@@ -1,0 +1,3 @@
+"""Vectorized relational operator kernels (the TPU analog of presto-main
+operator/*). Array-in/array-out, statically shaped, jit-friendly; Page-level
+wiring lives in presto_tpu.exec."""

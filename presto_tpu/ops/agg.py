@@ -1,0 +1,308 @@
+"""Group-by as segmented reduction (the TPU replacement for the reference's
+open-addressing hash tables).
+
+Reference: presto-main operator/HashAggregationOperator.java drives
+operator/GroupByHash.java (BigintGroupByHash fast path /
+MultiChannelGroupByHash) with per-row probe/insert — pointer-chasing that maps
+terribly to a vector unit. TPU-native design (BASELINE north-star: "hash
+aggregation as segmented reduction"):
+
+  - **sorted path** (general): lexsort rows by null-aware key encodings, mark
+    group boundaries where adjacent keys differ, group id = prefix-sum of
+    boundaries, then jax.ops.segment_* reductions with indices_are_sorted.
+    O(n log n) but fully vectorized, no collisions, deterministic.
+  - **dense path** (small key spaces, e.g. dictionary-coded flag columns):
+    group id computed arithmetically from codes, direct segment reductions
+    with a static group count — this is the Q1 fast path, analogous to the
+    reference's BigintGroupByHash small-range optimization.
+
+Output is fixed-capacity with a group validity mask plus an ``overflow`` flag
+(true if real group count exceeded capacity) so drivers can re-run with a
+larger capacity — the compiled-branch escape for dynamic cardinality
+(SURVEY §8.2.1).
+
+Partial/final split (reference: AggregationNode.Step PARTIAL/FINAL) is
+expressed by running the same primitives over partial-state pages with merge
+kinds (sum->sum, count->sum, min->min, max->max).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Primitive accumulator kinds. Compound SQL aggregates decompose into these
+# (avg -> sum+count with a finalize divide; reference analog: the
+# @AggregationFunction state/input/combine/output decomposition).
+SUM = "sum"
+COUNT = "count"  # counts non-null inputs
+COUNT_STAR = "count_star"
+MIN = "min"
+MAX = "max"
+ANY = "any"  # arbitrary non-null value (used for grouped key passthrough)
+BOOL_OR = "bool_or"
+BOOL_AND = "bool_and"
+
+
+@dataclasses.dataclass(frozen=True)
+class AggInput:
+    kind: str
+    # data/nulls indices into the arrays passed alongside; COUNT_STAR has none
+    has_input: bool = True
+
+
+def _null_aware_sort_keys(
+    key_cols: Sequence[jnp.ndarray],
+    key_nulls: Sequence[Optional[jnp.ndarray]],
+    valid: jnp.ndarray,
+) -> List[jnp.ndarray]:
+    """Sort keys: validity first (valid rows to front), then per key column a
+    (null-flag, normalized-value) pair so SQL NULLs form their own group."""
+    keys: List[jnp.ndarray] = [
+        jnp.where(valid, jnp.uint64(0), jnp.uint64(1))
+    ]
+    for col, null in zip(key_cols, key_nulls):
+        if null is None:
+            keys.append(jnp.zeros(col.shape, dtype=jnp.uint64))
+            keys.append(col)
+        else:
+            keys.append(jnp.where(null, jnp.uint64(1), jnp.uint64(0)))
+            keys.append(jnp.where(null, jnp.uint64(0), col))
+    return keys
+
+
+def _lexsort(keys: List[jnp.ndarray]) -> jnp.ndarray:
+    # jnp.lexsort: LAST key is primary; ours are listed primary-first.
+    return jnp.lexsort(tuple(reversed(keys)))
+
+
+@dataclasses.dataclass
+class GroupbyResult:
+    group_ids: jnp.ndarray  # int64[cap_in] group id per input row (clipped)
+    row_valid: jnp.ndarray  # contributing rows (input valid)
+    rep_index: jnp.ndarray  # int64[out_cap] representative input row per group
+    group_valid: jnp.ndarray  # bool[out_cap]
+    num_groups: jnp.ndarray  # traced scalar
+    overflow: jnp.ndarray  # traced bool
+
+
+def compute_groups_sorted(
+    key_cols: Sequence[jnp.ndarray],
+    key_nulls: Sequence[Optional[jnp.ndarray]],
+    valid: jnp.ndarray,
+    out_capacity: int,
+) -> GroupbyResult:
+    """Assign group ids via sort; no aggregation yet.
+
+    Reference analog: GroupByHash.getGroupIds(Page) — returns a group id per
+    input position; aggregation happens against those ids.
+    """
+    sort_keys = _null_aware_sort_keys(key_cols, key_nulls, valid)
+    perm = _lexsort(sort_keys)
+    svalid = valid[perm]
+
+    diff = jnp.zeros(valid.shape, dtype=jnp.bool_)
+    for k in sort_keys[1:]:
+        sk = k[perm]
+        d = jnp.concatenate(
+            [jnp.ones((1,), dtype=jnp.bool_), sk[1:] != sk[:-1]]
+        )
+        diff = diff | d
+    boundary = svalid & diff
+    gid_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    num_groups = jnp.sum(boundary.astype(jnp.int64))
+    overflow = num_groups > out_capacity
+
+    # scatter sorted-order group ids back to input order
+    gids = jnp.zeros(valid.shape, dtype=jnp.int64)
+    gids = gids.at[perm].set(jnp.clip(gid_sorted, 0, out_capacity - 1))
+
+    # representative input row per group = row at each boundary
+    targets = jnp.where(
+        boundary & (gid_sorted < out_capacity), gid_sorted, out_capacity
+    )
+    rep = jnp.zeros((out_capacity,), dtype=jnp.int64)
+    rep = rep.at[targets].set(perm.astype(jnp.int64), mode="drop")
+    group_valid = jnp.arange(out_capacity, dtype=jnp.int64) < num_groups
+    return GroupbyResult(
+        group_ids=gids,
+        row_valid=valid,
+        rep_index=rep,
+        group_valid=group_valid,
+        num_groups=num_groups,
+        overflow=overflow,
+    )
+
+
+def compute_groups_dense(
+    group_ids: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_groups: int,
+) -> GroupbyResult:
+    """Group ids already computed arithmetically (e.g. from dictionary codes:
+    gid = code_a * |dict_b| + code_b). Static group count, no sort.
+    """
+    ids = jnp.where(valid, group_ids.astype(jnp.int64), num_groups)
+    counts = jax.ops.segment_sum(
+        jnp.ones(valid.shape, dtype=jnp.int64),
+        ids,
+        num_segments=num_groups + 1,
+    )[:num_groups]
+    group_valid = counts > 0
+    # representative row per group: min input index holding that gid
+    idx = jnp.arange(valid.shape[0], dtype=jnp.int64)
+    rep = jax.ops.segment_min(
+        jnp.where(valid, idx, jnp.int64(2**62)),
+        ids,
+        num_segments=num_groups + 1,
+    )[:num_groups]
+    rep = jnp.clip(rep, 0, valid.shape[0] - 1)
+    return GroupbyResult(
+        group_ids=jnp.clip(ids, 0, num_groups - 1),
+        row_valid=valid,
+        rep_index=rep,
+        group_valid=group_valid,
+        num_groups=jnp.sum(group_valid.astype(jnp.int64)),
+        overflow=jnp.asarray(False),
+    )
+
+
+def _minmax_identity(dtype, is_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if is_min else -jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(is_min, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if is_min else info.min, dtype=dtype)
+
+
+def aggregate(
+    groups: GroupbyResult,
+    kind: str,
+    out_capacity: int,
+    data: Optional[jnp.ndarray] = None,
+    nulls: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """One primitive aggregation over assigned group ids.
+
+    Returns (values[out_capacity], null_mask or None). SQL semantics: SUM /
+    MIN / MAX / ANY over zero non-null inputs yield NULL; COUNT yields 0.
+    """
+    ids = jnp.where(groups.row_valid, groups.group_ids, out_capacity)
+    nseg = out_capacity + 1
+
+    if kind == COUNT_STAR:
+        ones = jnp.ones(groups.row_valid.shape, dtype=jnp.int64)
+        out = jax.ops.segment_sum(ones, ids, num_segments=nseg)[:out_capacity]
+        return out, None
+
+    assert data is not None
+    contributing = groups.row_valid
+    if nulls is not None:
+        contributing = contributing & ~nulls
+    cids = jnp.where(contributing, groups.group_ids, out_capacity)
+    ncontrib = jax.ops.segment_sum(
+        jnp.ones(contributing.shape, dtype=jnp.int64),
+        cids,
+        num_segments=nseg,
+    )[:out_capacity]
+    empty = ncontrib == 0
+
+    if kind == COUNT:
+        return ncontrib, None
+    if kind == SUM:
+        zero = jnp.zeros((), dtype=data.dtype)
+        out = jax.ops.segment_sum(
+            jnp.where(contributing, data, zero), cids, num_segments=nseg
+        )[:out_capacity]
+        return out, empty
+    if kind in (MIN, MAX):
+        ident = _minmax_identity(data.dtype, kind == MIN)
+        filled = jnp.where(contributing, data, ident)
+        seg = jax.ops.segment_min if kind == MIN else jax.ops.segment_max
+        out = seg(filled, cids, num_segments=nseg)[:out_capacity]
+        out = jnp.where(empty, jnp.zeros((), dtype=data.dtype), out)
+        return out, empty
+    if kind == ANY:
+        # value at min contributing row index
+        idx = jnp.arange(data.shape[0], dtype=jnp.int64)
+        first = jax.ops.segment_min(
+            jnp.where(contributing, idx, jnp.int64(2**62)),
+            cids,
+            num_segments=nseg,
+        )[:out_capacity]
+        first = jnp.clip(first, 0, data.shape[0] - 1)
+        return data[first], empty
+    if kind == BOOL_OR:
+        out = jax.ops.segment_max(
+            jnp.where(contributing, data.astype(jnp.int32), 0),
+            cids,
+            num_segments=nseg,
+        )[:out_capacity]
+        return out.astype(jnp.bool_), empty
+    if kind == BOOL_AND:
+        out = jax.ops.segment_min(
+            jnp.where(contributing, data.astype(jnp.int32), 1),
+            cids,
+            num_segments=nseg,
+        )[:out_capacity]
+        return out.astype(jnp.bool_), empty
+    raise ValueError(f"unknown aggregation kind: {kind}")
+
+
+def global_aggregate(
+    kind: str,
+    valid: jnp.ndarray,
+    data: Optional[jnp.ndarray] = None,
+    nulls: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Ungrouped aggregation (reference: operator/AggregationOperator.java).
+    Returns (scalar value, scalar is_null). COUNT of empty input is 0, SUM is
+    NULL — SQL global aggregates always produce exactly one row."""
+    if kind == COUNT_STAR:
+        return jnp.sum(valid.astype(jnp.int64)), jnp.asarray(False)
+    assert data is not None
+    contributing = valid
+    if nulls is not None:
+        contributing = contributing & ~nulls
+    n = jnp.sum(contributing.astype(jnp.int64))
+    empty = n == 0
+    if kind == COUNT:
+        return n, jnp.asarray(False)
+    if kind == SUM:
+        zero = jnp.zeros((), dtype=data.dtype)
+        return jnp.sum(jnp.where(contributing, data, zero)), empty
+    if kind in (MIN, MAX):
+        ident = _minmax_identity(data.dtype, kind == MIN)
+        filled = jnp.where(contributing, data, ident)
+        val = jnp.min(filled) if kind == MIN else jnp.max(filled)
+        return jnp.where(empty, jnp.zeros((), dtype=data.dtype), val), empty
+    if kind == ANY:
+        idx = jnp.arange(data.shape[0], dtype=jnp.int64)
+        first = jnp.min(jnp.where(contributing, idx, jnp.int64(2**62)))
+        first = jnp.clip(first, 0, data.shape[0] - 1)
+        return data[first], empty
+    if kind == BOOL_OR:
+        return jnp.any(contributing & data.astype(jnp.bool_)), empty
+    if kind == BOOL_AND:
+        return (
+            jnp.all(jnp.where(contributing, data.astype(jnp.bool_), True))
+            & ~empty,
+            empty,
+        )
+    raise ValueError(f"unknown aggregation kind: {kind}")
+
+
+MERGE_KIND = {
+    SUM: SUM,
+    COUNT: SUM,
+    COUNT_STAR: SUM,
+    MIN: MIN,
+    MAX: MAX,
+    ANY: ANY,
+    BOOL_OR: BOOL_OR,
+    BOOL_AND: BOOL_AND,
+}

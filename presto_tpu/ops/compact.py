@@ -1,0 +1,145 @@
+"""Selection-mask materialization: compact valid rows to a dense prefix and
+gather rows by index.
+
+Reference analog: presto-main operator/project/PageProcessor.java materializes
+selectedPositions into output Blocks; operator/PartitionedOutputOperator
+appends selected rows into per-partition PageBuilders. On TPU, compaction is a
+cumsum + scatter (stable, branch-free) and happens only at exchange/output
+boundaries — inside a stage, masks are free and compaction is wasted HBM
+bandwidth.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from presto_tpu.page import Block, Page
+
+
+def compact_indices(valid: jnp.ndarray, out_capacity: int):
+    """Stable scatter targets: row i goes to slot cumsum(valid)[i]-1.
+
+    Returns (targets[int, cap_in], out_valid[bool, out_capacity], num_rows).
+    Rows that are invalid or overflow out_capacity scatter to index
+    out_capacity (dropped by jax scatter mode='drop').
+    """
+    pos = jnp.cumsum(valid.astype(jnp.int64)) - 1
+    num = jnp.sum(valid.astype(jnp.int64))
+    targets = jnp.where(valid & (pos < out_capacity), pos, out_capacity)
+    out_valid = jnp.arange(out_capacity, dtype=jnp.int64) < num
+    return targets, out_valid, num
+
+
+def scatter_column(
+    data: jnp.ndarray, targets: jnp.ndarray, out_capacity: int
+) -> jnp.ndarray:
+    out = jnp.zeros((out_capacity,), dtype=data.dtype)
+    return out.at[targets].set(data, mode="drop")
+
+
+def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
+    """Materialize the selection mask: valid rows move to a dense prefix.
+
+    If out_capacity < num valid rows, overflow rows are silently dropped —
+    callers that can overflow must check num_rows first (the compiled-branch
+    escape described in SURVEY §8.2.1).
+    """
+    cap_out = out_capacity or page.capacity
+    targets, out_valid, _ = compact_indices(page.valid, cap_out)
+    new_blocks = []
+    for blk in page.blocks:
+        if isinstance(blk.data, tuple):
+            data = tuple(scatter_column(d, targets, cap_out) for d in blk.data)
+        else:
+            data = scatter_column(blk.data, targets, cap_out)
+        nulls = (
+            scatter_column(blk.nulls, targets, cap_out)
+            if blk.nulls is not None
+            else None
+        )
+        new_blocks.append(blk.with_data(data, nulls=nulls))
+    return Page(blocks=tuple(new_blocks), valid=out_valid)
+
+
+def gather_rows(
+    page: Page,
+    indices: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    force_null: Optional[jnp.ndarray] = None,
+) -> Page:
+    """Row gather: output row j = input row indices[j] (valid[j] gates).
+
+    force_null marks gathered rows entirely NULL (outer-join padding:
+    reference analog LookupJoinOperator emitting probe rows with null build
+    side).
+    """
+    idx = jnp.clip(indices, 0, page.capacity - 1)
+    new_blocks = []
+    for blk in page.blocks:
+        if isinstance(blk.data, tuple):
+            data = tuple(d[idx] for d in blk.data)
+        else:
+            data = blk.data[idx]
+        nulls = blk.nulls[idx] if blk.nulls is not None else None
+        if force_null is not None:
+            base = (
+                nulls
+                if nulls is not None
+                else jnp.zeros(idx.shape, dtype=jnp.bool_)
+            )
+            nulls = base | force_null
+        new_blocks.append(blk.with_data(data, nulls=nulls))
+    return Page(blocks=tuple(new_blocks), valid=valid)
+
+
+def concat_pages(a: Page, b: Page) -> Page:
+    """Concatenate two pages with identical schemas (capacities add).
+
+    Dictionary columns with differing dictionaries are merged: the output
+    dictionary is a's values followed by b's unseen values, and b's codes are
+    remapped through a static translation table (dictionaries are host-side
+    static data, so the remap is a compile-time constant gather).
+    """
+    import numpy as np
+
+    from presto_tpu.page import Block, Dictionary
+
+    blocks = []
+    for ba, bb in zip(a.blocks, b.blocks):
+        out_dict = ba.dictionary
+        bb_data = bb.data
+        if ba.dictionary is not None or bb.dictionary is not None:
+            da = ba.dictionary or Dictionary([])
+            db = bb.dictionary or Dictionary([])
+            if da != db:
+                merged_vals = list(da.values) + [
+                    v for v in db.values if da.code_of(v) < 0
+                ]
+                out_dict = Dictionary(merged_vals)
+                remap = np.array(
+                    [out_dict.code_of(v) for v in db.values] or [0],
+                    dtype=np.int32,
+                )
+                codes = jnp.clip(bb.data, 0, max(len(db) - 1, 0))
+                bb_data = jnp.asarray(remap)[codes]
+        if isinstance(ba.data, tuple):
+            data = tuple(
+                jnp.concatenate([x, y]) for x, y in zip(ba.data, bb_data)
+            )
+        else:
+            data = jnp.concatenate([ba.data, bb_data])
+        if ba.nulls is None and bb.nulls is None:
+            nulls = None
+        else:
+            na = ba.nulls_or_false()
+            nb = bb.nulls_or_false()
+            nulls = jnp.concatenate([na, nb])
+        blocks.append(
+            Block(data=data, type=ba.type, nulls=nulls, dictionary=out_dict)
+        )
+    return Page(
+        blocks=tuple(blocks), valid=jnp.concatenate([a.valid, b.valid])
+    )

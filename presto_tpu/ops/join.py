@@ -1,0 +1,207 @@
+"""Equi-join kernel: sort-searchsorted hash join with fixed-capacity match
+expansion.
+
+Reference: presto-main operator/HashBuilderOperator.java builds a PagesIndex +
+JoinHash (open-addressing over row addresses); operator/LookupJoinOperator
+probes row-at-a-time via JoinProbe. Pointer-chasing again — the TPU design
+replaces both with sorted arrays + vectorized binary search:
+
+  build:  hash build keys -> sort build rows by hash (one lexsort)
+  probe:  searchsorted(left/right) gives each probe row a candidate range
+          [lo, hi); range width = candidate match count
+  expand: fixed-capacity output; slot j belongs to probe row
+          searchsorted(cumsum(counts), j) at offset j - prefix — a branch-free
+          flattening of the variable-fanout probe loop
+  verify: gathered candidate keys compared for true equality, so 64-bit hash
+          collisions cost only wasted slots, never wrong results
+
+Dynamic output cardinality is handled capacity+overflow-flag style (SURVEY
+§8.2.1): callers size out_capacity, check ``overflow``, and retry bigger. The
+planner picks build/probe sides (reference: AddExchanges join distribution);
+outer-row emission (LEFT/RIGHT/FULL) and semi joins assemble from the match
+statistics returned here (reference: LookupJoinOperators factories,
+HashSemiJoinOperator).
+
+A Pallas radix-partitioned variant (north-star requirement) plugs in behind
+the same interface for HBM-resident build sides; see
+presto_tpu/ops/pallas_kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from presto_tpu.ops import hashing as H
+
+
+@dataclasses.dataclass
+class JoinMatches:
+    probe_idx: jnp.ndarray  # int64[out_cap] probe row per slot
+    build_idx: jnp.ndarray  # int64[out_cap] build row per slot
+    match: jnp.ndarray  # bool[out_cap] verified match
+    probe_match_count: jnp.ndarray  # int64[probe_cap]
+    build_matched: jnp.ndarray  # bool[build_cap]
+    total_candidates: jnp.ndarray  # traced scalar (pre-verification)
+    overflow: jnp.ndarray  # traced bool
+
+
+def _fold_nulls(
+    cols: Sequence[jnp.ndarray],
+    nulls: Sequence[Optional[jnp.ndarray]],
+    null_equals_null: bool,
+) -> tuple[List[jnp.ndarray], jnp.ndarray]:
+    """Returns (normalized key cols, any_null_disqualifies mask).
+
+    SQL equi-join: a NULL key never matches (unless IS NOT DISTINCT FROM
+    semantics, null_equals_null=True, where NULL matches NULL)."""
+    n = cols[0].shape[0]
+    any_null = jnp.zeros((n,), dtype=jnp.bool_)
+    out_cols: List[jnp.ndarray] = []
+    for c, nl in zip(cols, nulls):
+        if nl is None:
+            out_cols.append(c)
+            if null_equals_null:
+                # keep column counts symmetric across sides even when only
+                # one side has a nulls mask
+                out_cols.append(jnp.zeros((n,), dtype=jnp.uint64))
+            continue
+        out_cols.append(jnp.where(nl, jnp.uint64(0), c))
+        if null_equals_null:
+            out_cols.append(nl.astype(jnp.uint64))
+        else:
+            any_null = any_null | nl
+    return out_cols, any_null
+
+
+def hash_join_match(
+    build_cols: Sequence[jnp.ndarray],
+    build_nulls: Sequence[Optional[jnp.ndarray]],
+    build_valid: jnp.ndarray,
+    probe_cols: Sequence[jnp.ndarray],
+    probe_nulls: Sequence[Optional[jnp.ndarray]],
+    probe_valid: jnp.ndarray,
+    out_capacity: int,
+    *,
+    null_equals_null: bool = False,
+) -> JoinMatches:
+    """Match probe rows against build rows on equality-encoded uint64 keys."""
+    build_cap = build_valid.shape[0]
+    probe_cap = probe_valid.shape[0]
+
+    bcols, b_null_out = _fold_nulls(build_cols, build_nulls, null_equals_null)
+    pcols, p_null_out = _fold_nulls(probe_cols, probe_nulls, null_equals_null)
+    bvalid = build_valid & ~b_null_out
+    pvalid = probe_valid & ~p_null_out
+
+    none_nulls = [None] * len(bcols)
+    bhash = H.hash_columns(bcols, none_nulls)
+    phash = H.hash_columns(pcols, none_nulls)
+
+    # sort build rows: invalid last, then by hash
+    invalid_key = jnp.where(bvalid, jnp.uint64(0), jnp.uint64(1))
+    perm = jnp.lexsort((bhash, invalid_key))
+    sorted_hash = bhash[perm]
+    # poison invalid region so probe hashes cannot land in it
+    sorted_valid = bvalid[perm]
+    sorted_hash = jnp.where(
+        sorted_valid, sorted_hash, jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    )
+
+    lo = jnp.searchsorted(sorted_hash, phash, side="left")
+    hi = jnp.searchsorted(sorted_hash, phash, side="right")
+    counts = jnp.where(pvalid, (hi - lo).astype(jnp.int64), 0)
+
+    cum = jnp.cumsum(counts)
+    total = cum[-1] if counts.shape[0] else jnp.int64(0)
+    overflow = total > out_capacity
+
+    slots = jnp.arange(out_capacity, dtype=jnp.int64)
+    pid = jnp.searchsorted(cum, slots, side="right")
+    pid_c = jnp.clip(pid, 0, probe_cap - 1)
+    prev = jnp.concatenate([jnp.zeros((1,), dtype=cum.dtype), cum[:-1]])
+    off = slots - prev[pid_c]
+    sorted_pos = jnp.clip(lo[pid_c].astype(jnp.int64) + off, 0, build_cap - 1)
+    bid = perm[sorted_pos].astype(jnp.int64)
+
+    in_range = slots < total
+    match = in_range & pvalid[pid_c] & bvalid[bid]
+    for bc, pc in zip(bcols, pcols):
+        match = match & (bc[bid] == pc[pid_c])
+
+    probe_match_count = (
+        jnp.zeros((probe_cap + 1,), dtype=jnp.int64)
+        .at[jnp.where(match, pid_c, probe_cap)]
+        .add(1, mode="drop")[:probe_cap]
+    )
+    build_matched = (
+        jnp.zeros((build_cap + 1,), dtype=jnp.bool_)
+        .at[jnp.where(match, bid, build_cap)]
+        .max(True, mode="drop")[:build_cap]
+    )
+
+    return JoinMatches(
+        probe_idx=pid_c,
+        build_idx=bid,
+        match=match,
+        probe_match_count=probe_match_count,
+        build_matched=build_matched,
+        total_candidates=total,
+        overflow=overflow,
+    )
+
+
+def semi_join_mask(
+    build_cols: Sequence[jnp.ndarray],
+    build_nulls: Sequence[Optional[jnp.ndarray]],
+    build_valid: jnp.ndarray,
+    probe_cols: Sequence[jnp.ndarray],
+    probe_nulls: Sequence[Optional[jnp.ndarray]],
+    probe_valid: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-probe-row (has_match, null_result) for IN / semi-join predicates.
+
+    Reference: operator/HashSemiJoinOperator.java + SetBuilderOperator.
+    null_result marks SQL three-valued unknown: probe key NULL, or no match
+    while the build set contains a NULL (x IN (...NULL...) is NULL, not
+    false).
+    """
+    bcols, b_null = _fold_nulls(build_cols, build_nulls, False)
+    pcols, p_null = _fold_nulls(probe_cols, probe_nulls, False)
+    bvalid = build_valid & ~b_null
+    build_has_null = jnp.any(build_valid & b_null)
+
+    none_nulls = [None] * len(bcols)
+    bhash = H.hash_columns(bcols, none_nulls)
+    phash = H.hash_columns(pcols, none_nulls)
+    invalid_key = jnp.where(bvalid, jnp.uint64(0), jnp.uint64(1))
+    perm = jnp.lexsort((bhash, invalid_key))
+    sorted_valid = bvalid[perm]
+    sorted_hash = jnp.where(
+        sorted_valid, bhash[perm], jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    )
+    lo = jnp.searchsorted(sorted_hash, phash, side="left")
+    hi = jnp.searchsorted(sorted_hash, phash, side="right")
+
+    # verify within a bounded window (hash collisions beyond window are
+    # astronomically unlikely; window also bounds compile size)
+    WINDOW = 4
+    has_match = jnp.zeros(probe_valid.shape, dtype=jnp.bool_)
+    build_cap = bvalid.shape[0]
+    for w in range(WINDOW):
+        pos = jnp.clip(lo + w, 0, build_cap - 1)
+        bid = perm[pos]
+        ok = (lo + w < hi) & bvalid[bid]
+        for bc, pc in zip(bcols, pcols):
+            ok = ok & (bc[bid] == pc)
+        has_match = has_match | ok
+    # fall back for pathological windows: any remaining candidates counted as
+    # match only if hashes matched exactly (collision risk accepted 2^-64)
+    has_match = has_match | ((hi - lo) > WINDOW)
+
+    null_result = probe_valid & (
+        p_null | (~has_match & build_has_null)
+    )
+    return probe_valid & has_match & ~p_null, null_result

@@ -1,0 +1,120 @@
+"""Key encodings: map typed Blocks to uint64 arrays for equality (join /
+group-by) and total order (sort / merge).
+
+Reference analog: the reference compares typed values through Type
+equalTo/compareTo per position (spi/type/*); on TPU we precompute branch-free
+uint64 encodings once per page and then every comparison is integer compare.
+
+Equality encoding: values are equal iff encodings are equal (plus null flags).
+Order encoding: encoding order == SQL ascending order for non-null values:
+  - signed ints: flip sign bit  (x ^ 0x8000...),
+  - floats: IEEE-754 total order trick (flip all bits if negative, else set
+    sign bit); -0.0 normalized to +0.0 first so -0.0 == 0.0 (SQL equality);
+    NaN sorts above +inf which matches the engine's NaN-is-largest rule,
+  - dictionary codes: order via Dictionary.sort_rank (host, static), equality
+    via raw codes,
+  - booleans: 0/1.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.page import Block
+
+_SIGN64 = jnp.uint64(0x8000000000000000)
+
+
+def _int_order_u64(x: jnp.ndarray) -> jnp.ndarray:
+    return x.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN64
+
+
+def _float_order_u64(x: jnp.ndarray) -> jnp.ndarray:
+    x64 = x.astype(jnp.float64)
+    x64 = jnp.where(x64 == 0.0, 0.0, x64)  # -0.0 -> +0.0
+    bits = jax_bitcast_f64_u64(x64)
+    neg = (bits & _SIGN64) != 0
+    return jnp.where(neg, ~bits, bits | _SIGN64)
+
+
+def jax_bitcast_f64_u64(x: jnp.ndarray) -> jnp.ndarray:
+    import jax.lax as lax
+
+    return lax.bitcast_convert_type(x, jnp.uint64)
+
+
+def equality_encoding(block: Block) -> List[jnp.ndarray]:
+    """uint64 array(s) such that rows are SQL-equal iff encodings equal.
+
+    For floats we use the order encoding (normalizes -0.0; NaN==NaN under this
+    encoding, documented divergence: SQL `=` on NaN is false, but GROUP BY /
+    join on NaN grouping-equal matches the reference's distinct-value
+    semantics, which treat NaN as one value).
+    """
+    t = block.type
+    if isinstance(block.data, tuple):  # long decimal limbs
+        hi, lo = block.data
+        return [hi.astype(jnp.uint64), lo.astype(jnp.uint64)]
+    if isinstance(t, (T.DoubleType, T.RealType)):
+        return [_float_order_u64(block.data)]
+    if isinstance(t, T.BooleanType):
+        return [block.data.astype(jnp.uint64)]
+    return [block.data.astype(jnp.int64).astype(jnp.uint64)]
+
+
+def order_encoding(
+    block: Block,
+    *,
+    ascending: bool = True,
+    nulls_first: bool = False,
+) -> List[jnp.ndarray]:
+    """uint64 key columns (most-significant first) whose ascending order is
+    the requested SQL order, including the null position. Invalid rows are
+    handled by the caller (sorted to the end via a leading validity key)."""
+    t = block.type
+    if isinstance(block.data, tuple):
+        hi, lo = block.data
+        keys = [_int_order_u64(hi), lo.astype(jnp.uint64)]
+    elif isinstance(t, (T.DoubleType, T.RealType)):
+        keys = [_float_order_u64(block.data)]
+    elif isinstance(t, T.BooleanType):
+        keys = [block.data.astype(jnp.uint64)]
+    elif t.is_dictionary_encoded and block.dictionary is not None:
+        if len(block.dictionary) == 0:
+            # all-NULL column: only the null key matters
+            keys = [jnp.zeros(block.data.shape, dtype=jnp.uint64)]
+        else:
+            rank = jnp.asarray(block.dictionary.sort_rank())
+            codes = jnp.clip(block.data, 0, len(block.dictionary) - 1)
+            keys = [rank[codes].astype(jnp.uint64)]
+    else:
+        keys = [_int_order_u64(block.data)]
+
+    if not ascending:
+        keys = [~k for k in keys]
+
+    null = block.nulls
+    if null is None:
+        null_key = jnp.zeros(keys[0].shape, dtype=jnp.uint64)
+    elif nulls_first:
+        null_key = jnp.where(null, jnp.uint64(0), jnp.uint64(1))
+    else:
+        null_key = jnp.where(null, jnp.uint64(1), jnp.uint64(0))
+    return [null_key] + keys
+
+
+def block_key_columns(
+    blocks,
+) -> Tuple[List[jnp.ndarray], List[Optional[jnp.ndarray]]]:
+    """Equality encodings + null masks for a list of key Blocks (flattened:
+    a long-decimal key contributes two uint64 columns sharing one null)."""
+    cols: List[jnp.ndarray] = []
+    nulls: List[Optional[jnp.ndarray]] = []
+    for b in blocks:
+        enc = equality_encoding(b)
+        cols.extend(enc)
+        nulls.extend([b.nulls] * len(enc))
+    return cols, nulls

@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Mirrors the reference's test-ring strategy (SURVEY §5): all tests run on CPU
+with a virtual 8-device mesh so distributed semantics are exercised without
+TPU hardware (reference analog: DistributedQueryRunner boots a multi-node
+cluster inside one JVM).
+
+Env vars MUST be set before jax is imported anywhere.
+"""
+
+import os
+
+# force CPU even if the ambient env targets a real TPU (axon tunnel)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# the axon sitecustomize imports jax at interpreter start, latching the
+# platform before this file runs — override through the live config too
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)

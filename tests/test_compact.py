@@ -1,0 +1,81 @@
+"""Compaction/gather oracle tests (reference analog: PageProcessor
+selectedPositions materialization tests)."""
+
+import jax
+import numpy as np
+
+from presto_tpu import BIGINT, DOUBLE, VarcharType
+from presto_tpu.ops.compact import compact_page, concat_pages, gather_rows
+from presto_tpu.page import Page
+
+import jax.numpy as jnp
+
+
+def _page():
+    return Page.from_arrays(
+        [
+            [10, 11, 12, 13, 14, 15],
+            [0.5, None, 2.5, 3.5, None, 5.5],
+            ["a", "b", "a", None, "c", "b"],
+        ],
+        [BIGINT, DOUBLE, VarcharType()],
+        capacity=8,
+    )
+
+
+def test_compact_preserves_order_and_nulls():
+    page = _page()
+    keep = jnp.asarray([True, False, True, True, False, False, False, False])
+    filtered = page.with_valid(page.valid & keep)
+    out = compact_page(filtered)
+    assert out.to_pylist() == [(10, 0.5, "a"), (12, 2.5, "a"), (13, 3.5, None)]
+    # dense prefix
+    v = np.asarray(out.valid)
+    assert v[:3].all() and not v[3:].any()
+
+
+def test_compact_under_jit():
+    page = _page()
+
+    @jax.jit
+    def go(p):
+        return compact_page(p.with_valid(p.valid & (p.block(0).data % 2 == 0)))
+
+    out = go(page)
+    assert out.to_pylist() == [(10, 0.5, "a"), (12, 2.5, "a"), (14, None, "c")]
+
+
+def test_compact_shrink_capacity():
+    page = _page()
+    out = compact_page(page, out_capacity=4)
+    # silently truncates beyond capacity (callers check num_rows first)
+    assert len(out.to_pylist()) == 4
+
+
+def test_gather_rows_with_force_null():
+    page = _page()
+    idx = jnp.asarray([2, 0, 5], dtype=jnp.int64)
+    valid = jnp.asarray([True, True, True])
+    force = jnp.asarray([False, True, False])
+    out = gather_rows(page, idx, valid, force_null=force)
+    assert out.to_pylist() == [
+        (12, 2.5, "a"),
+        (None, None, None),
+        (15, 5.5, "b"),
+    ]
+
+
+def test_concat_pages():
+    a = Page.from_arrays([[1, 2]], [BIGINT], capacity=4)
+    b = Page.from_arrays([[3]], [BIGINT], capacity=2)
+    out = concat_pages(a, b)
+    assert out.capacity == 6
+    assert sorted(out.to_pylist()) == [(1,), (2,), (3,)]
+
+
+def test_concat_pages_merges_dictionaries():
+    a = Page.from_arrays([["apple", "cherry"]], [VarcharType()])
+    b = Page.from_arrays([["banana", "zebra", None]], [VarcharType()])
+    out = concat_pages(a, b)
+    got = [r[0] for r in out.to_pylist()]
+    assert got == ["apple", "cherry", "banana", "zebra", None]

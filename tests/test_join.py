@@ -1,0 +1,205 @@
+"""Join kernel oracle tests vs nested-loop Python joins (reference analog:
+TestHashJoinOperator)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.ops import join as J
+
+
+def _encode(arr):
+    return jnp.asarray(np.asarray(arr, dtype=np.int64)).astype(jnp.uint64)
+
+
+def _oracle_inner(build, probe, bvalid, pvalid):
+    out = []
+    for pi, pv in enumerate(probe):
+        if not pvalid[pi] or pv is None:
+            continue
+        for bi, bv in enumerate(build):
+            if bvalid[bi] and bv is not None and bv == pv:
+                out.append((pi, bi))
+    return sorted(out)
+
+
+def test_inner_join_with_duplicates(rng):
+    build = rng.integers(0, 10, size=40).tolist()
+    probe = rng.integers(0, 12, size=60).tolist()
+    bvalid = (rng.random(40) < 0.9).tolist()
+    pvalid = (rng.random(60) < 0.9).tolist()
+
+    m = J.hash_join_match(
+        [_encode(build)],
+        [None],
+        jnp.asarray(bvalid),
+        [_encode(probe)],
+        [None],
+        jnp.asarray(pvalid),
+        out_capacity=512,
+    )
+    got = sorted(
+        (int(p), int(b))
+        for p, b, ok in zip(
+            np.asarray(m.probe_idx), np.asarray(m.build_idx), np.asarray(m.match)
+        )
+        if ok
+    )
+    assert got == _oracle_inner(build, probe, bvalid, pvalid)
+    assert not bool(m.overflow)
+
+
+def test_join_null_keys_never_match():
+    build = [1, 2, 3]
+    bnull = jnp.asarray([False, True, False])
+    probe = [2, 1, 5]
+    pnull = jnp.asarray([False, False, True])
+    m = J.hash_join_match(
+        [_encode(build)],
+        [bnull],
+        jnp.ones(3, dtype=bool),
+        [_encode(probe)],
+        [pnull],
+        jnp.ones(3, dtype=bool),
+        out_capacity=16,
+    )
+    got = {
+        (int(p), int(b))
+        for p, b, ok in zip(
+            np.asarray(m.probe_idx), np.asarray(m.build_idx), np.asarray(m.match)
+        )
+        if ok
+    }
+    assert got == {(1, 0)}  # probe row 1 (=1) matches build row 0 (=1)
+
+
+def test_join_null_equals_null_mode():
+    build = [1, 0]
+    bnull = jnp.asarray([False, True])
+    probe = [0, 1]
+    pnull = jnp.asarray([True, False])
+    m = J.hash_join_match(
+        [_encode(build)],
+        [bnull],
+        jnp.ones(2, dtype=bool),
+        [_encode(probe)],
+        [pnull],
+        jnp.ones(2, dtype=bool),
+        out_capacity=8,
+        null_equals_null=True,
+    )
+    got = {
+        (int(p), int(b))
+        for p, b, ok in zip(
+            np.asarray(m.probe_idx), np.asarray(m.build_idx), np.asarray(m.match)
+        )
+        if ok
+    }
+    assert got == {(0, 1), (1, 0)}
+
+
+def test_join_null_equals_null_asymmetric_masks():
+    """null_equals_null with a nulls mask on only one side must still match
+    (regression: asymmetric key-column counts made hashes diverge)."""
+    build = [1, 2, 0]
+    bnull = jnp.asarray([False, False, True])
+    probe = [1, 2]
+    m = J.hash_join_match(
+        [_encode(build)],
+        [bnull],
+        jnp.ones(3, dtype=bool),
+        [_encode(probe)],
+        [None],
+        jnp.ones(2, dtype=bool),
+        out_capacity=8,
+        null_equals_null=True,
+    )
+    got = {
+        (int(p), int(b))
+        for p, b, ok in zip(
+            np.asarray(m.probe_idx), np.asarray(m.build_idx), np.asarray(m.match)
+        )
+        if ok
+    }
+    assert got == {(0, 0), (1, 1)}
+
+
+def test_multi_key_join(rng):
+    n_b, n_p = 30, 50
+    b1 = rng.integers(0, 4, size=n_b)
+    b2 = rng.integers(0, 4, size=n_b)
+    p1 = rng.integers(0, 4, size=n_p)
+    p2 = rng.integers(0, 4, size=n_p)
+    m = J.hash_join_match(
+        [_encode(b1), _encode(b2)],
+        [None, None],
+        jnp.ones(n_b, dtype=bool),
+        [_encode(p1), _encode(p2)],
+        [None, None],
+        jnp.ones(n_p, dtype=bool),
+        out_capacity=1024,
+    )
+    got = sorted(
+        (int(p), int(b))
+        for p, b, ok in zip(
+            np.asarray(m.probe_idx), np.asarray(m.build_idx), np.asarray(m.match)
+        )
+        if ok
+    )
+    oracle = sorted(
+        (pi, bi)
+        for pi in range(n_p)
+        for bi in range(n_b)
+        if b1[bi] == p1[pi] and b2[bi] == p2[pi]
+    )
+    assert got == oracle
+
+
+def test_probe_match_count_and_build_matched():
+    build = [1, 1, 2, 9]
+    probe = [1, 3, 2]
+    m = J.hash_join_match(
+        [_encode(build)],
+        [None],
+        jnp.ones(4, dtype=bool),
+        [_encode(probe)],
+        [None],
+        jnp.ones(3, dtype=bool),
+        out_capacity=16,
+    )
+    np.testing.assert_array_equal(np.asarray(m.probe_match_count), [2, 0, 1])
+    np.testing.assert_array_equal(
+        np.asarray(m.build_matched), [True, True, True, False]
+    )
+
+
+def test_join_overflow_flag():
+    build = [7] * 8
+    probe = [7] * 8
+    m = J.hash_join_match(
+        [_encode(build)],
+        [None],
+        jnp.ones(8, dtype=bool),
+        [_encode(probe)],
+        [None],
+        jnp.ones(8, dtype=bool),
+        out_capacity=16,  # need 64
+    )
+    assert bool(m.overflow)
+
+
+def test_semi_join_three_valued_logic():
+    build = [1, 2, 0]
+    bnull = jnp.asarray([False, False, True])
+    probe = [1, 5, 0]
+    pnull = jnp.asarray([False, False, True])
+    has, null_res = J.semi_join_mask(
+        [_encode(build)],
+        [bnull],
+        jnp.ones(3, dtype=bool),
+        [_encode(probe)],
+        [pnull],
+        jnp.ones(3, dtype=bool),
+    )
+    # 1 IN {1,2,NULL} -> true; 5 IN {...NULL} -> NULL; NULL IN ... -> NULL
+    np.testing.assert_array_equal(np.asarray(has), [True, False, False])
+    np.testing.assert_array_equal(np.asarray(null_res), [False, True, True])

@@ -1,0 +1,78 @@
+"""Sort / limit kernel tests vs Python sorted() oracle (reference analog:
+TestOrderByOperator, TestTopNOperator)."""
+
+import numpy as np
+
+from presto_tpu import BIGINT, DOUBLE, VarcharType
+from presto_tpu.ops.sort import SortKey, limit_page, sort_page
+from presto_tpu.page import Page
+
+
+def _page():
+    return Page.from_arrays(
+        [
+            [3, 1, 2, 1, None, 2],
+            [0.5, 2.5, None, 1.0, 3.5, -1.0],
+            ["b", "a", "c", None, "a", "b"],
+        ],
+        [BIGINT, DOUBLE, VarcharType()],
+    )
+
+
+def test_sort_single_key_asc_nulls_last():
+    out = sort_page(_page(), [SortKey(0)])
+    got = [r[0] for r in out.to_pylist()]
+    assert got == [1, 1, 2, 2, 3, None]
+
+
+def test_sort_desc_nulls_first():
+    out = sort_page(_page(), [SortKey(0, ascending=False, nulls_first=True)])
+    got = [r[0] for r in out.to_pylist()]
+    assert got == [None, 3, 2, 2, 1, 1]
+
+
+def test_sort_multi_key_stable_semantics():
+    # default null ordering is NULLS LAST regardless of direction
+    out = sort_page(_page(), [SortKey(0), SortKey(1, ascending=False)])
+    got = [(r[0], r[1]) for r in out.to_pylist()]
+    assert got == [(1, 2.5), (1, 1.0), (2, -1.0), (2, None), (3, 0.5), (None, 3.5)]
+
+
+def test_sort_all_null_varchar():
+    page = Page.from_arrays([[None, None, None]], [VarcharType()])
+    out = sort_page(page, [SortKey(0)])
+    assert out.to_pylist() == [(None,), (None,), (None,)]
+
+
+def test_sort_on_varchar_dictionary():
+    out = sort_page(_page(), [SortKey(2), SortKey(0)])
+    got = [(r[2], r[0]) for r in out.to_pylist()]
+    assert got == [
+        ("a", 1),
+        ("a", None),
+        ("b", 2),
+        ("b", 3),
+        ("c", 2),
+        (None, 1),
+    ]
+
+
+def test_sort_limit_offset():
+    out = sort_page(_page(), [SortKey(0)], limit=3, offset=1)
+    got = [r[0] for r in out.to_pylist()]
+    assert got == [1, 2, 2]
+    assert out.capacity == 3
+
+
+def test_sort_floats_total_order(rng):
+    vals = rng.normal(size=50).tolist() + [0.0, -0.0, float("inf"), -float("inf")]
+    page = Page.from_arrays([vals], [DOUBLE])
+    out = sort_page(page, [SortKey(0)])
+    got = [r[0] for r in out.to_pylist()]
+    assert got == sorted(vals)
+
+
+def test_limit_without_sort_keeps_page_order():
+    page = _page()
+    out = limit_page(page, 2, offset=1)
+    assert [r[0] for r in out.to_pylist()] == [1, 2]
