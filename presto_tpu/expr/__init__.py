@@ -1,0 +1,18 @@
+"""Expression layer: RowExpression-style IR lowered to jax array programs.
+
+Reference: presto-main sql/relational/RowExpression.java (the IR) and
+sql/gen/ExpressionCompiler.java (JVM bytecode codegen). Our "bytecode" is XLA:
+an expression tree evaluates to a statically-shaped array program over a Page,
+and ``jax.jit`` compiles it. The dual-eval testing pattern (reference:
+operator/scalar/FunctionAssertions evaluating interpreted vs compiled) becomes
+evaluating with the numpy backend vs the jitted jax backend.
+"""
+
+from presto_tpu.expr.ir import (  # noqa: F401
+    Call,
+    Constant,
+    InputRef,
+    RowExpression,
+    SpecialForm,
+)
+from presto_tpu.expr.eval import Val, evaluate, evaluate_filter  # noqa: F401

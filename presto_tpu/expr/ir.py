@@ -1,0 +1,194 @@
+"""RowExpression-equivalent IR.
+
+Reference: presto-main sql/relational/RowExpression.java and subclasses
+CallExpression / ConstantExpression / InputReferenceExpression /
+SpecialFormExpression (AND, OR, IF, COALESCE, SWITCH, IN, IS_NULL, ...).
+Planner-produced trees of these nodes are what the reference compiles to
+bytecode; ours lower to jax (presto_tpu/expr/eval.py).
+
+Nodes are frozen/hashable so whole trees can ride in jit static aux data —
+the jit cache key plays the role of the reference's compiled-expression LRU
+(sql/gen/ExpressionCompiler cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+from presto_tpu import types as T
+
+
+class RowExpression:
+    """Base class. ``type`` is the SQL result type of the node."""
+
+    type: T.SqlType
+
+    def children(self) -> Tuple["RowExpression", ...]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class InputRef(RowExpression):
+    """Reference to a Page channel (reference: InputReferenceExpression)."""
+
+    channel: int
+    type: T.SqlType = dataclasses.field(default_factory=T.UnknownType)
+
+    def __repr__(self) -> str:
+        return f"#{self.channel}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Constant(RowExpression):
+    """Literal (reference: ConstantExpression). value=None means NULL.
+
+    Values are host Python scalars: int for integral/date/interval types,
+    int (unscaled) for decimals, float for double/real, bool, str for
+    varchar/char.
+    """
+
+    value: Any
+    type: T.SqlType = dataclasses.field(default_factory=T.UnknownType)
+
+    def __repr__(self) -> str:
+        return f"{self.value!r}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(RowExpression):
+    """Function/operator call bound by name against the function registry
+    (reference: CallExpression resolved against FunctionRegistry)."""
+
+    name: str
+    args: Tuple[RowExpression, ...]
+    type: T.SqlType
+
+    def children(self) -> Tuple[RowExpression, ...]:
+        return self.args
+
+    def __repr__(self) -> str:
+        inner = ", ".join(repr(a) for a in self.args)
+        return f"{self.name}({inner})"
+
+
+# SpecialForm kinds (reference: SpecialFormExpression.Form)
+AND = "and"
+OR = "or"
+IF = "if"  # args: (condition, then, else)
+COALESCE = "coalesce"
+SWITCH = "switch"  # searched CASE: (when1, then1, ..., whenN, thenN, else)
+IN = "in"  # args: (value, candidate1, ..., candidateN)
+IS_NULL = "is_null"
+BETWEEN = "between"  # args: (value, low, high)
+DEREFERENCE = "dereference"  # row field access (v1: unsupported at eval)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecialForm(RowExpression):
+    """Short-circuit / variadic forms with non-function null semantics.
+
+    The reference evaluates these lazily (bytecode branches); XLA evaluates
+    both sides eagerly and selects with where() — the documented semantic
+    difference (SURVEY §4.4): erroring branches must be masked by their
+    guards, which the function implementations here do (e.g. divide by zero
+    yields NULL rather than raising).
+    """
+
+    form: str
+    args: Tuple[RowExpression, ...]
+    type: T.SqlType
+
+    def children(self) -> Tuple[RowExpression, ...]:
+        return self.args
+
+    def __repr__(self) -> str:
+        inner = ", ".join(repr(a) for a in self.args)
+        return f"{self.form.upper()}({inner})"
+
+
+# ----------------------------------------------------------------- builders
+# Convenience constructors that resolve result types via the registry, for
+# hand-built plans and tests (the SQL analyzer builds nodes directly).
+
+
+def _registry():
+    from presto_tpu.expr import functions
+
+    return functions
+
+
+def input_ref(channel: int, typ: T.SqlType) -> InputRef:
+    return InputRef(channel, typ)
+
+
+def const(value: Any, typ: T.SqlType) -> Constant:
+    return Constant(value, typ)
+
+
+def null(typ: T.SqlType = T.UNKNOWN) -> Constant:
+    return Constant(None, typ)
+
+
+def call(name: str, *args: RowExpression) -> Call:
+    typ = _registry().resolve_type(name, [a.type for a in args])
+    return Call(name, tuple(args), typ)
+
+
+def and_(*args: RowExpression) -> SpecialForm:
+    return SpecialForm(AND, tuple(args), T.BOOLEAN)
+
+
+def or_(*args: RowExpression) -> SpecialForm:
+    return SpecialForm(OR, tuple(args), T.BOOLEAN)
+
+
+def not_(arg: RowExpression) -> Call:
+    return call("not", arg)
+
+
+def if_(cond, then, else_) -> SpecialForm:
+    typ = T.common_super_type(then.type, else_.type)
+    if typ is None:
+        raise TypeError(f"IF branches disagree: {then.type} vs {else_.type}")
+    return SpecialForm(IF, (cond, then, else_), typ)
+
+
+def is_null(arg: RowExpression) -> SpecialForm:
+    return SpecialForm(IS_NULL, (arg,), T.BOOLEAN)
+
+
+def coalesce(*args: RowExpression) -> SpecialForm:
+    typ = args[0].type
+    for a in args[1:]:
+        nxt = T.common_super_type(typ, a.type)
+        if nxt is None:
+            raise TypeError(f"COALESCE branches disagree: {typ} vs {a.type}")
+        typ = nxt
+    return SpecialForm(COALESCE, tuple(args), typ)
+
+
+def between(value, low, high) -> SpecialForm:
+    return SpecialForm(BETWEEN, (value, low, high), T.BOOLEAN)
+
+
+def in_(value, *candidates: RowExpression) -> SpecialForm:
+    return SpecialForm(IN, (value,) + tuple(candidates), T.BOOLEAN)
+
+
+def switch(*args: RowExpression) -> SpecialForm:
+    """Searched CASE: switch(when1, then1, ..., whenN, thenN, default)."""
+    if len(args) < 3 or len(args) % 2 == 0:
+        raise TypeError("switch needs whenN/thenN pairs plus a default")
+    thens = list(args[1::2]) + [args[-1]]
+    typ = thens[0].type
+    for t in thens[1:]:
+        nxt = T.common_super_type(typ, t.type)
+        if nxt is None:
+            raise TypeError(f"CASE branches disagree: {typ} vs {t.type}")
+        typ = nxt
+    return SpecialForm(SWITCH, tuple(args), typ)
+
+
+def cast(arg: RowExpression, to: T.SqlType) -> Call:
+    return Call("cast", (arg,), to)

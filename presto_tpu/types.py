@@ -247,6 +247,29 @@ class VarbinaryType(SqlType):
 
 
 @dataclasses.dataclass(frozen=True)
+class IntervalDayTimeType(FixedWidthType):
+    """INTERVAL DAY TO SECOND — epoch-free duration in microseconds, int64
+    (reference: spi/type/ (airlift units) IntervalDayTimeType, millis)."""
+
+    name: str = dataclasses.field(init=False, default="interval day to second")
+
+    @property
+    def device_dtype(self):
+        return jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalYearMonthType(FixedWidthType):
+    """INTERVAL YEAR TO MONTH — whole months, int32."""
+
+    name: str = dataclasses.field(init=False, default="interval year to month")
+
+    @property
+    def device_dtype(self):
+        return jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
 class UnknownType(SqlType):
     """Type of NULL literals before coercion (reference: spi UnknownType)."""
 
@@ -298,6 +321,8 @@ TIMESTAMP = TimestampType()
 VARBINARY = VarbinaryType()
 UNKNOWN = UnknownType()
 VARCHAR = VarcharType()
+INTERVAL_DAY_TIME = IntervalDayTimeType()
+INTERVAL_YEAR_MONTH = IntervalYearMonthType()
 
 _INTEGRAL = (BigintType, IntegerType, SmallintType, TinyintType)
 _FLOATING = (DoubleType, RealType)

@@ -1,0 +1,436 @@
+"""Expression layer tests: dual evaluation (jitted jax vs numpy oracle).
+
+Reference test pattern: presto-main operator/scalar/FunctionAssertions
+evaluates every expression both interpreted and bytecode-compiled and
+compares — ours compares the numpy backend against the jax.jit backend
+(SURVEY §5 ring-1 mapping).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.expr import ir
+from presto_tpu.expr.eval import evaluate, evaluate_filter
+from presto_tpu.page import Page
+
+
+def np_page(page: Page) -> Page:
+    return jax.tree_util.tree_map(np.asarray, page)
+
+
+def dual_eval(expr, page, decode=True):
+    """Evaluate under jit (jax) and plain numpy; assert identical; return
+    (data, nulls) numpy arrays from the jax path."""
+
+    @jax.jit
+    def run(p):
+        v = evaluate(expr, p, jnp)
+        return v.data, v.nulls
+
+    jd, jn = run(page)
+    ov = evaluate(expr, np_page(page), np)
+    od, on = ov.data, ov.nulls
+    jd_np = (
+        tuple(np.asarray(x) for x in jd)
+        if isinstance(jd, tuple)
+        else np.asarray(jd)
+    )
+    od_b = np.broadcast_to(od, np.shape(jd_np)) if not isinstance(
+        od, tuple) else od
+    valid = np.asarray(page.valid)
+    if isinstance(jd_np, tuple):
+        for a, b in zip(jd_np, od_b):
+            np.testing.assert_array_equal(a[valid], np.asarray(b)[valid])
+    else:
+        nulls_j = np.zeros(valid.shape, bool) if jn is None else np.asarray(
+            np.broadcast_to(jn, valid.shape))
+        nulls_o = np.zeros(valid.shape, bool) if on is None else np.asarray(
+            np.broadcast_to(on, valid.shape))
+        np.testing.assert_array_equal(nulls_j[valid], nulls_o[valid])
+        live = valid & ~nulls_j
+        if jd_np.dtype.kind == "f":
+            np.testing.assert_allclose(
+                jd_np[live], np.asarray(od_b)[live], rtol=1e-12
+            )
+        else:
+            np.testing.assert_array_equal(jd_np[live], np.asarray(od_b)[live])
+    return jd_np, (None if jn is None else np.asarray(
+        np.broadcast_to(jn, valid.shape)))
+
+
+def bigint_page(*cols, nulls=None):
+    types = [T.BIGINT] * len(cols)
+    page = Page.from_arrays(list(cols), types)
+    return page
+
+
+class TestArithmetic:
+    def test_add_mul(self):
+        page = bigint_page([1, 2, 3, -4], [10, 20, 30, 40])
+        e = ir.call(
+            "add",
+            ir.call("multiply", ir.input_ref(0, T.BIGINT),
+                    ir.const(3, T.BIGINT)),
+            ir.input_ref(1, T.BIGINT),
+        )
+        data, nulls = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:4], [13, 26, 39, 28])
+
+    def test_division_by_zero_is_null(self):
+        page = bigint_page([10, 7, -9], [2, 0, -2])
+        e = ir.call("divide", ir.input_ref(0, T.BIGINT),
+                    ir.input_ref(1, T.BIGINT))
+        data, nulls = dual_eval(e, page)
+        assert nulls is not None and bool(nulls[1])
+        assert data[0] == 5 and data[2] == 4  # trunc toward zero
+
+    def test_modulus_sign(self):
+        page = bigint_page([7, -7, 7, -7], [3, 3, -3, -3])
+        e = ir.call("modulus", ir.input_ref(0, T.BIGINT),
+                    ir.input_ref(1, T.BIGINT))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:4], [1, -1, 1, -1])
+
+    def test_double_arith(self):
+        page = Page.from_arrays(
+            [[1.5, -2.25, 0.0], [2.0, 0.5, 3.0]], [T.DOUBLE, T.DOUBLE]
+        )
+        e = ir.call("divide", ir.input_ref(0, T.DOUBLE),
+                    ir.input_ref(1, T.DOUBLE))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_allclose(data[:3], [0.75, -4.5, 0.0])
+
+    def test_null_propagation(self):
+        page = Page.from_arrays([[1, None, 3], [None, 2, 3]],
+                                [T.BIGINT, T.BIGINT])
+        e = ir.call("add", ir.input_ref(0, T.BIGINT),
+                    ir.input_ref(1, T.BIGINT))
+        data, nulls = dual_eval(e, page)
+        np.testing.assert_array_equal(nulls[:3], [True, True, False])
+        assert data[2] == 6
+
+
+class TestDecimal:
+    def test_decimal_mul_rescale(self):
+        t = T.DecimalType(12, 2)
+        # 12.34 * 5.00 = 61.70 ; result scale 4 -> 617000
+        page = Page.from_arrays([[1234, 100], [500, 250]], [t, t])
+        e = ir.call("multiply", ir.input_ref(0, t), ir.input_ref(1, t))
+        assert e.type.scale == 4
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:2], [617000, 25000])
+
+    def test_decimal_add_mixed_scale(self):
+        a, b = T.DecimalType(10, 2), T.DecimalType(10, 4)
+        page = Page.from_arrays([[150], [12345]], [a, b])
+        e = ir.call("add", ir.input_ref(0, a), ir.input_ref(1, b))
+        assert e.type.scale == 4
+        data, _ = dual_eval(e, page)
+        assert data[0] == 15000 + 12345
+
+    def test_decimal_div_round_half_up(self):
+        t = T.DecimalType(10, 2)
+        page = Page.from_arrays([[100, 100, -100], [300, 800, 300]], [t, t])
+        e = ir.call("divide", ir.input_ref(0, t), ir.input_ref(1, t))
+        data, _ = dual_eval(e, page)
+        # 1.00/3.00 = 0.33 ; 1.00/8.00 = 0.13 (0.125 rounds up); -1/3 = -0.33
+        np.testing.assert_array_equal(data[:3], [33, 13, -33])
+
+    def test_q1_style_expression(self):
+        # l_extendedprice * (1 - l_discount) * (1 + l_tax)
+        price_t = T.DecimalType(12, 2)
+        disc_t = T.DecimalType(12, 2)
+        page = Page.from_arrays(
+            [[1000_00, 2499_99], [5, 10], [8, 0]], [price_t, disc_t, disc_t]
+        )
+        one = ir.const(100, T.DecimalType(12, 2))
+        e = ir.call(
+            "multiply",
+            ir.call(
+                "multiply",
+                ir.input_ref(0, price_t),
+                ir.call("subtract", one, ir.input_ref(1, disc_t)),
+            ),
+            ir.call("add", one, ir.input_ref(2, disc_t)),
+        )
+        data, _ = dual_eval(e, page)
+        # 1000.00 * 0.95 * 1.08 = 1026.00 at scale 6
+        assert data[0] == 1026_000000
+
+
+class TestComparisons:
+    def test_int_cmp(self):
+        page = bigint_page([1, 5, 3], [2, 5, 1])
+        for op, expect in [
+            ("lt", [True, False, False]),
+            ("le", [True, True, False]),
+            ("eq", [False, True, False]),
+            ("ne", [True, False, True]),
+            ("ge", [False, True, True]),
+            ("gt", [False, False, True]),
+        ]:
+            e = ir.call(op, ir.input_ref(0, T.BIGINT),
+                        ir.input_ref(1, T.BIGINT))
+            data, _ = dual_eval(e, page)
+            np.testing.assert_array_equal(data[:3], expect)
+
+    def test_mixed_type_cmp(self):
+        page = Page.from_arrays([[1, 2, 3]], [T.INTEGER])
+        e = ir.call("ge", ir.input_ref(0, T.INTEGER), ir.const(2, T.BIGINT))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:3], [False, True, True])
+
+    def test_decimal_cmp_mixed_scale(self):
+        a, b = T.DecimalType(10, 2), T.DecimalType(10, 4)
+        page = Page.from_arrays([[150, 120], [15000, 12345]], [a, b])
+        e = ir.call("eq", ir.input_ref(0, a), ir.input_ref(1, b))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:2], [True, False])
+
+    def test_string_eq_const(self):
+        page = Page.from_arrays([["A", "R", "N", "R"]], [T.VARCHAR])
+        e = ir.call("eq", ir.input_ref(0, T.VARCHAR),
+                    ir.const("R", T.VARCHAR))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:4], [False, True, False, True])
+
+    def test_string_cmp_order_with_missing_literal(self):
+        page = Page.from_arrays([["apple", "cherry", "beta"]], [T.VARCHAR])
+        e = ir.call("lt", ir.input_ref(0, T.VARCHAR),
+                    ir.const("banana", T.VARCHAR))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:3], [True, False, False])
+
+    def test_between(self):
+        page = bigint_page([1, 5, 10, 15])
+        e = ir.between(ir.input_ref(0, T.BIGINT), ir.const(5, T.BIGINT),
+                       ir.const(10, T.BIGINT))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:4], [False, True, True, False])
+
+    def test_in_list(self):
+        page = bigint_page([1, 2, 3, 4])
+        e = ir.in_(ir.input_ref(0, T.BIGINT), ir.const(2, T.BIGINT),
+                   ir.const(4, T.BIGINT))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:4], [False, True, False, True])
+
+
+class TestLogic:
+    def test_and_3vl(self):
+        page = Page.from_arrays(
+            [[True, True, False, None, None, False],
+             [True, None, None, None, True, False]],
+            [T.BOOLEAN, T.BOOLEAN],
+        )
+        e = ir.and_(ir.input_ref(0, T.BOOLEAN), ir.input_ref(1, T.BOOLEAN))
+        data, nulls = dual_eval(e, page)
+        # T&T=T, T&N=N, F&N=F, N&N=N, N&T=N, F&F=F
+        np.testing.assert_array_equal(
+            nulls[:6], [False, True, False, True, True, False]
+        )
+        np.testing.assert_array_equal(data[0], True)
+        np.testing.assert_array_equal(data[2], False)
+
+    def test_or_3vl(self):
+        page = Page.from_arrays(
+            [[True, False, None, None], [None, None, True, None]],
+            [T.BOOLEAN, T.BOOLEAN],
+        )
+        e = ir.or_(ir.input_ref(0, T.BOOLEAN), ir.input_ref(1, T.BOOLEAN))
+        data, nulls = dual_eval(e, page)
+        # T|N=T, F|N=N, N|T=T, N|N=N
+        np.testing.assert_array_equal(nulls[:4], [False, True, False, True])
+        assert data[0] and data[2]
+
+    def test_is_null_coalesce(self):
+        page = Page.from_arrays([[1, None, 3]], [T.BIGINT])
+        e = ir.is_null(ir.input_ref(0, T.BIGINT))
+        data, nulls = dual_eval(e, page)
+        assert nulls is None
+        np.testing.assert_array_equal(data[:3], [False, True, False])
+        e2 = ir.coalesce(ir.input_ref(0, T.BIGINT), ir.const(99, T.BIGINT))
+        data, nulls = dual_eval(e2, page)
+        np.testing.assert_array_equal(data[:3], [1, 99, 3])
+
+    def test_if_case(self):
+        page = bigint_page([1, 5, 10])
+        e = ir.if_(
+            ir.call("gt", ir.input_ref(0, T.BIGINT), ir.const(4, T.BIGINT)),
+            ir.const(1, T.BIGINT),
+            ir.const(0, T.BIGINT),
+        )
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:3], [0, 1, 1])
+
+    def test_switch_first_match_wins(self):
+        page = bigint_page([1, 5, 10])
+        e = ir.switch(
+            ir.call("ge", ir.input_ref(0, T.BIGINT), ir.const(10, T.BIGINT)),
+            ir.const(100, T.BIGINT),
+            ir.call("ge", ir.input_ref(0, T.BIGINT), ir.const(5, T.BIGINT)),
+            ir.const(50, T.BIGINT),
+            ir.const(0, T.BIGINT),
+        )
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:3], [0, 50, 100])
+
+
+class TestTemporal:
+    def test_extract_parts(self):
+        import datetime
+
+        dates = [
+            datetime.date(1994, 1, 1),
+            datetime.date(1998, 12, 31),
+            datetime.date(2000, 2, 29),
+            datetime.date(1970, 1, 1),
+        ]
+        days = [(d - datetime.date(1970, 1, 1)).days for d in dates]
+        page = Page.from_arrays([days], [T.DATE])
+        for part, expect in [
+            ("year", [1994, 1998, 2000, 1970]),
+            ("month", [1, 12, 2, 1]),
+            ("day", [1, 31, 29, 1]),
+            ("quarter", [1, 4, 1, 1]),
+        ]:
+            e = ir.call(part, ir.input_ref(0, T.DATE))
+            data, _ = dual_eval(e, page)
+            np.testing.assert_array_equal(data[:4], expect)
+
+    def test_date_interval_day_arith(self):
+        import datetime
+
+        epoch = datetime.date(1970, 1, 1)
+        d0 = (datetime.date(1998, 12, 1) - epoch).days
+        page = Page.from_arrays([[d0]], [T.DATE])
+        e = ir.call(
+            "subtract",
+            ir.input_ref(0, T.DATE),
+            ir.const(90 * 86_400_000_000, T.INTERVAL_DAY_TIME),
+        )
+        assert e.type == T.DATE
+        data, _ = dual_eval(e, page)
+        assert int(data[0]) == (datetime.date(1998, 9, 2) - epoch).days
+
+    def test_date_interval_month_clamps(self):
+        import datetime
+
+        epoch = datetime.date(1970, 1, 1)
+        d0 = (datetime.date(1995, 1, 31) - epoch).days
+        page = Page.from_arrays([[d0]], [T.DATE])
+        e = ir.call("add", ir.input_ref(0, T.DATE),
+                    ir.const(1, T.INTERVAL_YEAR_MONTH))
+        data, _ = dual_eval(e, page)
+        assert int(data[0]) == (datetime.date(1995, 2, 28) - epoch).days
+
+    def test_date_minus_date(self):
+        page = Page.from_arrays([[100], [40]], [T.DATE, T.DATE])
+        e = ir.call("subtract", ir.input_ref(0, T.DATE),
+                    ir.input_ref(1, T.DATE))
+        assert e.type == T.BIGINT
+        data, _ = dual_eval(e, page)
+        assert data[0] == 60
+
+
+class TestStrings:
+    def test_like(self):
+        page = Page.from_arrays(
+            [["PROMO BRUSHED", "STANDARD POLISHED", "PROMO PLATED"]],
+            [T.VARCHAR],
+        )
+        e = ir.call("like", ir.input_ref(0, T.VARCHAR),
+                    ir.const("PROMO%", T.VARCHAR))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:3], [True, False, True])
+
+    def test_like_underscore(self):
+        page = Page.from_arrays([["cat", "cut", "cart"]], [T.VARCHAR])
+        e = ir.call("like", ir.input_ref(0, T.VARCHAR),
+                    ir.const("c_t", T.VARCHAR))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:3], [True, True, False])
+
+    def test_substr_and_compare(self):
+        page = Page.from_arrays([["13-345", "31-999", "13-111"]], [T.VARCHAR])
+        sub = ir.call("substr", ir.input_ref(0, T.VARCHAR),
+                      ir.const(1, T.BIGINT), ir.const(2, T.BIGINT))
+        e = ir.call("eq", sub, ir.const("13", T.VARCHAR))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:3], [True, False, True])
+
+    def test_length_lower(self):
+        page = Page.from_arrays([["Abc", "XYZZY"]], [T.VARCHAR])
+        e = ir.call("length", ir.input_ref(0, T.VARCHAR))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:2], [3, 5])
+        e2 = ir.call(
+            "eq",
+            ir.call("lower", ir.input_ref(0, T.VARCHAR)),
+            ir.const("abc", T.VARCHAR),
+        )
+        data, _ = dual_eval(e2, page)
+        np.testing.assert_array_equal(data[:2], [True, False])
+
+
+class TestCastsAndMath:
+    def test_casts(self):
+        page = Page.from_arrays([[1, 2, 3]], [T.INTEGER])
+        e = ir.cast(ir.input_ref(0, T.INTEGER), T.DOUBLE)
+        data, _ = dual_eval(e, page)
+        assert data.dtype == np.float64
+        e2 = ir.cast(ir.input_ref(0, T.INTEGER), T.DecimalType(10, 2))
+        data, _ = dual_eval(e2, page)
+        np.testing.assert_array_equal(data[:3], [100, 200, 300])
+
+    def test_double_round_half_up_cast(self):
+        page = Page.from_arrays([[1.5, 2.5, -1.5, 0.4]], [T.DOUBLE])
+        e = ir.cast(ir.input_ref(0, T.DOUBLE), T.BIGINT)
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:4], [2, 3, -2, 0])
+
+    def test_round_sqrt(self):
+        page = Page.from_arrays([[2.4, 2.5, -2.5]], [T.DOUBLE])
+        e = ir.call("round", ir.input_ref(0, T.DOUBLE))
+        data, _ = dual_eval(e, page)
+        np.testing.assert_array_equal(data[:3], [2.0, 3.0, -3.0])
+        p2 = Page.from_arrays([[4.0, 9.0]], [T.DOUBLE])
+        e2 = ir.call("sqrt", ir.input_ref(0, T.DOUBLE))
+        data, _ = dual_eval(e2, p2)
+        np.testing.assert_array_equal(data[:2], [2.0, 3.0])
+
+
+class TestFilter:
+    def test_filter_q6_style(self):
+        # l_discount between 0.05 and 0.07 and l_quantity < 24
+        disc_t = T.DecimalType(12, 2)
+        page = Page.from_arrays(
+            [[5, 6, 8, 7], [1000, 3000, 1000, 1000]],
+            [disc_t, T.DecimalType(12, 2)],
+        )
+        pred = ir.and_(
+            ir.between(ir.input_ref(0, disc_t),
+                       ir.const(5, disc_t), ir.const(7, disc_t)),
+            ir.call("lt", ir.input_ref(1, T.DecimalType(12, 2)),
+                    ir.const(2400, T.DecimalType(12, 2))),
+        )
+
+        @jax.jit
+        def run(p):
+            return evaluate_filter(pred, p, jnp).valid
+
+        valid = np.asarray(run(page))
+        ov = evaluate_filter(pred, np_page(page), np).valid
+        np.testing.assert_array_equal(valid, np.asarray(ov))
+        np.testing.assert_array_equal(valid[:4], [True, False, False, True])
+
+    def test_filter_null_predicate_drops(self):
+        page = Page.from_arrays([[1, None, 3]], [T.BIGINT])
+        pred = ir.call("gt", ir.input_ref(0, T.BIGINT),
+                       ir.const(0, T.BIGINT))
+        ov = evaluate_filter(pred, np_page(page), np)
+        np.testing.assert_array_equal(np.asarray(ov.valid)[:3],
+                                      [True, False, True])
