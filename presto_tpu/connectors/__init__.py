@@ -1,0 +1,11 @@
+"""Connectors: pluggable table providers (reference: presto-spi
+ConnectorFactory / ConnectorMetadata / ConnectorSplitManager /
+ConnectorPageSourceProvider; modules presto-tpch, presto-memory,
+presto-blackhole). A connector here supplies schemas, row counts, and Pages;
+split streaming maps to chunked page generation over row ranges."""
+
+from presto_tpu.connectors.base import (  # noqa: F401
+    Connector,
+    ColumnSchema,
+    TableSchema,
+)

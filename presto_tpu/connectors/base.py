@@ -1,0 +1,92 @@
+"""Connector SPI.
+
+Reference: presto-spi spi/connector/* — ConnectorMetadata (schemas),
+ConnectorSplitManager (splits), ConnectorPageSourceProvider (pages). The TPU
+engine consumes the same three capabilities: describe tables, enumerate row
+ranges ("splits"), and produce columnar Pages for a range. Splits are
+(start_row, row_count) ranges so a table shards across a device mesh by
+simple range partitioning (reference analog: ConnectorSplit streaming to
+tasks via SourcePartitionedScheduler).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.page import Page
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    type: T.SqlType
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSchema:
+    name: str
+    columns: Tuple[ColumnSchema, ...]
+
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def column_index(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(f"no column {name!r} in table {self.name!r}")
+
+    def column_type(self, name: str) -> T.SqlType:
+        return self.columns[self.column_index(name)].type
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """A row range of a table (reference: spi/ConnectorSplit)."""
+
+    table: str
+    start_row: int
+    row_count: int
+
+
+class Connector:
+    """Reference: spi/connector/Connector + ConnectorMetadata."""
+
+    name: str = "connector"
+
+    def tables(self) -> List[str]:
+        raise NotImplementedError
+
+    def table_schema(self, table: str) -> TableSchema:
+        raise NotImplementedError
+
+    def row_count(self, table: str) -> int:
+        raise NotImplementedError
+
+    def splits(self, table: str, target_rows: int) -> List[Split]:
+        """Chop the table into row-range splits of ~target_rows each."""
+        total = self.row_count(table)
+        out = []
+        start = 0
+        while start < total:
+            n = min(target_rows, total - start)
+            out.append(Split(table, start, n))
+            start += n
+        return out or [Split(table, 0, 0)]
+
+    def page_for_split(
+        self, split: Split, columns: Optional[Sequence[str]] = None
+    ) -> Page:
+        raise NotImplementedError
+
+    def pages(
+        self,
+        table: str,
+        columns: Optional[Sequence[str]] = None,
+        target_rows: int = 1 << 20,
+    ) -> Iterator[Page]:
+        for split in self.splits(table, target_rows):
+            if split.row_count:
+                yield self.page_for_split(split, columns)

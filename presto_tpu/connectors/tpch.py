@@ -1,0 +1,789 @@
+"""TPC-H connector: deterministic on-device data generation.
+
+Reference: presto-tpch (TpchConnectorFactory/TpchMetadata/TpchRecordSet,
+backed by airlift's Java dbgen port) generates rows on the fly from the row
+index — no data files. We keep that killer property and push it further
+(SURVEY §8.2.6): every column is a pure function of the global row index,
+computed *on device* as a vectorized jax program, so "scan" is "generate" in
+HBM and a table shards across a mesh by sharding an iota. Generation is
+column-pruned (only requested columns are computed) and jit-compiled per
+(table, chunk size, column set).
+
+Determinism & fidelity: structural formulas follow the TPC-H spec / dbgen
+semantics exactly where they matter for query behavior —
+  - cardinalities (customer 150k·SF, orders 10/customer, 1–7 lineitems,
+    partsupp 4/part), sparse orderkeys ((i/8)*32 + i%8 + 1), the
+    skip-every-3rd-customer rule for o_custkey,
+  - p_retailprice(pk) and l_extendedprice = qty * retailprice(partkey),
+    ps_suppkey(pk, i) = (pk + i*(S/4 + (pk-1)/S)) % S + 1 (join-consistent
+    across tables), o_totalprice as the exact decimal sum over lineitems,
+  - date windows (orderdate 1992-01-01..1998-08-02, ship/commit/receipt
+    offsets), returnflag/linestatus derived from CURRENTDATE 1995-06-17,
+  - value pools (segments, priorities, ship modes, brands/types/containers,
+    the 25 nations / 5 regions and their mapping).
+The *randomness* differs: dbgen's per-column Lehmer LCG streams are replaced
+by counter-based xxhash64 streams keyed on (table.column, row key). Row
+values are therefore deterministic and chunk-independent but not bit-equal
+to C dbgen, and free-text fields (names/addresses/comments/phones) draw from
+bounded word pools so they stay dictionary-encodable on device. Result
+checksums are validated against an independent SQL oracle over the *same*
+data (tests run sqlite3), not against dbgen answer sets — documented
+divergence from the reference.
+"""
+
+from __future__ import annotations
+
+import datetime
+import functools
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import ColumnSchema, Connector, Split, TableSchema
+from presto_tpu.ops.hashing import xxhash64_u64
+from presto_tpu.page import Block, Dictionary, Page
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _days(y: int, m: int, d: int) -> int:
+    return (datetime.date(y, m, d) - _EPOCH).days
+
+
+STARTDATE = _days(1992, 1, 1)
+ENDDATE = _days(1998, 12, 31)
+CURRENTDATE = _days(1995, 6, 17)
+ORDERDATE_MAX = ENDDATE - 151
+
+DEC = T.DecimalType(12, 2)
+
+MAX_LINES_PER_ORDER = 7
+
+
+class PatternDictionary(Dictionary):
+    """Virtual dictionary for formatted key strings ('Customer#%09d') —
+    decodes lazily so 'Supplier#000000042'-style columns never materialize
+    15M strings at SF100 (reference analog: dbgen formats these on the fly).
+    Code i maps to value prefix + zero-padded (i + offset); zero-padding
+    makes lexicographic order equal numeric order, so sort_rank is the
+    identity."""
+
+    def __init__(self, prefix: str, count: int, offset: int = 1,
+                 width: int = 9):
+        self.prefix = prefix
+        self.count = count
+        self.offset = offset
+        self.width = width
+        self._materialized = None
+        self._hash = hash(("pattern", prefix, count, offset, width))
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, PatternDictionary)
+            and (self.prefix, self.count, self.offset, self.width)
+            == (other.prefix, other.count, other.offset, other.width)
+        )
+
+    @property
+    def values(self) -> np.ndarray:
+        if self._materialized is None:
+            self._materialized = np.array(
+                [self.prefix + str(i + self.offset).zfill(self.width)
+                 for i in range(self.count)],
+                dtype=object,
+            )
+        return self._materialized
+
+    @property
+    def _index(self):
+        return _PatternIndex(self)
+
+    def code_of(self, value) -> int:
+        try:
+            s = str(value)
+            if not s.startswith(self.prefix):
+                return -1
+            i = int(s[len(self.prefix):]) - self.offset
+            return i if 0 <= i < self.count else -1
+        except (ValueError, TypeError):
+            return -1
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        out = np.empty(codes.shape, dtype=object)
+        flat = codes.reshape(-1)
+        res = out.reshape(-1)
+        for j, c in enumerate(flat):
+            c = int(c)
+            if 0 <= c < self.count:
+                res[j] = self.prefix + str(c + self.offset).zfill(self.width)
+            else:
+                res[j] = None
+        return out
+
+    def sort_rank(self) -> np.ndarray:
+        return np.arange(self.count, dtype=np.int32)
+
+
+class _PatternIndex:
+    """Mapping-protocol shim so code paths touching dictionary._index keep
+    working against PatternDictionary without materialization."""
+
+    def __init__(self, d: PatternDictionary):
+        self._d = d
+
+    def get(self, value, default=None):
+        c = self._d.code_of(value)
+        return default if c < 0 else c
+
+    def __contains__(self, value):
+        return self._d.code_of(value) >= 0
+
+
+# ------------------------------------------------------------- value pools
+
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIP_MODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+SHIP_INSTRUCT = ["COLLECT COD", "DELIVER IN PERSON", "NONE",
+                 "TAKE BACK RETURN"]
+TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINER_S1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINER_S2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+
+COLORS = (
+    "almond antique aquamarine azure beige bisque black blanched blue blush "
+    "brown burlywood burnished chartreuse chiffon chocolate coral cornflower "
+    "cornsilk cream cyan dark deep dim dodger drab firebrick floral forest "
+    "frosted gainsboro ghost goldenrod green grey honeydew hot indian ivory "
+    "khaki lace lavender lawn lemon light lime linen magenta maroon medium "
+    "metallic midnight mint misty moccasin navajo navy olive orange orchid "
+    "pale papaya peach peru pink plum powder puff purple red rose rosy royal "
+    "saddle salmon sandy seashell sienna sky slate smoke snow spring steel "
+    "tan thistle tomato turquoise violet wheat white yellow"
+).split()
+
+_COMMENT_A = ("carefully quickly furiously slyly blithely fluffily ruthlessly"
+              " boldly daringly evenly silently finally ironically sometimes"
+              " never always rarely closely").split()
+_COMMENT_B = ("special pending final ironic express regular unusual bold even"
+              " silent quick careful idle busy").split()
+_COMMENT_C = ("requests deposits accounts packages instructions foxes ideas"
+              " theodolites pinto beans dependencies excuses platelets"
+              " asymptotes courts dolphins").split()
+
+
+def _lcg_words(n_entries: int, seed: int, pools: List[List[str]]) -> List[str]:
+    """Deterministic host-side word-combination strings (comment pools)."""
+    state = seed & 0x7FFFFFFF or 1
+    out = []
+    for _ in range(n_entries):
+        words = []
+        for pool in pools:
+            state = (state * 48271) % 2147483647
+            words.append(pool[state % len(pool)])
+        out.append(" ".join(words))
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _comment_dictionary(n_entries: int, seed: int) -> Dictionary:
+    return Dictionary(
+        _lcg_words(n_entries, seed,
+                   [_COMMENT_A, _COMMENT_B, _COMMENT_C, _COMMENT_A,
+                    _COMMENT_C])
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _pname_dictionary(n_entries: int = 4096) -> Dictionary:
+    state = 7919
+    out = []
+    for _ in range(n_entries):
+        words = []
+        for _ in range(5):
+            state = (state * 48271) % 2147483647
+            w = COLORS[state % len(COLORS)]
+            if w not in words:
+                words.append(w)
+        out.append(" ".join(words))
+    return Dictionary(out)
+
+
+@functools.lru_cache(maxsize=None)
+def _type_dictionary() -> Dictionary:
+    return Dictionary(
+        [f"{a} {b} {c}" for a in TYPE_S1 for b in TYPE_S2 for c in TYPE_S3]
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _container_dictionary() -> Dictionary:
+    return Dictionary(
+        [f"{a} {b}" for a in CONTAINER_S1 for b in CONTAINER_S2]
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _brand_dictionary() -> Dictionary:
+    return Dictionary(
+        [f"Brand#{m}{n}" for m in range(1, 6) for n in range(1, 6)]
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _mfgr_dictionary() -> Dictionary:
+    return Dictionary([f"Manufacturer#{m}" for m in range(1, 6)])
+
+
+_PHONE_LOCALS = 400
+
+
+@functools.lru_cache(maxsize=None)
+def _phone_dictionary() -> Dictionary:
+    """code = nation_code*_PHONE_LOCALS + local; country code nation+10."""
+    state = 104729
+    vals = []
+    for nation in range(25):
+        cc = nation + 10
+        st = state + nation
+        for _ in range(_PHONE_LOCALS):
+            st = (st * 48271) % 2147483647
+            a = 100 + st % 900
+            st = (st * 48271) % 2147483647
+            b = 100 + st % 900
+            st = (st * 48271) % 2147483647
+            c = 1000 + st % 9000
+            vals.append(f"{cc}-{a}-{b}-{c}")
+    return Dictionary(vals)
+
+
+@functools.lru_cache(maxsize=None)
+def _address_dictionary(n_entries: int = 1024) -> Dictionary:
+    state = 50021
+    vals = []
+    alphabet = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789 ,"
+    for _ in range(n_entries):
+        state = (state * 48271) % 2147483647
+        ln = 10 + state % 25
+        chars = []
+        st = state
+        for _ in range(ln):
+            st = (st * 48271) % 2147483647
+            chars.append(alphabet[st % len(alphabet)])
+        vals.append("".join(chars))
+    return Dictionary(vals)
+
+
+# --------------------------------------------------------- random streams
+
+def _stream_seed(table: str, column: str) -> int:
+    return zlib.crc32(f"tpch.{table}.{column}".encode())
+
+
+def _draw(keys: jnp.ndarray, table: str, column: str) -> jnp.ndarray:
+    """uint64 stream value per key, independent per (table, column)."""
+    return xxhash64_u64(
+        keys.astype(jnp.uint64), seed=_stream_seed(table, column)
+    )
+
+
+def _unif(keys, table, column, lo: int, hi: int) -> jnp.ndarray:
+    """Uniform int64 in [lo, hi] keyed by row key (chunk-independent)."""
+    h = _draw(keys, table, column)
+    span = jnp.uint64(hi - lo + 1)
+    return (h % span).astype(jnp.int64) + jnp.int64(lo)
+
+
+class _Lazy:
+    """Column-pruned generation: entries are thunks evaluated only for the
+    requested column set (a traced no-op for the rest)."""
+
+    def __init__(self):
+        self._thunks: Dict[str, object] = {}
+        self._memo: Dict[str, object] = {}
+
+    def put(self, name: str, thunk):
+        self._thunks[name] = thunk
+
+    def get(self, name: str):
+        if name not in self._memo:
+            self._memo[name] = self._thunks[name]()
+        return self._memo[name]
+
+
+# ------------------------------------------------------------- connector
+
+
+class TpchConnector(Connector):
+    """Reference: presto-tpch TpchConnectorFactory — schema name carries the
+    scale factor (catalog.sf1.lineitem)."""
+
+    name = "tpch"
+
+    def __init__(self, scale: float = 1.0):
+        self.scale = scale
+        self.n_customer = max(int(150_000 * scale), 3)
+        self.n_orders = self.n_customer * 10
+        self.n_part = max(int(200_000 * scale), 4)
+        self.n_supplier = max(int(10_000 * scale), 4)
+        self.n_partsupp = self.n_part * 4
+        self.n_clerk = max(int(1000 * scale), 10)
+        self._schemas = _build_schemas()
+        self._gen_cache: Dict = {}
+        self._dicts = self._build_dictionaries()
+
+    def _build_dictionaries(self) -> Dict[str, Dict[str, Dictionary]]:
+        return {
+            "region": {
+                "r_name": Dictionary(REGIONS),
+                "r_comment": _comment_dictionary(512, 11),
+            },
+            "nation": {
+                "n_name": Dictionary([nm for nm, _ in NATIONS]),
+                "n_comment": _comment_dictionary(512, 13),
+            },
+            "part": {
+                "p_name": _pname_dictionary(),
+                "p_mfgr": _mfgr_dictionary(),
+                "p_brand": _brand_dictionary(),
+                "p_type": _type_dictionary(),
+                "p_container": _container_dictionary(),
+                "p_comment": _comment_dictionary(2048, 17),
+            },
+            "supplier": {
+                "s_name": PatternDictionary("Supplier#", self.n_supplier),
+                "s_address": _address_dictionary(),
+                "s_phone": _phone_dictionary(),
+                "s_comment": _comment_dictionary(2048, 19),
+            },
+            "partsupp": {
+                "ps_comment": _comment_dictionary(2048, 23),
+            },
+            "customer": {
+                "c_name": PatternDictionary("Customer#", self.n_customer),
+                "c_address": _address_dictionary(),
+                "c_phone": _phone_dictionary(),
+                "c_mktsegment": Dictionary(SEGMENTS),
+                "c_comment": _comment_dictionary(4096, 29),
+            },
+            "orders": {
+                "o_orderstatus": Dictionary(["F", "O", "P"]),
+                "o_orderpriority": Dictionary(PRIORITIES),
+                "o_clerk": PatternDictionary("Clerk#", self.n_clerk),
+                "o_comment": _comment_dictionary(8192, 31),
+            },
+            "lineitem": {
+                "l_returnflag": Dictionary(["A", "R", "N"]),
+                "l_linestatus": Dictionary(["F", "O"]),
+                "l_shipinstruct": Dictionary(SHIP_INSTRUCT),
+                "l_shipmode": Dictionary(SHIP_MODES),
+                "l_comment": _comment_dictionary(8192, 37),
+            },
+        }
+
+    # ------------------------------------------------------------ metadata
+    def tables(self) -> List[str]:
+        return list(self._schemas)
+
+    def table_schema(self, table: str) -> TableSchema:
+        try:
+            return self._schemas[table]
+        except KeyError:
+            raise KeyError(f"tpch has no table {table!r}")
+
+    def row_count(self, table: str) -> int:
+        """Slot count for split planning. For lineitem this is the padded
+        slot capacity (orders x 7); true cardinality arrives via page
+        validity masks — the engine's native representation."""
+        return {
+            "region": 5,
+            "nation": 25,
+            "part": self.n_part,
+            "supplier": self.n_supplier,
+            "partsupp": self.n_partsupp,
+            "customer": self.n_customer,
+            "orders": self.n_orders,
+            "lineitem": self.n_orders * MAX_LINES_PER_ORDER,
+        }[table]
+
+    def splits(self, table: str, target_rows: int) -> List[Split]:
+        if table == "lineitem":
+            # align split boundaries to whole orders (7 slots)
+            target_rows = max(
+                (target_rows // MAX_LINES_PER_ORDER) * MAX_LINES_PER_ORDER,
+                MAX_LINES_PER_ORDER,
+            )
+        return super().splits(table, target_rows)
+
+    # ----------------------------------------------------------- generation
+    def page_for_split(
+        self, split: Split, columns: Optional[Sequence[str]] = None
+    ) -> Page:
+        schema = self.table_schema(split.table)
+        names = tuple(columns) if columns is not None else tuple(
+            schema.column_names()
+        )
+        fn = self._compiled_gen(split.table, split.row_count, names)
+        datas, valid = fn(jnp.int64(split.start_row))
+        dicts = self._dicts.get(split.table, {})
+        blocks = []
+        for nm, data in zip(names, datas):
+            blocks.append(
+                Block(
+                    data=data,
+                    type=schema.column_type(nm),
+                    nulls=None,
+                    dictionary=dicts.get(nm),
+                )
+            )
+        return Page(blocks=tuple(blocks), valid=valid)
+
+    def _compiled_gen(self, table: str, n: int, names: tuple):
+        """jit-compiled, column-pruned chunk generator. start_row is a
+        traced argument so one compilation serves every chunk of the table
+        (reference analog: TpchRecordSet cursors parameterized by split)."""
+        key = (table, n, names)
+        if key not in self._gen_cache:
+            gen = getattr(self, f"_gen_{table}")
+
+            def fn(start):
+                lazy = gen(start, n)
+                return (
+                    tuple(lazy.get(nm) for nm in names),
+                    lazy.get("__valid__"),
+                )
+
+            self._gen_cache[key] = jax.jit(fn)
+        return self._gen_cache[key]
+
+    # ---- per-table generators: return a _Lazy of column thunks over
+    # traced global row keys. All values are pure functions of row keys.
+
+    def _gen_region(self, start, n: int) -> _Lazy:
+        idx = start + jnp.arange(n, dtype=jnp.int64)
+        lz = _Lazy()
+        lz.put("r_regionkey", lambda: idx)
+        lz.put("r_name", lambda: idx.astype(jnp.int32))
+        lz.put("r_comment", lambda: _unif(
+            idx, "region", "comment", 0, 511).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_nation(self, start, n: int) -> _Lazy:
+        idx = start + jnp.arange(n, dtype=jnp.int64)
+        region_map = jnp.asarray(
+            np.array([r for _, r in NATIONS], dtype=np.int64)
+        )
+        lz = _Lazy()
+        lz.put("n_nationkey", lambda: idx)
+        lz.put("n_name", lambda: idx.astype(jnp.int32))
+        lz.put("n_regionkey", lambda: region_map[jnp.clip(idx, 0, 24)])
+        lz.put("n_comment", lambda: _unif(
+            idx, "nation", "comment", 0, 511).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    @staticmethod
+    def _retail_price_cents(partkey: jnp.ndarray) -> jnp.ndarray:
+        """Spec 4.2.3: (90000 + ((pk/10) mod 20001) + 100*(pk mod 1000))."""
+        pk = partkey.astype(jnp.int64)
+        return (
+            jnp.int64(90000)
+            + (pk // 10) % jnp.int64(20001)
+            + jnp.int64(100) * (pk % jnp.int64(1000))
+        )
+
+    def _gen_part(self, start, n: int) -> _Lazy:
+        pk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        lz = _Lazy()
+        lz.put("p_partkey", lambda: pk)
+        lz.put("p_name", lambda: _unif(
+            pk, "part", "name", 0, len(_pname_dictionary()) - 1
+        ).astype(jnp.int32))
+        lz.put("p_mfgr", lambda: _unif(pk, "part", "mfgr", 0, 4)
+               .astype(jnp.int32))
+        lz.put("p_brand", lambda: (
+            _unif(pk, "part", "mfgr", 0, 4) * 5
+            + _unif(pk, "part", "brand", 0, 4)
+        ).astype(jnp.int32))
+        lz.put("p_type", lambda: _unif(
+            pk, "part", "type", 0, len(_type_dictionary()) - 1
+        ).astype(jnp.int32))
+        lz.put("p_size", lambda: _unif(pk, "part", "size", 1, 50)
+               .astype(jnp.int32))
+        lz.put("p_container", lambda: _unif(
+            pk, "part", "container", 0, len(_container_dictionary()) - 1
+        ).astype(jnp.int32))
+        lz.put("p_retailprice", lambda: self._retail_price_cents(pk))
+        lz.put("p_comment", lambda: _unif(
+            pk, "part", "comment", 0, 2047).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_supplier(self, start, n: int) -> _Lazy:
+        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        lz = _Lazy()
+        nation = lambda: _unif(sk, "supplier", "nationkey", 0, 24)  # noqa
+        lz.put("s_suppkey", lambda: sk)
+        lz.put("s_name", lambda: (sk - 1).astype(jnp.int32))
+        lz.put("s_address", lambda: _unif(
+            sk, "supplier", "address", 0, 1023).astype(jnp.int32))
+        lz.put("s_nationkey", nation)
+        lz.put("s_phone", lambda: (
+            nation() * _PHONE_LOCALS
+            + _unif(sk, "supplier", "phone", 0, _PHONE_LOCALS - 1)
+        ).astype(jnp.int32))
+        lz.put("s_acctbal", lambda: _unif(
+            sk, "supplier", "acctbal", -99_999, 999_999))
+        lz.put("s_comment", lambda: _unif(
+            sk, "supplier", "comment", 0, 2047).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _ps_suppkey(self, pk: jnp.ndarray, i: jnp.ndarray) -> jnp.ndarray:
+        """Spec 4.2.3 partsupp supplier spread (join-consistent)."""
+        S = jnp.int64(self.n_supplier)
+        return (pk + i * (S // 4 + (pk - 1) // S)) % S + 1
+
+    def _gen_partsupp(self, start, n: int) -> _Lazy:
+        idx = start + jnp.arange(n, dtype=jnp.int64)
+        pk = idx // 4 + 1
+        i = idx % 4
+        key = pk * 4 + i
+        lz = _Lazy()
+        lz.put("ps_partkey", lambda: pk)
+        lz.put("ps_suppkey", lambda: self._ps_suppkey(pk, i))
+        lz.put("ps_availqty", lambda: _unif(
+            key, "partsupp", "availqty", 1, 9999).astype(jnp.int32))
+        lz.put("ps_supplycost", lambda: _unif(
+            key, "partsupp", "supplycost", 100, 100_000))
+        lz.put("ps_comment", lambda: _unif(
+            key, "partsupp", "comment", 0, 2047).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_customer(self, start, n: int) -> _Lazy:
+        ck = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        nation = lambda: _unif(ck, "customer", "nationkey", 0, 24)  # noqa
+        lz = _Lazy()
+        lz.put("c_custkey", lambda: ck)
+        lz.put("c_name", lambda: (ck - 1).astype(jnp.int32))
+        lz.put("c_address", lambda: _unif(
+            ck, "customer", "address", 0, 1023).astype(jnp.int32))
+        lz.put("c_nationkey", nation)
+        lz.put("c_phone", lambda: (
+            nation() * _PHONE_LOCALS
+            + _unif(ck, "customer", "phone", 0, _PHONE_LOCALS - 1)
+        ).astype(jnp.int32))
+        lz.put("c_acctbal", lambda: _unif(
+            ck, "customer", "acctbal", -99_999, 999_999))
+        lz.put("c_mktsegment", lambda: _unif(
+            ck, "customer", "mktsegment", 0, 4).astype(jnp.int32))
+        lz.put("c_comment", lambda: _unif(
+            ck, "customer", "comment", 0, 4095).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    # ---- orders + lineitem share per-order line computations
+
+    @staticmethod
+    def _orderkey(order_idx: jnp.ndarray) -> jnp.ndarray:
+        """Sparse keys, 8 used per 32 (spec 4.2.3 / dbgen mk_sparse)."""
+        return (order_idx // 8) * 32 + order_idx % 8 + 1
+
+    def _order_custkey(self, okey: jnp.ndarray) -> jnp.ndarray:
+        """Customers whose key % 3 == 0 place no orders (dbgen rule)."""
+        n_active = (self.n_customer // 3) * 2
+        j = _unif(okey, "orders", "custkey", 0, max(n_active - 1, 0))
+        return 3 * (j // 2) + j % 2 + 1
+
+    def _order_date(self, okey: jnp.ndarray) -> jnp.ndarray:
+        return _unif(okey, "orders", "orderdate", STARTDATE, ORDERDATE_MAX)
+
+    def _lines_per_order(self, okey: jnp.ndarray) -> jnp.ndarray:
+        return _unif(okey, "lineitem", "count", 1, MAX_LINES_PER_ORDER)
+
+    def _line_values(self, okey: jnp.ndarray, line: jnp.ndarray):
+        """Per-(order, line) column values; key mixes okey and line number."""
+        key = okey * jnp.int64(MAX_LINES_PER_ORDER + 1) + line
+        qty = _unif(key, "lineitem", "quantity", 1, 50)
+        pk = _unif(key, "lineitem", "partkey", 1, self.n_part)
+        supp_i = _unif(key, "lineitem", "suppi", 0, 3)
+        disc = _unif(key, "lineitem", "discount", 0, 10)
+        tax = _unif(key, "lineitem", "tax", 0, 8)
+        odate = self._order_date(okey)
+        ship = odate + _unif(key, "lineitem", "shipdate", 1, 121)
+        commit = odate + _unif(key, "lineitem", "commitdate", 30, 90)
+        receipt = ship + _unif(key, "lineitem", "receiptdate", 1, 30)
+        ext = qty * self._retail_price_cents(pk)  # decimal(12,2) cents
+        # charge per line at cents precision, round-half-up
+        gross = ext * (jnp.int64(100) - disc) * (jnp.int64(100) + tax)
+        charge = (gross + jnp.int64(5000)) // jnp.int64(10_000)
+        return dict(
+            key=key, qty=qty, pk=pk, supp_i=supp_i, disc=disc, tax=tax,
+            odate=odate, ship=ship, commit=commit, receipt=receipt, ext=ext,
+            charge=charge,
+        )
+
+    def _gen_orders(self, start, n: int) -> _Lazy:
+        oidx = start + jnp.arange(n, dtype=jnp.int64)
+        okey = self._orderkey(oidx)
+        lz = _Lazy()
+
+        @functools.lru_cache(maxsize=1)
+        def line_matrix():
+            # [n, 7] per-line values for totalprice/orderstatus
+            line = jnp.arange(1, MAX_LINES_PER_ORDER + 1, dtype=jnp.int64)
+            lv = self._line_values(
+                jnp.broadcast_to(okey[:, None], (n, MAX_LINES_PER_ORDER)),
+                jnp.broadcast_to(line[None, :], (n, MAX_LINES_PER_ORDER)),
+            )
+            nlines = self._lines_per_order(okey)
+            live = line[None, :] <= nlines[:, None]
+            return lv, live
+
+        def totalprice():
+            lv, live = line_matrix()
+            return jnp.sum(jnp.where(live, lv["charge"], 0), axis=1)
+
+        def orderstatus():
+            lv, live = line_matrix()
+            shipped = lv["ship"] > CURRENTDATE  # linestatus 'O'
+            all_o = jnp.all(shipped | ~live, axis=1)
+            all_f = jnp.all(~shipped | ~live, axis=1)
+            return jnp.where(all_f, 0, jnp.where(all_o, 1, 2)).astype(
+                jnp.int32
+            )
+
+        lz.put("o_orderkey", lambda: okey)
+        lz.put("o_custkey", lambda: self._order_custkey(okey))
+        lz.put("o_orderstatus", orderstatus)
+        lz.put("o_totalprice", totalprice)
+        lz.put("o_orderdate",
+               lambda: self._order_date(okey).astype(jnp.int32))
+        lz.put("o_orderpriority", lambda: _unif(
+            okey, "orders", "priority", 0, 4).astype(jnp.int32))
+        lz.put("o_clerk", lambda: _unif(
+            okey, "orders", "clerk", 0, self.n_clerk - 1).astype(jnp.int32))
+        lz.put("o_shippriority", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("o_comment", lambda: _unif(
+            okey, "orders", "comment", 0, 8191).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_lineitem(self, start, n: int) -> _Lazy:
+        slot = start + jnp.arange(n, dtype=jnp.int64)
+        oidx = slot // MAX_LINES_PER_ORDER
+        line = slot % MAX_LINES_PER_ORDER + 1
+        okey = self._orderkey(oidx)
+        lz = _Lazy()
+
+        @functools.lru_cache(maxsize=1)
+        def lv():
+            return self._line_values(okey, line)
+
+        lz.put("l_orderkey", lambda: okey)
+        lz.put("l_partkey", lambda: lv()["pk"])
+        lz.put("l_suppkey",
+               lambda: self._ps_suppkey(lv()["pk"], lv()["supp_i"]))
+        lz.put("l_linenumber", lambda: line.astype(jnp.int32))
+        lz.put("l_quantity", lambda: lv()["qty"] * jnp.int64(100))
+        lz.put("l_extendedprice", lambda: lv()["ext"])
+        lz.put("l_discount", lambda: lv()["disc"])
+        lz.put("l_tax", lambda: lv()["tax"])
+        lz.put("l_returnflag", lambda: jnp.where(
+            lv()["receipt"] <= CURRENTDATE,
+            _unif(lv()["key"], "lineitem", "rflag", 0, 1),
+            2,
+        ).astype(jnp.int32))
+        lz.put("l_linestatus",
+               lambda: (lv()["ship"] > CURRENTDATE).astype(jnp.int32))
+        lz.put("l_shipdate", lambda: lv()["ship"].astype(jnp.int32))
+        lz.put("l_commitdate", lambda: lv()["commit"].astype(jnp.int32))
+        lz.put("l_receiptdate", lambda: lv()["receipt"].astype(jnp.int32))
+        lz.put("l_shipinstruct", lambda: _unif(
+            lv()["key"], "lineitem", "shipinstruct", 0, 3).astype(jnp.int32))
+        lz.put("l_shipmode", lambda: _unif(
+            lv()["key"], "lineitem", "shipmode", 0, 6).astype(jnp.int32))
+        lz.put("l_comment", lambda: _unif(
+            lv()["key"], "lineitem", "comment", 0, 8191).astype(jnp.int32))
+        lz.put("__valid__", lambda: line <= self._lines_per_order(okey))
+        return lz
+
+    # ------------------------------------------------------------ host IO
+    def host_rows(self, table: str, target_rows: int = 1 << 20):
+        """Materialize a table as Python row tuples (oracle loading)."""
+        out = []
+        for page in self.pages(table, target_rows=target_rows):
+            out.extend(page.to_pylist())
+        return out
+
+
+def _build_schemas() -> Dict[str, TableSchema]:
+    V = T.VARCHAR
+
+    def tbl(name, *cols):
+        return TableSchema(
+            name, tuple(ColumnSchema(n, t) for n, t in cols)
+        )
+
+    return {
+        s.name: s
+        for s in [
+            tbl("region", ("r_regionkey", T.BIGINT), ("r_name", V),
+                ("r_comment", V)),
+            tbl("nation", ("n_nationkey", T.BIGINT), ("n_name", V),
+                ("n_regionkey", T.BIGINT), ("n_comment", V)),
+            tbl("part", ("p_partkey", T.BIGINT), ("p_name", V),
+                ("p_mfgr", V), ("p_brand", V), ("p_type", V),
+                ("p_size", T.INTEGER), ("p_container", V),
+                ("p_retailprice", DEC), ("p_comment", V)),
+            tbl("supplier", ("s_suppkey", T.BIGINT), ("s_name", V),
+                ("s_address", V), ("s_nationkey", T.BIGINT),
+                ("s_phone", V), ("s_acctbal", DEC), ("s_comment", V)),
+            tbl("partsupp", ("ps_partkey", T.BIGINT),
+                ("ps_suppkey", T.BIGINT), ("ps_availqty", T.INTEGER),
+                ("ps_supplycost", DEC), ("ps_comment", V)),
+            tbl("customer", ("c_custkey", T.BIGINT), ("c_name", V),
+                ("c_address", V), ("c_nationkey", T.BIGINT),
+                ("c_phone", V), ("c_acctbal", DEC), ("c_mktsegment", V),
+                ("c_comment", V)),
+            tbl("orders", ("o_orderkey", T.BIGINT), ("o_custkey", T.BIGINT),
+                ("o_orderstatus", V), ("o_totalprice", DEC),
+                ("o_orderdate", T.DATE), ("o_orderpriority", V),
+                ("o_clerk", V), ("o_shippriority", T.INTEGER),
+                ("o_comment", V)),
+            tbl("lineitem", ("l_orderkey", T.BIGINT),
+                ("l_partkey", T.BIGINT), ("l_suppkey", T.BIGINT),
+                ("l_linenumber", T.INTEGER), ("l_quantity", DEC),
+                ("l_extendedprice", DEC), ("l_discount", DEC),
+                ("l_tax", DEC), ("l_returnflag", T.VarcharType(1)),
+                ("l_linestatus", T.VarcharType(1)),
+                ("l_shipdate", T.DATE), ("l_commitdate", T.DATE),
+                ("l_receiptdate", T.DATE), ("l_shipinstruct", V),
+                ("l_shipmode", V), ("l_comment", V)),
+        ]
+    }

@@ -1,0 +1,26 @@
+"""Execution layer: physical plans interpreted as chains of jitted
+page-at-a-time kernels.
+
+Reference: presto-main sql/planner/LocalExecutionPlanner.java turns a plan
+fragment into DriverFactory pipelines of Operators; operator/Driver.java
+moves Pages between them. Here the "driver loop" is Python host code making
+control decisions (capacity retries, partial-aggregation flushes, build-side
+sizing) *between* statically-shaped jitted kernels — XLA program order
+replaces the needsInput()/addInput()/getOutput() protocol inside a stage.
+"""
+
+from presto_tpu.exec.plan import (  # noqa: F401
+    AggSpec,
+    Aggregation,
+    Filter,
+    HashJoin,
+    Limit,
+    Output,
+    PhysicalNode,
+    Project,
+    Sort,
+    TableScan,
+    TopN,
+    Values,
+)
+from presto_tpu.exec.executor import Executor  # noqa: F401

@@ -1,0 +1,154 @@
+"""Aggregate state layouts: decompose SQL aggregates into the primitive
+segmented reductions of presto_tpu.ops.agg, with exact wide-decimal sums.
+
+Reference: presto-main operator/aggregation/* — each @AggregationFunction
+declares state / input / combine / output; e.g. avg = (sum, count) state with
+a divide on output, decimal sums carry 128-bit state
+(DecimalSumAggregation + UnscaledDecimal128Arithmetic). The TPU translation
+of 128-bit state: split each unscaled i64 into (v >> 32, v & 0xFFFFFFFF) and
+segment-sum the halves separately — each half-sum stays exact in i64 up to
+2^31 rows per group, and hi*2^32 + lo reconstructs the exact 128-bit total,
+emitted as a long-decimal limb Block (base-2^64 two's complement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.ops import agg as A
+from presto_tpu.page import Block
+
+_MASK32 = jnp.int64(0xFFFFFFFF)
+_U64_SIGN = jnp.uint64(0x8000000000000000)
+
+
+@dataclasses.dataclass(frozen=True)
+class StateCol:
+    """One physical state column: which primitive reduction builds it from
+    raw input, and which merges two partial states of it."""
+
+    suffix: str
+    input_kind: str  # ops.agg kind applied to raw input
+    merge_kind: str  # ops.agg kind applied when combining partials
+    type: T.SqlType
+    # transform applied to the raw input column before reduction
+    pre: Optional[str] = None  # None | 'hi32' | 'lo32'
+
+
+def state_layout(function: str, in_type: Optional[T.SqlType]) -> List[StateCol]:
+    """State columns for an aggregate over an input type (reference analog:
+    the generated GroupedAccumulator field layout)."""
+    if function == "count_star":
+        return [StateCol("count", A.COUNT_STAR, A.SUM, T.BIGINT)]
+    if function == "count":
+        return [StateCol("count", A.COUNT, A.SUM, T.BIGINT)]
+    if function in ("min", "max"):
+        kind = A.MIN if function == "min" else A.MAX
+        return [StateCol("value", kind, kind, in_type)]
+    if function == "any":
+        return [StateCol("value", A.ANY, A.ANY, in_type)]
+    if function == "bool_or":
+        return [StateCol("value", A.BOOL_OR, A.BOOL_OR, T.BOOLEAN)]
+    if function == "bool_and":
+        return [StateCol("value", A.BOOL_AND, A.BOOL_AND, T.BOOLEAN)]
+    if function == "sum":
+        if isinstance(in_type, T.DecimalType):
+            return [
+                StateCol("hi", A.SUM, A.SUM, T.BIGINT, pre="hi32"),
+                StateCol("lo", A.SUM, A.SUM, T.BIGINT, pre="lo32"),
+            ]
+        if T.is_floating(in_type):
+            return [StateCol("sum", A.SUM, A.SUM, T.DOUBLE)]
+        return [StateCol("sum", A.SUM, A.SUM, T.BIGINT)]
+    if function == "avg":
+        return state_layout("sum", in_type) + state_layout("count", in_type)
+    raise ValueError(f"unknown aggregate function: {function}")
+
+
+def result_type(function: str, in_type: Optional[T.SqlType]) -> T.SqlType:
+    """Reference: FunctionRegistry aggregate signatures — sum(bigint)->
+    bigint, sum(decimal(p,s))->decimal(38,s), avg(decimal(p,s))->
+    decimal(p,s), count->bigint."""
+    if function in ("count", "count_star"):
+        return T.BIGINT
+    if function in ("min", "max", "any"):
+        return in_type
+    if function in ("bool_or", "bool_and"):
+        return T.BOOLEAN
+    if function == "sum":
+        if isinstance(in_type, T.DecimalType):
+            return T.DecimalType(38, in_type.scale)
+        if T.is_floating(in_type):
+            return T.DOUBLE
+        return T.BIGINT
+    if function == "avg":
+        if isinstance(in_type, T.DecimalType):
+            return in_type
+        return T.DOUBLE
+    raise ValueError(f"unknown aggregate function: {function}")
+
+
+def pre_transform(pre: Optional[str], data: jnp.ndarray) -> jnp.ndarray:
+    if pre is None:
+        return data
+    if pre == "hi32":
+        return data >> jnp.int64(32)  # arithmetic: floor(v / 2^32)
+    if pre == "lo32":
+        return data & _MASK32
+    raise ValueError(pre)
+
+
+def split32_to_limbs(hi: jnp.ndarray, lo: jnp.ndarray):
+    """(sum of v>>32, sum of v&0xFFFFFFFF) -> base-2^64 two's-complement
+    limbs of the exact 128-bit value hi*2^32 + lo."""
+    u_shift = hi.astype(jnp.uint64) << jnp.uint64(32)
+    u_lo = lo.astype(jnp.uint64)
+    lo64 = u_shift + u_lo
+    carry = (lo64 < u_shift).astype(jnp.int64)
+    hi64 = (hi >> jnp.int64(32)) + carry
+    return hi64, lo64.astype(jnp.int64)
+
+
+def finalize(
+    function: str,
+    in_type: Optional[T.SqlType],
+    out_type: T.SqlType,
+    states: List[Tuple[jnp.ndarray, Optional[jnp.ndarray]]],
+    xp=jnp,
+) -> Block:
+    """Combine merged state columns into the SQL result Block."""
+    if function in ("count", "count_star"):
+        data, _ = states[0]
+        return Block(data=data, type=T.BIGINT, nulls=None)
+    if function in ("min", "max", "any", "bool_or", "bool_and"):
+        data, nulls = states[0]
+        return Block(data=data, type=out_type, nulls=nulls)
+    if function == "sum":
+        if isinstance(in_type, T.DecimalType):
+            (hi, hn), (lo, _) = states
+            hi64, lo64 = split32_to_limbs(hi, lo)
+            return Block(data=(hi64, lo64), type=out_type, nulls=hn)
+        data, nulls = states[0]
+        return Block(data=data, type=out_type, nulls=nulls)
+    if function == "avg":
+        if isinstance(in_type, T.DecimalType):
+            (hi, hn), (lo, _), (count, _) = states
+            cnt = xp.maximum(count, jnp.int64(1))
+            # exact two-step 128/64 divide with round-half-up; derivation
+            # assumes the non-negative domain (money sums); negative totals
+            # fall back through the same path with floor bias ≤ 1 ulp
+            qh = hi // cnt
+            rh = hi - qh * cnt
+            rest = (rh << jnp.int64(32)) + lo
+            q2 = (rest + cnt // jnp.int64(2)) // cnt
+            avg = (qh << jnp.int64(32)) + q2
+            return Block(data=avg, type=out_type, nulls=hn)
+        (s, sn), (count, _) = states
+        cnt = xp.maximum(count, jnp.int64(1)).astype(jnp.float64)
+        data = s.astype(jnp.float64) / cnt
+        return Block(data=data, type=T.DOUBLE, nulls=sn)
+    raise ValueError(f"unknown aggregate function: {function}")

@@ -1,0 +1,637 @@
+"""Plan interpreter: chains statically-shaped jitted kernels per page, with
+host-side control (capacity retries, build sizing, limit accounting) between
+kernel launches.
+
+Reference: presto-main operator/Driver.java's processFor loop moving Pages
+through operator chains, SqlTaskExecution mapping splits to drivers. The TPU
+translation collapses each operator's inner loop into an XLA program; the
+Python host plays the Driver role only at blocking boundaries (aggregation
+flush, join build, sort) and for the dynamic-cardinality escape hatch
+(overflow-retry with doubled capacity, SURVEY §8.2.1).
+
+Jit discipline: every per-page kernel is compiled once per (plan node,
+page schema, capacity) and cached — expression trees and plan nodes are
+hashable and ride in the jit cache key, which is the reference's
+compiled-expression LRU (sql/gen/ExpressionCompiler cache) reborn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import Connector
+from presto_tpu.exec import agg_states as S
+from presto_tpu.exec import plan as P
+from presto_tpu.expr.eval import evaluate, evaluate_filter
+from presto_tpu.ops import agg as A
+from presto_tpu.ops import join as J
+from presto_tpu.ops import keys as K
+from presto_tpu.ops.compact import compact_page, concat_all, gather_rows
+from presto_tpu.ops.sort import limit_page, sort_page
+from presto_tpu.page import Block, Dictionary, Page
+
+
+def _next_pow2(n: int) -> int:
+    n = max(int(n), 8)
+    return 1 << (n - 1).bit_length()
+
+
+def _canonical_join_cols(
+    left_blocks: List[Block], right_blocks: List[Block]
+):
+    """Equality-encoded uint64 key columns for a join, canonicalizing
+    dictionary-coded pairs through a merged host universe so equal strings
+    compare equal across differing dictionaries."""
+    lcols: List[jnp.ndarray] = []
+    rcols: List[jnp.ndarray] = []
+    lnulls, rnulls = [], []
+    for lb, rb in zip(left_blocks, right_blocks):
+        if lb.dictionary is not None or rb.dictionary is not None:
+            ld, rd = lb.dictionary, rb.dictionary
+            if ld == rd:
+                lcols.append(lb.data.astype(jnp.int64).astype(jnp.uint64))
+                rcols.append(rb.data.astype(jnp.int64).astype(jnp.uint64))
+            else:
+                universe = {}
+                for d in (ld, rd):
+                    for v in (d.values if d is not None else []):
+                        universe.setdefault(v, len(universe))
+
+                def canon(b, d):
+                    if d is None or len(d) == 0:
+                        return jnp.zeros(b.data.shape, dtype=jnp.uint64)
+                    lut = np.array(
+                        [universe[v] for v in d.values], np.uint64
+                    )
+                    codes = jnp.clip(b.data, 0, len(d) - 1)
+                    return jnp.asarray(lut)[codes]
+
+                lcols.append(canon(lb, ld))
+                rcols.append(canon(rb, rd))
+            lnulls.append(lb.nulls)
+            rnulls.append(rb.nulls)
+        else:
+            lc = K.equality_encoding(lb)
+            rc = K.equality_encoding(rb)
+            lcols.extend(lc)
+            rcols.extend(rc)
+            lnulls.extend([lb.nulls] * len(lc))
+            rnulls.extend([rb.nulls] * len(rc))
+    return lcols, lnulls, rcols, rnulls
+
+
+class Executor:
+    """Reference: LocalQueryRunner's local execution half — interpret a
+    physical plan against in-process connectors, no scheduler, no HTTP."""
+
+    def __init__(
+        self,
+        catalogs: Dict[str, Connector],
+        *,
+        page_rows: int = 1 << 18,
+        use_jit: bool = True,
+    ):
+        self.catalogs = catalogs
+        self.page_rows = page_rows
+        self.use_jit = use_jit
+        self._jit_cache: Dict = {}
+
+    # ------------------------------------------------------------ plumbing
+    def _jit(self, key, fn, static_argnums=()):
+        if not self.use_jit:
+            return fn
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(fn, static_argnums=static_argnums)
+        return self._jit_cache[key]
+
+    def output_types(self, node: P.PhysicalNode) -> List[T.SqlType]:
+        """Static output channel types (reference: PlanNode.getOutputSymbols
+        + TypeProvider)."""
+        if isinstance(node, P.TableScan):
+            schema = self.catalogs[node.catalog].table_schema(node.table)
+            return [schema.column_type(c) for c in node.columns]
+        if isinstance(node, P.Values):
+            return list(node.types)
+        if isinstance(node, (P.Filter, P.Limit, P.Sort, P.TopN, P.Output)):
+            return self.output_types(node.source)
+        if isinstance(node, P.Project):
+            return [e.type for e in node.exprs]
+        if isinstance(node, P.Aggregation):
+            src = self.output_types(node.source)
+            out = [src[c] for c in node.group_channels]
+            for spec in node.aggregates:
+                in_t = None if spec.channel is None else src[spec.channel]
+                out.append(S.result_type(spec.function, in_t))
+            return out
+        if isinstance(node, P.HashJoin):
+            left = self.output_types(node.left)
+            if node.join_type in ("semi", "anti"):
+                return left + [T.BOOLEAN]
+            return left + self.output_types(node.right)
+        raise TypeError(f"unknown node: {node!r}")
+
+    # ------------------------------------------------------------- execute
+    def pages(self, node: P.PhysicalNode) -> Iterator[Page]:
+        if isinstance(node, P.TableScan):
+            conn = self.catalogs[node.catalog]
+            yield from conn.pages(
+                node.table, node.columns, target_rows=self.page_rows
+            )
+            return
+        if isinstance(node, P.Values):
+            cols = list(zip(*node.rows)) if node.rows else [
+                [] for _ in node.types
+            ]
+            yield Page.from_arrays(
+                [list(c) for c in cols], list(node.types)
+            )
+            return
+        if isinstance(node, P.Filter):
+            fn = self._jit(
+                ("filter", node.predicate),
+                lambda page: evaluate_filter(node.predicate, page, jnp),
+            )
+            for page in self.pages(node.source):
+                yield fn(page)
+            return
+        if isinstance(node, P.Project):
+            fn = self._jit(
+                ("project", node.exprs),
+                functools.partial(_project_page, node.exprs),
+            )
+            for page in self.pages(node.source):
+                yield fn(page)
+            return
+        if isinstance(node, P.Aggregation):
+            yield from self._exec_aggregation(node)
+            return
+        if isinstance(node, P.HashJoin):
+            yield from self._exec_join(node)
+            return
+        if isinstance(node, (P.Sort, P.TopN)):
+            pages = list(self.pages(node.source))
+            if not pages:
+                return
+            merged = concat_all(pages)
+            limit = node.limit if isinstance(node, P.TopN) else None
+            key = ("sort", node.keys, limit, merged.capacity)
+            fn = self._jit(
+                key, functools.partial(sort_page, sort_keys=node.keys,
+                                       limit=limit)
+            )
+            yield fn(merged)
+            return
+        if isinstance(node, P.Limit):
+            remaining = node.count
+            offset = node.offset
+            for page in self.pages(node.source):
+                if remaining <= 0:
+                    return
+                out = limit_page(page, remaining, offset)
+                n = int(out.num_rows())
+                skipped_here = min(int(page.num_rows()), offset)
+                offset = max(offset - skipped_here, 0)
+                remaining -= n
+                if n:
+                    yield out
+            return
+        if isinstance(node, P.Output):
+            yield from self.pages(node.source)
+            return
+        raise TypeError(f"unknown node: {node!r}")
+
+    def execute(self, node: P.PhysicalNode):
+        """Materialize results: (column_names, list of row tuples).
+
+        Reference analog: testing/MaterializedResult via LocalQueryRunner.
+        """
+        names = (
+            list(node.names) if isinstance(node, P.Output) else None
+        )
+        rows: List[tuple] = []
+        for page in self.pages(node):
+            rows.extend(_decode_result_page(page))
+        return names, rows
+
+    # -------------------------------------------------------- aggregation
+    def _agg_in_types(self, node: P.Aggregation) -> List[Optional[T.SqlType]]:
+        src = self.output_types(node.source)
+        return [
+            None if s.channel is None else src[s.channel]
+            for s in node.aggregates
+        ]
+
+    def _exec_aggregation(self, node: P.Aggregation) -> Iterator[Page]:
+        in_types = self._agg_in_types(node)
+        layouts = [
+            S.state_layout(s.function, t)
+            for s, t in zip(node.aggregates, in_types)
+        ]
+        if not node.group_channels:
+            yield self._exec_global_agg(node, in_types, layouts)
+            return
+
+        cap = _next_pow2(min(node.capacity, self.page_rows))
+        partial_fn = self._jit(
+            ("agg_partial", node),
+            functools.partial(
+                _partial_agg_page, node.group_channels, node.aggregates,
+                tuple(tuple(l) for l in layouts)
+            ),
+            static_argnums=(1,),
+        )
+        partials: List[Page] = []
+        any_input = False
+        for page in self.pages(node.source):
+            any_input = True
+            c = cap
+            max_cap = _next_pow2(page.capacity)
+            while True:
+                out, overflow = partial_fn(page, c)
+                if not bool(overflow) or c >= max_cap:
+                    break
+                c = min(c * 2, max_cap)
+            partials.append(out)
+        if not any_input:
+            return
+
+        merged = concat_all(partials) if len(partials) > 1 else partials[0]
+        final_fn = self._jit(
+            ("agg_final", node),
+            functools.partial(
+                _final_agg_page, node.group_channels, node.aggregates,
+                tuple(tuple(l) for l in layouts), tuple(in_types)
+            ),
+            static_argnums=(1,),
+        )
+        c = _next_pow2(node.capacity)
+        while True:
+            out, overflow = final_fn(merged, c)
+            if not bool(overflow):
+                break
+            c *= 2
+        yield out
+
+    def _exec_global_agg(self, node, in_types, layouts) -> Page:
+        partial_fn = self._jit(
+            ("gagg_partial", node),
+            functools.partial(
+                _partial_global_agg, node.aggregates,
+                tuple(tuple(l) for l in layouts)
+            ),
+        )
+        partials = [partial_fn(p) for p in self.pages(node.source)]
+        if not partials:
+            partials = [
+                _empty_state_page(node.aggregates, layouts)
+            ]
+        merged = concat_all(partials) if len(partials) > 1 else partials[0]
+        final_fn = self._jit(
+            ("gagg_final", node),
+            functools.partial(
+                _final_global_agg, node.aggregates,
+                tuple(tuple(l) for l in layouts), tuple(in_types)
+            ),
+        )
+        return final_fn(merged)
+
+    # --------------------------------------------------------------- join
+    def _exec_join(self, node: P.HashJoin) -> Iterator[Page]:
+        build_pages = list(self.pages(node.right))
+        left_types = self.output_types(node.left)
+        right_types = self.output_types(node.right)
+        if not build_pages:
+            build_pages = [_empty_page(right_types)]
+        build_all = concat_all(build_pages)
+        n_build = int(build_all.num_rows())
+        build = compact_page(build_all, _next_pow2(n_build))
+
+        if node.join_type in ("semi", "anti"):
+            fn = self._jit(
+                ("semi", node, build.capacity),
+                functools.partial(_semi_join_page, node.left_keys,
+                                  node.right_keys),
+            )
+            for page in self.pages(node.left):
+                yield fn(page, build)
+            return
+
+        probe_fn = self._jit(
+            ("join_probe", node, build.capacity),
+            functools.partial(
+                _probe_join_page, node.left_keys, node.right_keys,
+                node.join_type
+            ),
+            static_argnums=(2,),
+        )
+        build_matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
+        for page in self.pages(node.left):
+            out_cap = _next_pow2(max(page.capacity, n_build) * 2)
+            while True:
+                out, matched, overflow = probe_fn(page, build, out_cap)
+                if not bool(overflow):
+                    break
+                out_cap *= 2
+            build_matched = build_matched | matched
+            yield out
+        if node.join_type in ("right", "full"):
+            # emit unmatched build rows with null left side (reference:
+            # LookupOuterOperator draining unvisited positions)
+            unmatched = build.valid & ~build_matched
+            null_left = _null_blocks(left_types, build.capacity)
+            page = Page(
+                blocks=tuple(null_left) + build.blocks, valid=unmatched
+            )
+            yield page
+
+
+# ---------------------------------------------------------------- kernels
+# Module-level pure functions so functools.partial(...) stays hashable and
+# jit caches hit across pages.
+
+
+def _project_page(exprs, page: Page) -> Page:
+    blocks = []
+    for e in exprs:
+        v = evaluate(e, page, jnp)
+        data = v.data
+        if not isinstance(data, tuple) and data.ndim == 0:
+            data = jnp.broadcast_to(data, (page.capacity,))
+        elif isinstance(data, tuple):
+            data = tuple(
+                jnp.broadcast_to(d, (page.capacity,)) if d.ndim == 0 else d
+                for d in data
+            )
+        nulls = v.nulls
+        if nulls is not None and nulls.ndim == 0:
+            nulls = jnp.broadcast_to(nulls, (page.capacity,))
+        blocks.append(
+            Block(data=data, type=e.type, nulls=nulls, dictionary=v.dictionary)
+        )
+    return Page(blocks=tuple(blocks), valid=page.valid)
+
+
+def _group_ids(group_channels, page: Page, cap: int):
+    key_blocks = [page.block(c) for c in group_channels]
+    key_cols, key_nulls = K.block_key_columns(key_blocks)
+    return A.compute_groups_sorted(key_cols, key_nulls, page.valid, cap)
+
+
+def _state_reduce(st, blk, kind, apply_pre, reducer):
+    """Run one primitive reduction with value-domain transforms.
+
+    Dictionary-coded inputs (min/max need *value* order, not code order) are
+    rank-transformed through Dictionary.sort_rank before reducing and mapped
+    back after, and the dictionary rides along so decode stays correct.
+    reducer(data, nulls) -> (vals, out_nulls).
+    """
+    if blk is None:
+        return (*reducer(None, None), None)
+    if isinstance(blk.data, tuple):
+        raise NotImplementedError(
+            "aggregation over long-decimal (p>18) input columns is not "
+            "supported yet; decimal sums produce long-decimal *outputs* "
+            "from short inputs (presto_tpu/exec/agg_states.py)"
+        )
+    dic = blk.dictionary
+    if dic is not None and kind in (A.MIN, A.MAX) and len(dic):
+        rank = jnp.asarray(dic.sort_rank().astype(np.int64))
+        inv = jnp.asarray(np.argsort(dic.sort_rank()).astype(np.int64))
+        data = rank[jnp.clip(blk.data, 0, len(dic) - 1)]
+        vals, out_nulls = reducer(data, blk.nulls)
+        vals = inv[jnp.clip(vals, 0, len(dic) - 1)].astype(blk.data.dtype)
+        return vals, out_nulls, dic
+    data = S.pre_transform(st.pre, blk.data) if apply_pre else blk.data
+    vals, out_nulls = reducer(data, blk.nulls)
+    return vals, out_nulls, dic
+
+
+def _attach_dictionary(block: Block, dic) -> Block:
+    if dic is None or block.dictionary is not None:
+        return block
+    if not block.type.is_dictionary_encoded:
+        return block
+    return Block(
+        data=block.data, type=block.type, nulls=block.nulls, dictionary=dic
+    )
+
+
+def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
+                      cap: int):
+    groups = _group_ids(group_channels, page, cap)
+    keys_page = gather_rows(
+        page.select_channels(group_channels),
+        groups.rep_index,
+        groups.group_valid,
+    )
+    state_blocks: List[Block] = []
+    for spec, layout in zip(aggregates, layouts):
+        blk = None if spec.channel is None else page.block(spec.channel)
+        for st in layout:
+            vals, out_nulls, dic = _state_reduce(
+                st, blk, st.input_kind, True,
+                lambda data, nulls, k=st.input_kind: A.aggregate(
+                    groups, k, cap, data, nulls
+                ),
+            )
+            state_blocks.append(
+                Block(data=vals, type=st.type, nulls=out_nulls,
+                      dictionary=dic)
+            )
+    out = Page(
+        blocks=keys_page.blocks + tuple(state_blocks),
+        valid=groups.group_valid,
+    )
+    return out, groups.overflow
+
+
+def _final_agg_page(group_channels, aggregates, layouts, in_types,
+                    merged: Page, cap: int):
+    nkeys = len(group_channels)
+    key_channels = tuple(range(nkeys))
+    groups = _group_ids(key_channels, merged, cap)
+    keys_page = gather_rows(
+        merged.select_channels(key_channels),
+        groups.rep_index,
+        groups.group_valid,
+    )
+    out_blocks: List[Block] = []
+    ch = nkeys
+    for spec, layout, in_t in zip(aggregates, layouts, in_types):
+        states = []
+        state_dic = None
+        for st in layout:
+            blk = merged.block(ch)
+            ch += 1
+            vals, out_nulls, dic = _state_reduce(
+                st, blk, st.merge_kind, False,
+                lambda data, nulls, k=st.merge_kind: A.aggregate(
+                    groups, k, cap, data, nulls
+                ),
+            )
+            state_dic = state_dic or dic
+            states.append((vals, out_nulls))
+        out_t = S.result_type(spec.function, in_t)
+        out_blocks.append(
+            _attach_dictionary(
+                S.finalize(spec.function, in_t, out_t, states), state_dic
+            )
+        )
+    out = Page(
+        blocks=keys_page.blocks + tuple(out_blocks),
+        valid=groups.group_valid,
+    )
+    return out, groups.overflow
+
+
+def _partial_global_agg(aggregates, layouts, page: Page) -> Page:
+    blocks = []
+    for spec, layout in zip(aggregates, layouts):
+        blk = None if spec.channel is None else page.block(spec.channel)
+        for st in layout:
+            vals, is_null, dic = _state_reduce(
+                st, blk, st.input_kind, True,
+                lambda data, nulls, k=st.input_kind: A.global_aggregate(
+                    k, page.valid, data, nulls
+                ),
+            )
+            blocks.append(
+                Block(
+                    data=vals[None].astype(np.dtype(st.type.numpy_dtype)),
+                    type=st.type,
+                    nulls=is_null[None],
+                    dictionary=dic,
+                )
+            )
+    return Page(blocks=tuple(blocks), valid=jnp.ones((1,), dtype=jnp.bool_))
+
+
+def _final_global_agg(aggregates, layouts, in_types, merged: Page) -> Page:
+    out_blocks = []
+    ch = 0
+    for spec, layout, in_t in zip(aggregates, layouts, in_types):
+        states = []
+        state_dic = None
+        for st in layout:
+            blk = merged.block(ch)
+            ch += 1
+            vals, is_null, dic = _state_reduce(
+                st, blk, st.merge_kind, False,
+                lambda data, nulls, k=st.merge_kind: A.global_aggregate(
+                    k, merged.valid, data, nulls
+                ),
+            )
+            state_dic = state_dic or dic
+            states.append((vals[None], is_null[None]))
+        out_t = S.result_type(spec.function, in_t)
+        out_blocks.append(
+            _attach_dictionary(
+                S.finalize(spec.function, in_t, out_t, states), state_dic
+            )
+        )
+    return Page(blocks=tuple(out_blocks),
+                valid=jnp.ones((1,), dtype=jnp.bool_))
+
+
+def _empty_state_page(aggregates, layouts) -> Page:
+    blocks = []
+    for spec, layout in zip(aggregates, layouts):
+        for st in layout:
+            blocks.append(
+                Block(
+                    data=jnp.zeros((1,), dtype=np.dtype(st.type.numpy_dtype)),
+                    type=st.type,
+                    nulls=jnp.ones((1,), dtype=jnp.bool_),
+                )
+            )
+    return Page(blocks=tuple(blocks), valid=jnp.zeros((1,), dtype=jnp.bool_))
+
+
+def _empty_page(types: List[T.SqlType], cap: int = 8) -> Page:
+    blocks = []
+    for t in types:
+        if isinstance(t, T.DecimalType) and not t.is_short:
+            data = (
+                jnp.zeros((cap,), dtype=jnp.int64),
+                jnp.zeros((cap,), dtype=jnp.int64),
+            )
+        else:
+            data = jnp.zeros((cap,), dtype=np.dtype(t.numpy_dtype))
+        dic = Dictionary([]) if t.is_dictionary_encoded else None
+        blocks.append(Block(data=data, type=t, nulls=None, dictionary=dic))
+    return Page(blocks=tuple(blocks), valid=jnp.zeros((cap,), dtype=jnp.bool_))
+
+
+def _null_blocks(types: List[T.SqlType], cap: int) -> List[Block]:
+    page = _empty_page(types, cap)
+    return [
+        Block(
+            data=b.data,
+            type=b.type,
+            nulls=jnp.ones((cap,), dtype=jnp.bool_),
+            dictionary=b.dictionary,
+        )
+        for b in page.blocks
+    ]
+
+
+def _probe_join_page(left_keys, right_keys, join_type, page: Page,
+                     build: Page, out_cap: int):
+    lblocks = [page.block(c) for c in left_keys]
+    rblocks = [build.block(c) for c in right_keys]
+    lcols, lnulls, rcols, rnulls = _canonical_join_cols(lblocks, rblocks)
+    m = J.hash_join_match(
+        rcols, rnulls, build.valid, lcols, lnulls, page.valid, out_cap
+    )
+    out_valid = m.match
+    left_out = gather_rows(page, m.probe_idx, out_valid)
+    right_out = gather_rows(build, m.build_idx, out_valid)
+    out = Page(blocks=left_out.blocks + right_out.blocks, valid=out_valid)
+    if join_type in ("left", "full"):
+        # unmatched probe rows with null build side, appended
+        unmatched_valid = page.valid & (m.probe_match_count == 0)
+        null_right = [
+            Block(
+                data=b.data,
+                type=b.type,
+                nulls=jnp.ones((page.capacity,), dtype=jnp.bool_),
+                dictionary=b.dictionary,
+            )
+            for b in gather_rows(
+                build,
+                jnp.zeros((page.capacity,), dtype=jnp.int64),
+                unmatched_valid,
+            ).blocks
+        ]
+        pad = Page(
+            blocks=page.blocks + tuple(null_right), valid=unmatched_valid
+        )
+        out = concat_all([out, pad])
+    return out, m.build_matched, m.overflow
+
+
+def _semi_join_page(left_keys, right_keys, page: Page, build: Page) -> Page:
+    lblocks = [page.block(c) for c in left_keys]
+    rblocks = [build.block(c) for c in right_keys]
+    lcols, lnulls, rcols, rnulls = _canonical_join_cols(lblocks, rblocks)
+    has_match, null_result = J.semi_join_mask(
+        rcols, rnulls, build.valid, lcols, lnulls, page.valid
+    )
+    match_block = Block(
+        data=has_match, type=T.BOOLEAN, nulls=null_result
+    )
+    return Page(blocks=page.blocks + (match_block,), valid=page.valid)
+
+
+def _decode_result_page(page: Page) -> List[tuple]:
+    """Decode device rows to Python values, normalizing engine-internal
+    encodings (decimal unscaled ints -> Decimal strings stay as ints here;
+    clients format)."""
+    return page.to_pylist()

@@ -1,0 +1,157 @@
+"""Physical plan nodes.
+
+Reference: the operator factories LocalExecutionPlanner wires up —
+ScanFilterAndProjectOperator, FilterAndProjectOperator,
+HashAggregationOperator, HashBuilderOperator/LookupJoinOperator,
+TopNOperator, OrderByOperator, LimitOperator, ValuesOperator,
+TaskOutputOperator (presto-main operator/*). A node tree here is what both
+hand-built benchmarks (SURVEY §8.1 phase 3) and the SQL planner (phase 4)
+emit; the Executor interprets it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.expr.ir import RowExpression
+from presto_tpu.ops.sort import SortKey
+
+
+class PhysicalNode:
+    def children(self) -> Tuple["PhysicalNode", ...]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class TableScan(PhysicalNode):
+    """Leaf: stream pages of selected columns from a connector table
+    (reference: operator/TableScanOperator.java + ConnectorPageSource)."""
+
+    catalog: str
+    table: str
+    columns: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Values(PhysicalNode):
+    """Inline literal rows (reference: operator/ValuesOperator.java)."""
+
+    types: Tuple[T.SqlType, ...]
+    rows: Tuple[tuple, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter(PhysicalNode):
+    source: PhysicalNode
+    predicate: RowExpression
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Project(PhysicalNode):
+    """Channel-producing projection (reference: FilterAndProjectOperator's
+    project half; exprs reference the source's channels)."""
+
+    source: PhysicalNode
+    exprs: Tuple[RowExpression, ...]
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate call (reference: AggregationNode.Aggregation).
+
+    function: sum | count | count_star | min | max | avg | any | bool_or |
+    bool_and. channel: input channel (None for count_star).
+    """
+
+    function: str
+    channel: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregation(PhysicalNode):
+    """Group-by + aggregates (reference: HashAggregationOperator /
+    AggregationOperator for the global case). Output channels: group keys
+    first (in group_channels order), then one per AggSpec.
+
+    capacity = max distinct groups the executor sizes for; it retries with
+    doubled capacity on overflow (SURVEY §8.2.1 escape hatch).
+    """
+
+    source: PhysicalNode
+    group_channels: Tuple[int, ...]
+    aggregates: Tuple[AggSpec, ...]
+    capacity: int = 4096
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class HashJoin(PhysicalNode):
+    """Equi-join; left = probe side, right = build side (reference:
+    LookupJoinOperator probes HashBuilderOperator's LookupSource; the
+    planner's AddExchanges decides sides). Output: left channels then right
+    channels. join_type: inner | left | right | full | semi | anti.
+
+    For semi/anti the output is the left channels plus one boolean channel
+    (match indicator consumed by a downstream filter), mirroring the
+    reference's HashSemiJoinOperator emitting a match channel.
+    """
+
+    left: PhysicalNode
+    right: PhysicalNode
+    left_keys: Tuple[int, ...]
+    right_keys: Tuple[int, ...]
+    join_type: str = "inner"
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort(PhysicalNode):
+    source: PhysicalNode
+    keys: Tuple[SortKey, ...]
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopN(PhysicalNode):
+    source: PhysicalNode
+    keys: Tuple[SortKey, ...]
+    limit: int
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Limit(PhysicalNode):
+    source: PhysicalNode
+    count: int
+    offset: int = 0
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Output(PhysicalNode):
+    """Terminal: name the output channels (reference: OutputNode +
+    TaskOutputOperator)."""
+
+    source: PhysicalNode
+    names: Tuple[str, ...]
+
+    def children(self):
+        return (self.source,)

@@ -95,51 +95,62 @@ def gather_rows(
     return Page(blocks=tuple(new_blocks), valid=valid)
 
 
-def concat_pages(a: Page, b: Page) -> Page:
-    """Concatenate two pages with identical schemas (capacities add).
+def concat_all(pages) -> Page:
+    """n-way page concat with dictionary reconciliation (one
+    jnp.concatenate per column, not a fold of pairwise copies).
 
-    Dictionary columns with differing dictionaries are merged: the output
-    dictionary is a's values followed by b's unseen values, and b's codes are
-    remapped through a static translation table (dictionaries are host-side
-    static data, so the remap is a compile-time constant gather).
+    Dictionary columns with differing dictionaries are merged through one
+    value universe and codes are remapped via static host luts (dictionaries
+    are compile-time data, so the remaps are constant gathers).
     """
     import numpy as np
 
     from presto_tpu.page import Block, Dictionary
 
+    pages = list(pages)
+    if len(pages) == 1:
+        return pages[0]
     blocks = []
-    for ba, bb in zip(a.blocks, b.blocks):
-        out_dict = ba.dictionary
-        bb_data = bb.data
-        if ba.dictionary is not None or bb.dictionary is not None:
-            da = ba.dictionary or Dictionary([])
-            db = bb.dictionary or Dictionary([])
-            if da != db:
-                merged_vals = list(da.values) + [
-                    v for v in db.values if da.code_of(v) < 0
-                ]
-                out_dict = Dictionary(merged_vals)
-                remap = np.array(
-                    [out_dict.code_of(v) for v in db.values] or [0],
-                    dtype=np.int32,
-                )
-                codes = jnp.clip(bb.data, 0, max(len(db) - 1, 0))
-                bb_data = jnp.asarray(remap)[codes]
-        if isinstance(ba.data, tuple):
+    for ch in range(pages[0].channel_count):
+        blks = [p.block(ch) for p in pages]
+        dic = None
+        datas = [b.data for b in blks]
+        if any(b.dictionary is not None for b in blks):
+            dics = [b.dictionary for b in blks]
+            if all(d == dics[0] for d in dics):
+                dic = dics[0]
+            else:
+                pos = {}
+                for d in dics:
+                    for v in (d.values if d is not None else []):
+                        pos.setdefault(v, len(pos))
+                dic = Dictionary(list(pos))
+                remapped = []
+                for b, d in zip(blks, dics):
+                    if d is None or len(d) == 0:
+                        remapped.append(jnp.zeros_like(b.data))
+                        continue
+                    lut = np.array([pos[v] for v in d.values], np.int32)
+                    codes = jnp.clip(b.data, 0, len(d) - 1)
+                    remapped.append(jnp.asarray(lut)[codes])
+                datas = remapped
+        if isinstance(datas[0], tuple):
             data = tuple(
-                jnp.concatenate([x, y]) for x, y in zip(ba.data, bb_data)
+                jnp.concatenate([d[i] for d in datas]) for i in range(2)
             )
         else:
-            data = jnp.concatenate([ba.data, bb_data])
-        if ba.nulls is None and bb.nulls is None:
+            data = jnp.concatenate(datas)
+        if all(b.nulls is None for b in blks):
             nulls = None
         else:
-            na = ba.nulls_or_false()
-            nb = bb.nulls_or_false()
-            nulls = jnp.concatenate([na, nb])
+            nulls = jnp.concatenate([b.nulls_or_false() for b in blks])
         blocks.append(
-            Block(data=data, type=ba.type, nulls=nulls, dictionary=out_dict)
+            Block(data=data, type=blks[0].type, nulls=nulls, dictionary=dic)
         )
-    return Page(
-        blocks=tuple(blocks), valid=jnp.concatenate([a.valid, b.valid])
-    )
+    valid = jnp.concatenate([p.valid for p in pages])
+    return Page(blocks=tuple(blocks), valid=valid)
+
+
+def concat_pages(a: Page, b: Page) -> Page:
+    """Two-page concat (see concat_all)."""
+    return concat_all([a, b])

@@ -1,0 +1,63 @@
+"""sqlite3-based SQL oracle for engine correctness tests.
+
+Reference test pattern: presto-tests tests/H2QueryRunner — TPC-H correctness
+suites compare engine output against an embedded relational database over
+the same data. We load the deterministic TPC-H pages into sqlite with
+engine-internal encodings (decimals as unscaled ints, dates as epoch days)
+so integer math is exact on both sides.
+"""
+
+import sqlite3
+from typing import Dict, Iterable, List, Optional
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import Connector
+
+
+def _sqlite_type(t: T.SqlType) -> str:
+    if T.is_string(t):
+        return "TEXT"
+    if T.is_floating(t):
+        return "REAL"
+    return "INTEGER"
+
+
+def load_sqlite(
+    connector: Connector,
+    tables: Iterable[str],
+    target_rows: int = 1 << 20,
+) -> sqlite3.Connection:
+    db = sqlite3.connect(":memory:")
+    for table in tables:
+        schema = connector.table_schema(table)
+        cols = ", ".join(
+            f"{c.name} {_sqlite_type(c.type)}" for c in schema.columns
+        )
+        db.execute(f"CREATE TABLE {table} ({cols})")
+        placeholders = ", ".join("?" for _ in schema.columns)
+        rows = connector.host_rows(table, target_rows=target_rows)
+        db.executemany(
+            f"INSERT INTO {table} VALUES ({placeholders})", rows
+        )
+    db.commit()
+    return db
+
+
+def rows_match(engine_rows: List[tuple], oracle_rows: List[tuple],
+               float_cols: Optional[set] = None, tol: float = 1e-9) -> None:
+    """Order-sensitive row comparison with exact ints and tolerant floats."""
+    assert len(engine_rows) == len(oracle_rows), (
+        f"row count mismatch: engine {len(engine_rows)} vs oracle "
+        f"{len(oracle_rows)}\nengine head: {engine_rows[:3]}\n"
+        f"oracle head: {oracle_rows[:3]}"
+    )
+    float_cols = float_cols or set()
+    for i, (er, orow) in enumerate(zip(engine_rows, oracle_rows)):
+        assert len(er) == len(orow), f"row {i} arity mismatch"
+        for j, (ev, ov) in enumerate(zip(er, orow)):
+            if j in float_cols and ev is not None and ov is not None:
+                assert abs(float(ev) - float(ov)) <= tol * max(
+                    1.0, abs(float(ov))
+                ), f"row {i} col {j}: {ev} != {ov}"
+            else:
+                assert ev == ov, f"row {i} col {j}: {ev!r} != {ov!r}"

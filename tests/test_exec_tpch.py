@@ -1,0 +1,349 @@
+"""End-to-end single-device engine tests: hand-built physical plans for
+TPC-H Q1/Q6/Q3-style pipelines validated against a sqlite oracle over the
+same data (SURVEY §8.1 phase 3; BASELINE config 1 minimum slice).
+
+Reference analog: presto-benchmark HandTpchQuery1 — a hand-wired operator
+pipeline — checked the way presto-tests checks SQL against H2QueryRunner.
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.tpch import DEC, TpchConnector
+from presto_tpu.exec import (
+    AggSpec,
+    Aggregation,
+    Executor,
+    Filter,
+    HashJoin,
+    Limit,
+    Output,
+    Project,
+    Sort,
+    TableScan,
+    TopN,
+)
+from presto_tpu.expr import ir
+from presto_tpu.ops.sort import SortKey
+from tests.oracle import load_sqlite, rows_match
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def days(y, m, d):
+    return (datetime.date(y, m, d) - EPOCH).days
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(0.005)
+
+
+@pytest.fixture(scope="module")
+def ex(conn):
+    return Executor({"tpch": conn}, page_rows=1 << 14)
+
+
+@pytest.fixture(scope="module")
+def db(conn):
+    return load_sqlite(
+        conn, ["lineitem", "orders", "customer", "nation", "region"]
+    )
+
+
+def round_half_up(num: int, den: int) -> int:
+    if den == 0:
+        return 0
+    sign = 1 if (num >= 0) == (den >= 0) else -1
+    q, r = divmod(abs(num), abs(den))
+    if 2 * r >= abs(den):
+        q += 1
+    return sign * q
+
+
+class TestQ1:
+    def plan(self):
+        cutoff = days(1998, 12, 1) - 90
+        scan = TableScan(
+            "tpch", "lineitem",
+            ("l_returnflag", "l_linestatus", "l_quantity",
+             "l_extendedprice", "l_discount", "l_tax", "l_shipdate"),
+        )
+        filt = Filter(
+            scan,
+            ir.call("le", ir.input_ref(6, T.DATE), ir.const(cutoff, T.DATE)),
+        )
+        one = ir.const(100, DEC)
+        ext = ir.input_ref(3, DEC)
+        disc = ir.input_ref(4, DEC)
+        tax = ir.input_ref(5, DEC)
+        disc_price = ir.call("multiply", ext,
+                             ir.call("subtract", one, disc))
+        charge = ir.call("multiply", disc_price, ir.call("add", one, tax))
+        proj = Project(
+            filt,
+            (
+                ir.input_ref(0, T.VARCHAR), ir.input_ref(1, T.VARCHAR),
+                ir.input_ref(2, DEC), ext, disc_price, charge, disc,
+            ),
+        )
+        agg = Aggregation(
+            proj,
+            group_channels=(0, 1),
+            aggregates=(
+                AggSpec("sum", 2),      # sum_qty
+                AggSpec("sum", 3),      # sum_base_price
+                AggSpec("sum", 4),      # sum_disc_price
+                AggSpec("sum", 5),      # sum_charge
+                AggSpec("avg", 2),      # avg_qty
+                AggSpec("avg", 3),      # avg_price
+                AggSpec("avg", 6),      # avg_disc
+                AggSpec("count_star", None),
+            ),
+            capacity=16,
+        )
+        sort = Sort(agg, (SortKey(0), SortKey(1)))
+        return Output(sort, (
+            "l_returnflag", "l_linestatus", "sum_qty", "sum_base_price",
+            "sum_disc_price", "sum_charge", "avg_qty", "avg_price",
+            "avg_disc", "count_order",
+        ))
+
+    def test_q1_vs_oracle(self, ex, db):
+        cutoff = days(1998, 12, 1) - 90
+        names, rows = ex.execute(self.plan())
+        oracle = db.execute(
+            f"""
+            SELECT l_returnflag, l_linestatus,
+                   SUM(l_quantity),
+                   SUM(l_extendedprice),
+                   SUM(l_extendedprice * (100 - l_discount)),
+                   SUM(l_extendedprice * (100 - l_discount)
+                       * (100 + l_tax)),
+                   SUM(l_quantity), COUNT(*),
+                   SUM(l_extendedprice),
+                   SUM(l_discount)
+            FROM lineitem WHERE l_shipdate <= {cutoff}
+            GROUP BY 1, 2 ORDER BY 1, 2
+            """
+        ).fetchall()
+        assert len(rows) == len(oracle) > 0
+        expect = []
+        for (rf, ls, sq, sbp, sdp, sc, sq2, cnt, sext, sdisc) in oracle:
+            expect.append((
+                rf, ls, sq, sbp, sdp, sc,
+                round_half_up(sq, cnt),
+                round_half_up(sext, cnt),
+                round_half_up(sdisc, cnt),
+                cnt,
+            ))
+        rows_match(rows, expect)
+
+
+class TestQ6:
+    def plan(self):
+        lo, hi = days(1994, 1, 1), days(1995, 1, 1)
+        scan = TableScan(
+            "tpch", "lineitem",
+            ("l_shipdate", "l_discount", "l_quantity", "l_extendedprice"),
+        )
+        pred = ir.and_(
+            ir.call("ge", ir.input_ref(0, T.DATE), ir.const(lo, T.DATE)),
+            ir.call("lt", ir.input_ref(0, T.DATE), ir.const(hi, T.DATE)),
+            ir.between(ir.input_ref(1, DEC), ir.const(5, DEC),
+                       ir.const(7, DEC)),
+            ir.call("lt", ir.input_ref(2, DEC), ir.const(2400, DEC)),
+        )
+        filt = Filter(scan, pred)
+        revenue = ir.call("multiply", ir.input_ref(3, DEC),
+                          ir.input_ref(1, DEC))
+        proj = Project(filt, (revenue,))
+        agg = Aggregation(proj, (), (AggSpec("sum", 0),))
+        return Output(agg, ("revenue",))
+
+    def test_q6_vs_oracle(self, ex, db):
+        lo, hi = days(1994, 1, 1), days(1995, 1, 1)
+        names, rows = ex.execute(self.plan())
+        (expect,) = db.execute(
+            f"""
+            SELECT SUM(l_extendedprice * l_discount) FROM lineitem
+            WHERE l_shipdate >= {lo} AND l_shipdate < {hi}
+              AND l_discount BETWEEN 5 AND 7 AND l_quantity < 2400
+            """
+        ).fetchone()
+        assert len(rows) == 1
+        assert rows[0][0] == expect
+
+
+class TestQ3:
+    def plan(self):
+        cutoff = days(1995, 3, 15)
+        cust = Filter(
+            TableScan("tpch", "customer", ("c_custkey", "c_mktsegment")),
+            ir.call("eq", ir.input_ref(1, T.VARCHAR),
+                    ir.const("BUILDING", T.VARCHAR)),
+        )
+        orders = Filter(
+            TableScan("tpch", "orders",
+                      ("o_orderkey", "o_custkey", "o_orderdate",
+                       "o_shippriority")),
+            ir.call("lt", ir.input_ref(2, T.DATE),
+                    ir.const(cutoff, T.DATE)),
+        )
+        # orders ⋈ customer on custkey (customer is the small build side)
+        j1 = HashJoin(orders, cust, (1,), (0,))
+        # channels: o_orderkey, o_custkey, o_orderdate, o_shippriority,
+        #           c_custkey, c_mktsegment
+        line = Filter(
+            TableScan("tpch", "lineitem",
+                      ("l_orderkey", "l_extendedprice", "l_discount",
+                       "l_shipdate")),
+            ir.call("gt", ir.input_ref(3, T.DATE),
+                    ir.const(cutoff, T.DATE)),
+        )
+        j2 = HashJoin(line, j1, (0,), (0,))
+        # channels: l_orderkey, l_extendedprice, l_discount, l_shipdate,
+        #           o_orderkey, o_custkey, o_orderdate, o_shippriority, ...
+        one = ir.const(100, DEC)
+        revenue = ir.call(
+            "multiply", ir.input_ref(1, DEC),
+            ir.call("subtract", one, ir.input_ref(2, DEC)),
+        )
+        proj = Project(
+            j2,
+            (ir.input_ref(0, T.BIGINT), revenue,
+             ir.input_ref(6, T.DATE), ir.input_ref(7, T.INTEGER)),
+        )
+        agg = Aggregation(
+            proj, (0, 2, 3), (AggSpec("sum", 1),), capacity=1 << 14
+        )
+        # reorder to Q3 output: l_orderkey, revenue, o_orderdate,
+        # o_shippriority (agg output is okey, odate, ship, sum)
+        out = Project(
+            agg,
+            (ir.input_ref(0, T.BIGINT),
+             ir.input_ref(3, T.DecimalType(38, 4)),
+             ir.input_ref(1, T.DATE), ir.input_ref(2, T.INTEGER)),
+        )
+        topn = TopN(
+            out,
+            (SortKey(1, ascending=False), SortKey(2)),
+            limit=10,
+        )
+        return Output(topn, ("l_orderkey", "revenue", "o_orderdate",
+                             "o_shippriority"))
+
+    def test_q3_vs_oracle(self, ex, db):
+        cutoff = days(1995, 3, 15)
+        names, rows = ex.execute(self.plan())
+        oracle = db.execute(
+            f"""
+            SELECT l_orderkey,
+                   SUM(l_extendedprice * (100 - l_discount)) AS revenue,
+                   o_orderdate, o_shippriority
+            FROM customer, orders, lineitem
+            WHERE c_mktsegment = 'BUILDING'
+              AND c_custkey = o_custkey AND l_orderkey = o_orderkey
+              AND o_orderdate < {cutoff} AND l_shipdate > {cutoff}
+            GROUP BY l_orderkey, o_orderdate, o_shippriority
+            ORDER BY revenue DESC, o_orderdate LIMIT 10
+            """
+        ).fetchall()
+        # ties on (revenue, orderdate) make trailing rows ambiguous; compare
+        # as sets of tuples (the engine and sqlite may break ties apart)
+        assert len(rows) == len(oracle)
+        assert set(map(tuple, rows)) == set(map(tuple, oracle)) or [
+            r[1] for r in rows
+        ] == [r[1] for r in oracle]
+
+
+class TestJoinTypes:
+    def test_left_join_emits_unmatched(self, ex, db, conn):
+        orders = TableScan("tpch", "orders", ("o_orderkey", "o_custkey"))
+        cust = Filter(
+            TableScan("tpch", "customer", ("c_custkey", "c_acctbal")),
+            ir.call("gt", ir.input_ref(1, DEC), ir.const(900_000, DEC)),
+        )
+        j = HashJoin(orders, cust, (1,), (0,), join_type="left")
+        agg = Aggregation(
+            j, (),
+            (AggSpec("count_star", None), AggSpec("count", 2)),
+        )
+        _, rows = ex.execute(Output(agg, ("n", "matched")))
+        (n, matched) = rows[0]
+        (on,) = db.execute("SELECT COUNT(*) FROM orders").fetchone()
+        (om,) = db.execute(
+            """SELECT COUNT(*) FROM orders JOIN customer
+               ON c_custkey = o_custkey WHERE c_acctbal > 900000"""
+        ).fetchone()
+        assert n == on  # every order survives a left join on its customer
+        assert matched == om
+
+    def test_semi_join_filter(self, ex, db):
+        nation = Filter(
+            TableScan("tpch", "nation", ("n_nationkey", "n_regionkey")),
+            ir.call("eq", ir.input_ref(1, T.BIGINT),
+                    ir.const(3, T.BIGINT)),  # EUROPE
+        )
+        cust = TableScan("tpch", "customer", ("c_custkey", "c_nationkey"))
+        semi = HashJoin(cust, nation, (1,), (0,), join_type="semi")
+        filt = Filter(semi, ir.input_ref(2, T.BOOLEAN))
+        agg = Aggregation(filt, (), (AggSpec("count_star", None),))
+        _, rows = ex.execute(Output(agg, ("n",)))
+        (expect,) = db.execute(
+            """SELECT COUNT(*) FROM customer WHERE c_nationkey IN
+               (SELECT n_nationkey FROM nation WHERE n_regionkey = 3)"""
+        ).fetchone()
+        assert rows[0][0] == expect
+
+
+class TestDictionaryAggregates:
+    def test_min_max_over_varchar_uses_value_order(self, ex, db):
+        """min/max over a dictionary column must compare values, not codes
+        (l_returnflag dictionary is ['A','R','N'] — code order != value
+        order)."""
+        scan = TableScan("tpch", "lineitem",
+                         ("l_linestatus", "l_returnflag"))
+        agg = Aggregation(
+            scan, (0,),
+            (AggSpec("min", 1), AggSpec("max", 1)),
+            capacity=8,
+        )
+        sort = Sort(agg, (SortKey(0),))
+        _, rows = ex.execute(Output(sort, ("ls", "min_rf", "max_rf")))
+        oracle = db.execute(
+            """SELECT l_linestatus, MIN(l_returnflag), MAX(l_returnflag)
+               FROM lineitem GROUP BY 1 ORDER BY 1"""
+        ).fetchall()
+        rows_match(rows, [tuple(r) for r in oracle])
+
+    def test_global_min_max_varchar(self, ex, db):
+        scan = TableScan("tpch", "orders", ("o_orderpriority",))
+        agg = Aggregation(
+            scan, (), (AggSpec("min", 0), AggSpec("max", 0))
+        )
+        _, rows = ex.execute(Output(agg, ("lo", "hi")))
+        oracle = db.execute(
+            "SELECT MIN(o_orderpriority), MAX(o_orderpriority) FROM orders"
+        ).fetchone()
+        assert rows[0] == tuple(oracle)
+
+
+class TestLimitsAndSort:
+    def test_limit_streaming(self, ex):
+        scan = TableScan("tpch", "orders", ("o_orderkey",))
+        _, rows = ex.execute(Output(Limit(scan, 17), ("k",)))
+        assert len(rows) == 17
+
+    def test_order_by_desc_with_topn_equivalence(self, ex, db):
+        scan = TableScan("tpch", "orders", ("o_orderkey", "o_totalprice"))
+        topn = TopN(scan, (SortKey(1, ascending=False), SortKey(0)), 5)
+        _, rows = ex.execute(Output(topn, ("k", "p")))
+        oracle = db.execute(
+            """SELECT o_orderkey, o_totalprice FROM orders
+               ORDER BY o_totalprice DESC, o_orderkey LIMIT 5"""
+        ).fetchall()
+        rows_match(rows, [tuple(r) for r in oracle])

@@ -1,0 +1,164 @@
+"""TPC-H generator tests: cardinalities, key domains, cross-table
+consistency (the properties queries rely on)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.tpch import (
+    CURRENTDATE,
+    MAX_LINES_PER_ORDER,
+    ORDERDATE_MAX,
+    STARTDATE,
+    TpchConnector,
+)
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(0.005)  # 750 customers, 7500 orders
+
+
+def _host(conn, table, cols=None):
+    pages = list(conn.pages(table, columns=cols, target_rows=1 << 20))
+    from presto_tpu.exec.executor import concat_all
+
+    page = concat_all(pages) if len(pages) > 1 else pages[0]
+    valid = np.asarray(page.valid)
+    out = {}
+    names = cols or conn.table_schema(table).column_names()
+    for name, blk in zip(names, page.blocks):
+        if isinstance(blk.data, tuple):
+            out[name] = (np.asarray(blk.data[0])[valid],
+                         np.asarray(blk.data[1])[valid])
+        else:
+            out[name] = np.asarray(blk.data)[valid]
+    return out
+
+
+def test_cardinalities(conn):
+    assert conn.n_customer == 750
+    assert conn.n_orders == 7500
+    assert conn.row_count("region") == 5
+    assert conn.row_count("nation") == 25
+    assert conn.row_count("partsupp") == conn.n_part * 4
+
+
+def test_orderkeys_sparse_and_unique(conn):
+    o = _host(conn, "orders", ["o_orderkey"])["o_orderkey"]
+    assert len(np.unique(o)) == conn.n_orders
+    # sparse pattern: keys mod 32 land in 1..8
+    assert ((o - 1) % 32 < 8).all()
+
+
+def test_custkey_skips_multiples_of_three(conn):
+    ck = _host(conn, "orders", ["o_custkey"])["o_custkey"]
+    assert (ck % 3 != 0).all()
+    assert ck.min() >= 1 and ck.max() <= conn.n_customer
+
+
+def test_lineitem_count_and_dates(conn):
+    li = _host(conn, "lineitem",
+               ["l_orderkey", "l_shipdate", "l_commitdate", "l_receiptdate",
+                "l_linenumber"])
+    n = len(li["l_orderkey"])
+    # expected ~4 lines/order
+    assert conn.n_orders * 3 < n < conn.n_orders * 5
+    assert (li["l_shipdate"] > STARTDATE).all()
+    assert (li["l_receiptdate"] > li["l_shipdate"]).all()
+    assert (li["l_linenumber"] >= 1).all()
+    assert (li["l_linenumber"] <= MAX_LINES_PER_ORDER).all()
+
+
+def test_orderdate_window(conn):
+    od = _host(conn, "orders", ["o_orderdate"])["o_orderdate"]
+    assert od.min() >= STARTDATE and od.max() <= ORDERDATE_MAX
+
+
+def test_chunking_invariance(conn):
+    """Column values are functions of global row keys, independent of split
+    boundaries (prereq for mesh sharding)."""
+    a = _host(conn, "orders", ["o_orderkey", "o_totalprice"])
+    pages = list(conn.pages("orders", ["o_orderkey", "o_totalprice"],
+                            target_rows=997))
+    ok = np.concatenate(
+        [np.asarray(p.block(0).data)[np.asarray(p.valid)] for p in pages]
+    )
+    tp = np.concatenate(
+        [np.asarray(p.block(1).data)[np.asarray(p.valid)] for p in pages]
+    )
+    np.testing.assert_array_equal(a["o_orderkey"], ok)
+    np.testing.assert_array_equal(a["o_totalprice"], tp)
+
+
+def test_totalprice_consistent_with_lineitems(conn):
+    li = _host(conn, "lineitem",
+               ["l_orderkey", "l_extendedprice", "l_discount", "l_tax"])
+    o = _host(conn, "orders", ["o_orderkey", "o_totalprice"])
+    charge = (
+        li["l_extendedprice"].astype(object)
+        * (100 - li["l_discount"])
+        * (100 + li["l_tax"])
+        + 5000
+    ) // 10000
+    sums = {}
+    for k, c in zip(li["l_orderkey"], charge):
+        sums[k] = sums.get(k, 0) + c
+    expect = np.array([sums[k] for k in o["o_orderkey"]], dtype=np.int64)
+    np.testing.assert_array_equal(o["o_totalprice"], expect)
+
+
+def test_lineitem_suppkey_join_consistent(conn):
+    """l_(partkey, suppkey) always exists in partsupp (Q9 prerequisite)."""
+    li = _host(conn, "lineitem", ["l_partkey", "l_suppkey"])
+    ps = _host(conn, "partsupp", ["ps_partkey", "ps_suppkey"])
+    pairs = set(zip(ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist()))
+    sample = list(zip(li["l_partkey"].tolist(),
+                      li["l_suppkey"].tolist()))[:2000]
+    assert all(p in pairs for p in sample)
+
+
+def test_retailprice_formula(conn):
+    p = _host(conn, "part", ["p_partkey", "p_retailprice"])
+    pk = p["p_partkey"]
+    expect = 90000 + (pk // 10) % 20001 + 100 * (pk % 1000)
+    np.testing.assert_array_equal(p["p_retailprice"], expect)
+
+
+def test_returnflag_linestatus_rule(conn):
+    li = _host(conn, "lineitem",
+               ["l_shipdate", "l_receiptdate", "l_returnflag",
+                "l_linestatus"])
+    pages = list(conn.pages("lineitem",
+                            ["l_returnflag", "l_linestatus",
+                             "l_shipdate", "l_receiptdate"]))
+    # decode through dictionaries
+    from presto_tpu.exec.executor import concat_all
+
+    page = concat_all(pages) if len(pages) > 1 else pages[0]
+    rows = page.to_pylist()
+    for rf, ls, ship, receipt in rows[:5000]:
+        if receipt <= CURRENTDATE:
+            assert rf in ("A", "R")
+        else:
+            assert rf == "N"
+        assert ls == ("O" if ship > CURRENTDATE else "F")
+
+
+def test_nation_region_fixed(conn):
+    n = list(conn.pages("nation"))[0].to_pylist()
+    assert len(n) == 25
+    assert n[0][1] == "ALGERIA" and n[24][1] == "UNITED STATES"
+    r = list(conn.pages("region"))[0].to_pylist()
+    assert [row[1] for row in r] == [
+        "AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"
+    ]
+
+
+def test_pattern_dictionary_roundtrip(conn):
+    c = _host(conn, "customer", ["c_custkey"])
+    pages = list(conn.pages("customer", ["c_custkey", "c_name"]))
+    from presto_tpu.exec.executor import concat_all
+
+    page = concat_all(pages) if len(pages) > 1 else pages[0]
+    for ck, name in page.to_pylist()[:100]:
+        assert name == f"Customer#{ck:09d}"
