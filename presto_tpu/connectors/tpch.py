@@ -133,6 +133,9 @@ class PatternDictionary(Dictionary):
     def sort_rank(self) -> np.ndarray:
         return np.arange(self.count, dtype=np.int32)
 
+    def has_duplicate_values(self) -> bool:
+        return False  # unique by construction, never materialize
+
 
 class _PatternIndex:
     """Mapping-protocol shim so code paths touching dictionary._index keep

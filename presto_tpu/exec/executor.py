@@ -135,6 +135,13 @@ class Executor:
             if node.join_type in ("semi", "anti"):
                 return left + [T.BOOLEAN]
             return left + self.output_types(node.right)
+        if isinstance(node, P.CrossJoin):
+            return self.output_types(node.left) + self.output_types(
+                node.right)
+        if isinstance(node, P.UniqueId):
+            return self.output_types(node.source) + [T.BIGINT]
+        if isinstance(node, P.Union):
+            return self.output_types(node.sources[0])
         raise TypeError(f"unknown node: {node!r}")
 
     # ------------------------------------------------------------- execute
@@ -174,6 +181,35 @@ class Executor:
             return
         if isinstance(node, P.HashJoin):
             yield from self._exec_join(node)
+            return
+        if isinstance(node, P.CrossJoin):
+            right_pages = list(self.pages(node.right))
+            if not right_pages:
+                return
+            build_all = concat_all(right_pages)
+            build = compact_page(
+                build_all, _next_pow2(int(build_all.num_rows()))
+            )
+            fn = self._jit(
+                ("cross", node, build.capacity),
+                _cross_join_page,
+            )
+            for page in self.pages(node.left):
+                yield fn(page, build)
+            return
+        if isinstance(node, P.UniqueId):
+            offset = 0
+            for page in self.pages(node.source):
+                ids = Block(
+                    data=jnp.arange(page.capacity, dtype=jnp.int64) + offset,
+                    type=T.BIGINT,
+                )
+                offset += page.capacity
+                yield Page(blocks=page.blocks + (ids,), valid=page.valid)
+            return
+        if isinstance(node, P.Union):
+            for src in node.sources:
+                yield from self.pages(src)
             return
         if isinstance(node, (P.Sort, P.TopN)):
             pages = list(self.pages(node.source))
@@ -615,6 +651,18 @@ def _probe_join_page(left_keys, right_keys, join_type, page: Page,
         )
         out = concat_all([out, pad])
     return out, m.build_matched, m.overflow
+
+
+def _cross_join_page(page: Page, build: Page) -> Page:
+    nb = build.capacity
+    out_cap = page.capacity * nb
+    idx = jnp.arange(out_cap, dtype=jnp.int64)
+    li = idx // nb
+    ri = idx % nb
+    valid = page.valid[li] & build.valid[ri]
+    left = gather_rows(page, li, valid)
+    right = gather_rows(build, ri, valid)
+    return Page(blocks=left.blocks + right.blocks, valid=valid)
 
 
 def _semi_join_page(left_keys, right_keys, page: Page, build: Page) -> Page:

@@ -117,6 +117,42 @@ class HashJoin(PhysicalNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class CrossJoin(PhysicalNode):
+    """Nested-loop cross product (reference:
+    operator/NestedLoopJoinOperator.java). Output left then right channels.
+    Only safe when one side is small; the planner uses it as a last resort
+    for edge-less join groups."""
+
+    left: PhysicalNode
+    right: PhysicalNode
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class UniqueId(PhysicalNode):
+    """Append a bigint channel holding a query-unique row id (reference:
+    AssignUniqueIdOperator [M]); used by general EXISTS decorrelation."""
+
+    source: PhysicalNode
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Union(PhysicalNode):
+    """UNION ALL: stream children in order (reference: the planner's
+    UnionNode collapsing into a shared LocalExchange)."""
+
+    sources: Tuple[PhysicalNode, ...]
+
+    def children(self):
+        return self.sources
+
+
+@dataclasses.dataclass(frozen=True)
 class Sort(PhysicalNode):
     source: PhysicalNode
     keys: Tuple[SortKey, ...]

@@ -96,6 +96,19 @@ _INT_RANK = {T.TinyintType: 0, T.SmallintType: 1, T.IntegerType: 2,
              T.BigintType: 3}
 
 
+def _short_decimal(p: int, s: int) -> T.DecimalType:
+    """Computed decimals are physically scaled i64 (presto_tpu/expr design:
+    TPU-side decimal arithmetic never widens to limbs; only aggregate sums
+    produce long-decimal limb blocks). Declare the honest physical
+    precision — capped at 18 — so downstream layers can tell i64 decimals
+    from limb decimals by type. Reference divergence: the reference widens
+    to decimal(38) and raises on overflow; we wrap at i64 (SURVEY §8.2.4:
+    TPC-H money stays far below 2^63)."""
+    if s > 18:
+        raise TypeError(f"decimal scale {s} beyond i64 arithmetic range")
+    return T.DecimalType(max(min(p, 18), s, 1), s)
+
+
 def _numeric_result(a: T.SqlType, b: T.SqlType, op: str) -> T.SqlType:
     if isinstance(a, T.DoubleType) or isinstance(b, T.DoubleType):
         return T.DOUBLE
@@ -105,22 +118,23 @@ def _numeric_result(a: T.SqlType, b: T.SqlType, op: str) -> T.SqlType:
         return T.REAL
     if isinstance(a, T.DecimalType) or isinstance(b, T.DecimalType):
         da, db = T._to_decimal(a), T._to_decimal(b)
-        # Reference: spi/type/DecimalType + DecimalOperators result rules
+        # Reference: spi/type/DecimalType + DecimalOperators result rules,
+        # with precision capped to the i64 physical representation
         if op in ("add", "subtract"):
             s = max(da.scale, db.scale)
             p = max(da.precision - da.scale, db.precision - db.scale) + s + 1
-            return T.DecimalType(min(38, p), s)
+            return _short_decimal(p, s)
         if op == "multiply":
-            return T.DecimalType(min(38, da.precision + db.precision),
-                                 min(37, da.scale + db.scale))
+            return _short_decimal(da.precision + db.precision,
+                                  da.scale + db.scale)
         if op == "divide":
             s = max(da.scale, db.scale)
             p = da.precision + db.scale + max(0, db.scale - da.scale)
-            return T.DecimalType(min(38, max(p, s + 1)), s)
+            return _short_decimal(max(p, s + 1), s)
         if op == "modulus":
             s = max(da.scale, db.scale)
             p = min(da.precision - da.scale, db.precision - db.scale) + s
-            return T.DecimalType(min(38, max(p, s + 1)), s)
+            return _short_decimal(max(p, s + 1), s)
     if type(a) in _INT_RANK and type(b) in _INT_RANK:
         return a if _INT_RANK[type(a)] >= _INT_RANK[type(b)] else b
     raise TypeError(f"no numeric result for {a} {op} {b}")

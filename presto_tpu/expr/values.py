@@ -127,6 +127,23 @@ def cast_data(xp, val: Val, to: T.SqlType, cap: int):
     if isinstance(src, T.UnknownType):  # typed NULL literal
         z = xp.zeros((cap,), dtype=np.dtype(to.numpy_dtype))
         return z, xp.ones((cap,), dtype=bool)
+    if isinstance(data, tuple):
+        # long-decimal limbs (base-2^64 two's complement). Lossless only
+        # into double below 2^53 of unscaled magnitude — the planner casts
+        # long-decimal aggregate outputs to double before further
+        # arithmetic (documented divergence from the reference's exact
+        # decimal(38) math).
+        hi, lo = data
+        if T.is_floating(to):
+            f = (
+                hi.astype(np.float64) * float(2**64)
+                + xp.where(lo >= 0, lo.astype(np.float64),
+                           lo.astype(np.float64) + float(2**64))
+            )
+            if isinstance(src, T.DecimalType):
+                f = f / float(10**src.scale)
+            return f.astype(np.dtype(to.numpy_dtype)), nulls
+        raise TypeError(f"unsupported cast from long decimal to {to}")
 
     if isinstance(to, T.DecimalType):
         if isinstance(src, T.DecimalType):

@@ -53,6 +53,10 @@ def equality_encoding(block: Block) -> List[jnp.ndarray]:
     encoding, documented divergence: SQL `=` on NaN is false, but GROUP BY /
     join on NaN grouping-equal matches the reference's distinct-value
     semantics, which treat NaN as one value).
+
+    Dictionary columns canonicalize codes by *value* through a static host
+    lut — dictionaries produced by string transforms (substr/lower/...)
+    carry duplicate values, so raw codes are not equality-faithful.
     """
     t = block.type
     if isinstance(block.data, tuple):  # long decimal limbs
@@ -62,6 +66,20 @@ def equality_encoding(block: Block) -> List[jnp.ndarray]:
         return [_float_order_u64(block.data)]
     if isinstance(t, T.BooleanType):
         return [block.data.astype(jnp.uint64)]
+    if (
+        block.dictionary is not None
+        and len(block.dictionary)
+        and block.dictionary.has_duplicate_values()
+    ):
+        import numpy as np
+
+        values = block.dictionary.values
+        first: dict = {}
+        lut = np.empty(len(values), dtype=np.uint64)
+        for i, v in enumerate(values):
+            lut[i] = first.setdefault(v, i)
+        codes = jnp.clip(block.data, 0, len(values) - 1)
+        return [jnp.asarray(lut)[codes]]
     return [block.data.astype(jnp.int64).astype(jnp.uint64)]
 
 

@@ -86,6 +86,13 @@ class Dictionary:
         rank[order] = np.arange(len(self.values), dtype=np.int32)
         return rank
 
+    def has_duplicate_values(self) -> bool:
+        """Transform-produced dictionaries (substr/lower/...) may map many
+        codes to one value; equality on raw codes is then wrong (see
+        ops/keys.equality_encoding). Subclasses with unique-by-construction
+        values override to False without materializing."""
+        return len(self._index) < len(self.values)
+
     def __repr__(self) -> str:  # pragma: no cover
         head = ", ".join(repr(v) for v in self.values[:4])
         more = "..." if len(self.values) > 4 else ""

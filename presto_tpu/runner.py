@@ -1,0 +1,107 @@
+"""LocalRunner: full parse → plan → execute pipeline in one process.
+
+Reference: presto-main testing/LocalQueryRunner.java — the single-JVM
+engine harness with no HTTP and no scheduler, used by planner tests and
+benchmarks. Ours is additionally the building block the coordinator wraps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.connectors.base import Connector
+from presto_tpu.exec import plan as P
+from presto_tpu.exec.executor import Executor
+from presto_tpu.exec.prune import prune_plan
+from presto_tpu.sql import ast_nodes as N
+from presto_tpu.sql.parser import parse
+from presto_tpu.sql.planner import Planner
+
+
+@dataclasses.dataclass
+class QueryResult:
+    column_names: List[str]
+    rows: List[tuple]
+
+
+class LocalRunner:
+    def __init__(
+        self,
+        catalogs: Dict[str, Connector],
+        default_catalog: str = "tpch",
+        page_rows: int = 1 << 18,
+    ):
+        self.catalogs = catalogs
+        self.default_catalog = default_catalog
+        self.executor = Executor(catalogs, page_rows=page_rows)
+
+    def _planner(self) -> Planner:
+        return Planner(
+            self.catalogs,
+            self.default_catalog,
+            scalar_executor=lambda node: self.executor.execute(node)[1],
+        )
+
+    def plan(self, sql: str) -> P.Output:
+        stmt = parse(sql)
+        if isinstance(stmt, N.Explain):
+            stmt = stmt.query
+        out = self._planner().plan_statement(stmt)
+        return prune_plan(out, self.catalogs)
+
+    def execute(self, sql: str) -> QueryResult:
+        stmt = parse(sql)
+        if isinstance(stmt, N.Explain):
+            out = self.plan(sql)
+            text = explain_text(out)
+            return QueryResult(["Query Plan"],
+                               [(line,) for line in text.splitlines()])
+        out = self.plan(sql)
+        names, rows = self.executor.execute(out)
+        return QueryResult(list(names or []), rows)
+
+
+def explain_text(node: P.PhysicalNode, indent: int = 0) -> str:
+    """Plan rendering (reference: sql/planner/planPrinter/PlanPrinter)."""
+    pad = "    " * indent
+    if isinstance(node, P.Output):
+        line = f"{pad}Output[{', '.join(node.names)}]"
+    elif isinstance(node, P.TableScan):
+        line = (f"{pad}TableScan[{node.catalog}.{node.table} "
+                f"cols={list(node.columns)}]")
+    elif isinstance(node, P.Filter):
+        line = f"{pad}Filter[{node.predicate!r}]"
+    elif isinstance(node, P.Project):
+        line = f"{pad}Project[{len(node.exprs)} cols]"
+    elif isinstance(node, P.Aggregation):
+        fns = ", ".join(
+            f"{s.function}({'' if s.channel is None else '#%d' % s.channel})"
+            for s in node.aggregates
+        )
+        line = (f"{pad}Aggregate[keys={list(node.group_channels)} "
+                f"aggs=[{fns}]]")
+    elif isinstance(node, P.HashJoin):
+        line = (f"{pad}{node.join_type.capitalize()}Join"
+                f"[probe={list(node.left_keys)} "
+                f"build={list(node.right_keys)}]")
+    elif isinstance(node, P.CrossJoin):
+        line = f"{pad}CrossJoin"
+    elif isinstance(node, P.TopN):
+        line = f"{pad}TopN[{node.limit} by {list(node.keys)}]"
+    elif isinstance(node, P.Sort):
+        line = f"{pad}Sort[{list(node.keys)}]"
+    elif isinstance(node, P.Limit):
+        line = f"{pad}Limit[{node.count}]"
+    elif isinstance(node, P.UniqueId):
+        line = f"{pad}AssignUniqueId"
+    elif isinstance(node, P.Union):
+        line = f"{pad}Union"
+    elif isinstance(node, P.Values):
+        line = f"{pad}Values[{len(node.rows)} rows]"
+    else:
+        line = f"{pad}{type(node).__name__}"
+    parts = [line]
+    for child in node.children():
+        parts.append(explain_text(child, indent + 1))
+    return "\n".join(parts)

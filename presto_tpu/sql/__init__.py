@@ -1,0 +1,14 @@
+"""SQL frontend: tokenizer, recursive-descent parser, analyzer/planner.
+
+Reference: presto-parser (ANTLR SqlBase.g4 grammar -> sql/tree/* AST,
+~150 node classes) and presto-main sql/analyzer + sql/planner. Per SURVEY
+§8.1.4 we do NOT port the grammar wholesale: this is a hand-written
+recursive-descent/Pratt parser over the SQL-92+ subset that TPC-H/TPC-DS
+exercise, feeding a planner that lowers straight to typed physical plans
+with predicate pushdown, column pruning, join-key extraction, and subquery
+decorrelation folded into the lowering (the reference spreads these across
+PlanOptimizers passes; ours are integrated because the plan space is
+narrower).
+"""
+
+from presto_tpu.sql.parser import parse  # noqa: F401

@@ -1,0 +1,228 @@
+"""SQL AST.
+
+Reference: presto-parser sql/tree/* (Query, QuerySpecification, Select,
+Join, comparison/arithmetic expression nodes, ...). Dataclasses, one per
+syntactic form; the planner consumes these directly. Names mirror the
+reference's where a node corresponds 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+# ------------------------------------------------------------- expressions
+
+@dataclasses.dataclass(frozen=True)
+class Identifier(Node):
+    """Column reference, possibly qualified (t.col)."""
+
+    parts: Tuple[str, ...]
+
+    @property
+    def name(self) -> str:
+        return self.parts[-1]
+
+    @property
+    def qualifier(self) -> Optional[str]:
+        return self.parts[-2] if len(self.parts) > 1 else None
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Node):
+    """kind: 'long' | 'double' | 'decimal' | 'string' | 'boolean' | 'null'
+    | 'date' | 'interval'. value for interval: (amount, unit)."""
+
+    kind: str
+    value: object
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Node):
+    qualifier: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str  # '-' | '+' | 'not'
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str  # + - * / % = <> < <= > >= and or
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Node):
+    value: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Node):
+    value: Node
+    items: Tuple[Node, ...]
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Node):
+    value: Node
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Node):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Node):
+    value: Node
+    pattern: Node
+    escape: Optional[Node] = None
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Node):
+    value: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Node):
+    """Searched or simple CASE (operand not None => simple)."""
+
+    operand: Optional[Node]
+    whens: Tuple[Tuple[Node, Node], ...]
+    default: Optional[Node]
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Node):
+    value: Node
+    type_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionCall(Node):
+    name: str
+    args: Tuple[Node, ...]
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract(Node):
+    field: str  # year | month | day | ...
+    value: Node
+
+
+# --------------------------------------------------------------- relations
+
+@dataclasses.dataclass(frozen=True)
+class Table(Node):
+    parts: Tuple[str, ...]  # [catalog.][schema.]table
+
+
+@dataclasses.dataclass(frozen=True)
+class AliasedRelation(Node):
+    relation: Node
+    alias: str
+    column_aliases: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRelation(Node):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinRelation(Node):
+    join_type: str  # inner | left | right | full | cross
+    left: Node
+    right: Node
+    on: Optional[Node] = None
+
+
+# ----------------------------------------------------------------- queries
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node  # expression or Star
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class QuerySpec(Node):
+    """One SELECT block (reference: sql/tree/QuerySpecification)."""
+
+    select: Tuple[SelectItem, ...]
+    distinct: bool
+    from_: Tuple[Node, ...]  # comma-separated relations (implicit cross)
+    where: Optional[Node]
+    group_by: Tuple[Node, ...]
+    having: Optional[Node]
+    order_by: Tuple[OrderItem, ...]
+    limit: Optional[int]
+    offset: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class SetOp(Node):
+    """UNION [ALL] / INTERSECT / EXCEPT chains."""
+
+    op: str  # union | union_all | intersect | except
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class With(Node):
+    name: str
+    column_names: Tuple[str, ...]
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Query(Node):
+    """Top: optional WITH list + body (QuerySpec or SetOp) + query-level
+    ORDER BY/LIMIT/OFFSET (SQL binds these to the whole body, including
+    across UNION branches — the QuerySpec never owns them)."""
+
+    body: Node
+    withs: Tuple[With, ...] = ()
+    order_by: Tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Explain(Node):
+    query: Query
+    analyze: bool = False

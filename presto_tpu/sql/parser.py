@@ -1,0 +1,588 @@
+"""Hand-written SQL tokenizer + recursive-descent parser.
+
+Reference: presto-parser's ANTLR grammar
+(presto-parser/src/main/antlr4/.../SqlBase.g4) and AstBuilder. Deliberately
+NOT a grammar port (SURVEY §8.1.4): a compact Pratt parser covering the
+SQL-92+ subset TPC-H/TPC-DS use — SELECT blocks with joins, subqueries
+(FROM/scalar/IN/EXISTS), WITH, set operations, CASE, CAST, EXTRACT,
+LIKE/BETWEEN/IN, date/interval literals, EXPLAIN.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from presto_tpu.sql import ast_nodes as N
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?
+      |\d+[eE][+-]?\d+|\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<dquoted>"(?:[^"]|"")*")
+  | (?P<name>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "exists", "between", "like",
+    "escape", "is", "null", "case", "when", "then", "else", "end", "cast",
+    "extract", "distinct", "all", "union", "intersect", "except", "join",
+    "inner", "left", "right", "full", "outer", "cross", "on", "using",
+    "with", "asc", "desc", "nulls", "first", "last", "date", "time",
+    "timestamp", "interval", "true", "false", "explain", "analyze",
+    "substring", "for",
+}
+
+
+class Token:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind, value, pos):
+        self.kind = kind  # number | string | name | keyword | op | eof
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):  # pragma: no cover
+        return f"Token({self.kind}, {self.value!r})"
+
+
+def tokenize(text: str) -> List[Token]:
+    out: List[Token] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m:
+            raise SqlSyntaxError(
+                f"unexpected character {text[pos]!r} at {pos}"
+            )
+        pos = m.end()
+        if m.lastgroup == "ws":
+            continue
+        val = m.group()
+        if m.lastgroup == "name":
+            low = val.lower()
+            out.append(
+                Token("keyword" if low in KEYWORDS else "name", low, m.start())
+            )
+        elif m.lastgroup == "string":
+            out.append(
+                Token("string", val[1:-1].replace("''", "'"), m.start())
+            )
+        elif m.lastgroup == "dquoted":
+            out.append(
+                Token("name", val[1:-1].replace('""', '"'), m.start())
+            )
+        else:
+            out.append(Token(m.lastgroup, val, m.start()))
+    out.append(Token("eof", None, pos))
+    return out
+
+
+class SqlSyntaxError(ValueError):
+    pass
+
+
+# Pratt binding powers for binary operators
+_PRECEDENCE = {
+    "or": 1,
+    "and": 2,
+    # NOT handled as prefix at level 3
+    "=": 4, "<>": 4, "!=": 4, "<": 4, "<=": 4, ">": 4, ">=": 4,
+    # BETWEEN/IN/LIKE/IS handled at level 4 specially
+    "||": 5,
+    "+": 6, "-": 6,
+    "*": 7, "/": 7, "%": 7,
+}
+
+
+class Parser:
+    def __init__(self, tokens: List[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # ------------------------------------------------------------ cursor
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_keyword(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "keyword" and t.value in kws
+
+    def accept_keyword(self, *kws: str) -> bool:
+        if self.at_keyword(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_keyword(self, kw: str):
+        if not self.accept_keyword(kw):
+            raise SqlSyntaxError(
+                f"expected {kw.upper()} at position {self.peek().pos}, "
+                f"found {self.peek().value!r}"
+            )
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "op" and t.value == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            raise SqlSyntaxError(
+                f"expected {op!r} at position {self.peek().pos}, found "
+                f"{self.peek().value!r}"
+            )
+
+    def expect_name(self) -> str:
+        t = self.next()
+        if t.kind not in ("name", "keyword"):
+            raise SqlSyntaxError(f"expected identifier, found {t.value!r}")
+        return t.value
+
+    # ----------------------------------------------------------- toplevel
+    def parse_statement(self) -> N.Node:
+        if self.accept_keyword("explain"):
+            analyze = self.accept_keyword("analyze")
+            q = self.parse_query()
+            self._finish()
+            return N.Explain(q, analyze)
+        q = self.parse_query()
+        self._finish()
+        return q
+
+    def _finish(self):
+        self.accept_op(";")
+        if self.peek().kind != "eof":
+            raise SqlSyntaxError(
+                f"trailing input at {self.peek().pos}: {self.peek().value!r}"
+            )
+
+    def parse_query(self) -> N.Query:
+        withs: List[N.With] = []
+        if self.accept_keyword("with"):
+            while True:
+                name = self.expect_name()
+                col_aliases: Tuple[str, ...] = ()
+                if self.accept_op("("):
+                    cols = [self.expect_name()]
+                    while self.accept_op(","):
+                        cols.append(self.expect_name())
+                    self.expect_op(")")
+                    col_aliases = tuple(cols)
+                self.expect_keyword("as")
+                self.expect_op("(")
+                sub = self.parse_query()
+                self.expect_op(")")
+                withs.append(N.With(name, col_aliases, sub))
+                if not self.accept_op(","):
+                    break
+        body = self.parse_set_expr()
+        # ORDER BY / LIMIT / OFFSET bind to the whole body (incl. across
+        # UNION branches) — never to an individual set-op operand
+        order_by: Tuple[N.OrderItem, ...] = ()
+        limit = None
+        offset = 0
+        if self.at_keyword("order"):
+            order_by = self.parse_order_by()
+        if self.accept_keyword("limit"):
+            limit = int(self.next().value)
+        if self.accept_keyword("offset"):
+            offset = int(self.next().value)
+        return N.Query(body=body, withs=tuple(withs), order_by=order_by,
+                       limit=limit, offset=offset)
+
+    def parse_set_expr(self) -> N.Node:
+        left = self.parse_query_term()
+        while self.at_keyword("union", "intersect", "except"):
+            op = self.next().value
+            if op == "union":
+                op = "union_all" if self.accept_keyword("all") else "union"
+                self.accept_keyword("distinct")
+            else:
+                self.accept_keyword("all", "distinct")
+            right = self.parse_query_term()
+            left = N.SetOp(op, left, right)
+        return left
+
+    def parse_query_term(self) -> N.Node:
+        if self.accept_op("("):
+            q = self.parse_query()
+            self.expect_op(")")
+            return q
+        return self.parse_query_spec()
+
+    def parse_query_spec(self) -> N.QuerySpec:
+        self.expect_keyword("select")
+        distinct = False
+        if self.accept_keyword("distinct"):
+            distinct = True
+        else:
+            self.accept_keyword("all")
+        select = [self.parse_select_item()]
+        while self.accept_op(","):
+            select.append(self.parse_select_item())
+
+        from_: List[N.Node] = []
+        if self.accept_keyword("from"):
+            from_.append(self.parse_relation())
+            while self.accept_op(","):
+                from_.append(self.parse_relation())
+
+        where = self.parse_expr() if self.accept_keyword("where") else None
+
+        group_by: List[N.Node] = []
+        if self.accept_keyword("group"):
+            self.expect_keyword("by")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+
+        having = self.parse_expr() if self.accept_keyword("having") else None
+
+        return N.QuerySpec(
+            select=tuple(select), distinct=distinct, from_=tuple(from_),
+            where=where, group_by=tuple(group_by), having=having,
+            order_by=(), limit=None, offset=0,
+        )
+
+    def parse_order_by(self) -> Tuple[N.OrderItem, ...]:
+        self.expect_keyword("order")
+        self.expect_keyword("by")
+        items = [self.parse_order_item()]
+        while self.accept_op(","):
+            items.append(self.parse_order_item())
+        return tuple(items)
+
+    def parse_order_item(self) -> N.OrderItem:
+        e = self.parse_expr()
+        asc = True
+        if self.accept_keyword("desc"):
+            asc = False
+        else:
+            self.accept_keyword("asc")
+        nulls_first = None
+        if self.accept_keyword("nulls"):
+            if self.accept_keyword("first"):
+                nulls_first = True
+            else:
+                self.expect_keyword("last")
+                nulls_first = False
+        return N.OrderItem(e, asc, nulls_first)
+
+    def parse_select_item(self) -> N.SelectItem:
+        if self.accept_op("*"):
+            return N.SelectItem(N.Star())
+        # qualified star: t.*
+        if (
+            self.peek().kind == "name"
+            and self.peek(1).kind == "op" and self.peek(1).value == "."
+            and self.peek(2).kind == "op" and self.peek(2).value == "*"
+        ):
+            q = self.next().value
+            self.next()
+            self.next()
+            return N.SelectItem(N.Star(qualifier=q))
+        e = self.parse_expr()
+        alias = None
+        if self.accept_keyword("as"):
+            alias = self.expect_name()
+        elif self.peek().kind == "name":
+            alias = self.next().value
+        return N.SelectItem(e, alias)
+
+    # ---------------------------------------------------------- relations
+    def parse_relation(self) -> N.Node:
+        left = self.parse_aliased_relation()
+        while True:
+            if self.accept_keyword("cross"):
+                self.expect_keyword("join")
+                right = self.parse_aliased_relation()
+                left = N.JoinRelation("cross", left, right)
+                continue
+            jt = None
+            if self.accept_keyword("inner"):
+                jt = "inner"
+                self.expect_keyword("join")
+            elif self.at_keyword("left", "right", "full"):
+                jt = self.next().value
+                self.accept_keyword("outer")
+                self.expect_keyword("join")
+            elif self.accept_keyword("join"):
+                jt = "inner"
+            if jt is None:
+                return left
+            right = self.parse_aliased_relation()
+            on = None
+            if self.accept_keyword("on"):
+                on = self.parse_expr()
+            elif self.accept_keyword("using"):
+                self.expect_op("(")
+                cols = [self.expect_name()]
+                while self.accept_op(","):
+                    cols.append(self.expect_name())
+                self.expect_op(")")
+                on = ("using", tuple(cols))
+            left = N.JoinRelation(jt, left, right, on)
+
+    def parse_aliased_relation(self) -> N.Node:
+        if self.accept_op("("):
+            if self.at_keyword("select", "with"):
+                rel: N.Node = N.SubqueryRelation(self.parse_query())
+            else:
+                rel = self.parse_relation()
+            self.expect_op(")")
+        else:
+            parts = [self.expect_name()]
+            while self.accept_op("."):
+                parts.append(self.expect_name())
+            rel = N.Table(tuple(parts))
+        alias = None
+        cols: Tuple[str, ...] = ()
+        if self.accept_keyword("as"):
+            alias = self.expect_name()
+        elif self.peek().kind == "name":
+            alias = self.next().value
+        if alias and self.accept_op("("):
+            cs = [self.expect_name()]
+            while self.accept_op(","):
+                cs.append(self.expect_name())
+            self.expect_op(")")
+            cols = tuple(cs)
+        if alias:
+            return N.AliasedRelation(rel, alias, cols)
+        return rel
+
+    # -------------------------------------------------------- expressions
+    def parse_expr(self, min_bp: int = 0) -> N.Node:
+        left = self.parse_prefix()
+        while True:
+            t = self.peek()
+            # NOT BETWEEN / NOT IN / NOT LIKE
+            if t.kind == "keyword" and t.value == "not" and self.peek(
+                    1).kind == "keyword" and self.peek(1).value in (
+                    "between", "in", "like"):
+                if 4 < min_bp:
+                    return left
+                self.next()
+                left = self.parse_postfix_predicate(left, negated=True)
+                continue
+            if t.kind == "keyword" and t.value in ("between", "in", "like"):
+                if 4 < min_bp:
+                    return left
+                left = self.parse_postfix_predicate(left, negated=False)
+                continue
+            if t.kind == "keyword" and t.value == "is":
+                if 4 < min_bp:
+                    return left
+                self.next()
+                negated = self.accept_keyword("not")
+                self.expect_keyword("null")
+                left = N.IsNull(left, negated)
+                continue
+            op = None
+            if t.kind == "op" and t.value in _PRECEDENCE:
+                op = t.value
+            elif t.kind == "keyword" and t.value in ("and", "or"):
+                op = t.value
+            if op is None:
+                return left
+            bp = _PRECEDENCE[op]
+            if bp < min_bp:
+                return left
+            self.next()
+            right = self.parse_expr(bp + 1)
+            if op == "!=":
+                op = "<>"
+            left = N.BinaryOp(op, left, right)
+
+    def parse_postfix_predicate(self, left: N.Node, negated: bool) -> N.Node:
+        if self.accept_keyword("between"):
+            low = self.parse_expr(5)
+            self.expect_keyword("and")
+            high = self.parse_expr(5)
+            return N.Between(left, low, high, negated)
+        if self.accept_keyword("in"):
+            self.expect_op("(")
+            if self.at_keyword("select", "with"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return N.InSubquery(left, q, negated)
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            return N.InList(left, tuple(items), negated)
+        if self.accept_keyword("like"):
+            pattern = self.parse_expr(5)
+            escape = None
+            if self.accept_keyword("escape"):
+                escape = self.parse_expr(5)
+            return N.Like(left, pattern, escape, negated)
+        raise SqlSyntaxError("bad postfix predicate")  # pragma: no cover
+
+    def parse_prefix(self) -> N.Node:
+        t = self.peek()
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            if self.at_keyword("select", "with"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return N.ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "op" and t.value in ("-", "+"):
+            self.next()
+            return N.UnaryOp(t.value, self.parse_expr(8))
+        if t.kind == "keyword":
+            return self.parse_keyword_expr()
+        if t.kind == "number":
+            self.next()
+            v = t.value
+            if "." in v or "e" in v.lower():
+                # exact decimal literal unless exponent present (reference:
+                # parser DecimalLiteral vs DoubleLiteral)
+                if "e" in v.lower():
+                    return N.Literal("double", float(v))
+                return N.Literal("decimal", v)
+            return N.Literal("long", int(v))
+        if t.kind == "string":
+            self.next()
+            return N.Literal("string", t.value)
+        if t.kind == "name":
+            return self.parse_name_expr()
+        raise SqlSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_keyword_expr(self) -> N.Node:
+        if self.accept_keyword("not"):
+            return N.UnaryOp("not", self.parse_expr(3))
+        if self.accept_keyword("exists"):
+            self.expect_op("(")
+            q = self.parse_query()
+            self.expect_op(")")
+            return N.Exists(q)
+        if self.accept_keyword("true"):
+            return N.Literal("boolean", True)
+        if self.accept_keyword("false"):
+            return N.Literal("boolean", False)
+        if self.accept_keyword("null"):
+            return N.Literal("null", None)
+        if self.accept_keyword("date"):
+            lit = self.next()
+            if lit.kind != "string":
+                raise SqlSyntaxError("DATE literal needs a string")
+            return N.Literal("date", lit.value)
+        if self.accept_keyword("timestamp"):
+            lit = self.next()
+            return N.Literal("timestamp", lit.value)
+        if self.accept_keyword("interval"):
+            sign = -1 if self.accept_op("-") else 1
+            lit = self.next()
+            if lit.kind != "string":
+                raise SqlSyntaxError("INTERVAL literal needs a string")
+            unit = self.expect_name()
+            return N.Literal("interval", (sign * int(lit.value), unit))
+        if self.accept_keyword("case"):
+            operand = None
+            if not self.at_keyword("when"):
+                operand = self.parse_expr()
+            whens = []
+            while self.accept_keyword("when"):
+                cond = self.parse_expr()
+                self.expect_keyword("then")
+                whens.append((cond, self.parse_expr()))
+            default = None
+            if self.accept_keyword("else"):
+                default = self.parse_expr()
+            self.expect_keyword("end")
+            return N.Case(operand, tuple(whens), default)
+        if self.accept_keyword("cast"):
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_keyword("as")
+            type_name = self._parse_type_name()
+            self.expect_op(")")
+            return N.Cast(e, type_name)
+        if self.accept_keyword("extract"):
+            self.expect_op("(")
+            field = self.expect_name()
+            self.expect_keyword("from")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return N.Extract(field, e)
+        if self.accept_keyword("substring"):
+            # substring(x from a [for b]) and substring(x, a [, b])
+            self.expect_op("(")
+            e = self.parse_expr()
+            args = [e]
+            if self.accept_keyword("from"):
+                args.append(self.parse_expr())
+                if self.accept_keyword("for"):
+                    args.append(self.parse_expr())
+            else:
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            return N.FunctionCall("substr", tuple(args))
+        # keywords usable as function names / identifiers (left, right...)
+        return self.parse_name_expr()
+
+    def _parse_type_name(self) -> str:
+        name = self.expect_name()
+        if self.accept_op("("):
+            args = [self.next().value]
+            while self.accept_op(","):
+                args.append(self.next().value)
+            self.expect_op(")")
+            return f"{name}({','.join(str(a) for a in args)})"
+        # two-word types
+        if name == "double" and self.accept_keyword("precision"):
+            return "double"
+        return name
+
+    def parse_name_expr(self) -> N.Node:
+        t = self.next()
+        if t.kind not in ("name", "keyword"):
+            raise SqlSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+        name = t.value
+        # function call?
+        if self.peek().kind == "op" and self.peek().value == "(":
+            self.next()
+            if self.accept_op("*"):
+                self.expect_op(")")
+                return N.FunctionCall(name, (), is_star=True)
+            distinct = False
+            args: List[N.Node] = []
+            if not (self.peek().kind == "op" and self.peek().value == ")"):
+                if self.accept_keyword("distinct"):
+                    distinct = True
+                else:
+                    self.accept_keyword("all")
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            return N.FunctionCall(name, tuple(args), distinct=distinct)
+        parts = [name]
+        while self.peek().kind == "op" and self.peek().value == ".":
+            self.next()
+            parts.append(self.expect_name())
+        return N.Identifier(tuple(parts))
+
+
+def parse(sql: str) -> N.Node:
+    """Parse one statement (reference: SqlParser.createStatement)."""
+    return Parser(tokenize(sql)).parse_statement()

@@ -413,12 +413,13 @@ def common_super_type(a: SqlType, b: SqlType) -> Optional[SqlType]:
         if isinstance(a, RealType) or isinstance(b, RealType):
             # decimal + real -> real in Presto
             return REAL
-        # at least one decimal
+        # at least one decimal; precision capped at 18 — computed decimals
+        # are physically scaled i64 (see expr/functions._short_decimal)
         da = _to_decimal(a)
         db = _to_decimal(b)
         scale = max(da.scale, db.scale)
         int_digits = max(da.precision - da.scale, db.precision - db.scale)
-        return DecimalType(min(38, int_digits + scale), scale)
+        return DecimalType(max(min(18, int_digits + scale), scale, 1), scale)
     if is_string(a) and is_string(b):
         la = a.length
         lb = b.length

@@ -1,0 +1,133 @@
+"""SQL parser tests: all 22 TPC-H queries parse; targeted shape checks."""
+
+import pytest
+
+from presto_tpu.sql import ast_nodes as N
+from presto_tpu.sql.parser import SqlSyntaxError, parse
+from tests.tpch_queries import QUERIES
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_parses(qnum):
+    q = parse(QUERIES[qnum])
+    assert isinstance(q, N.Query)
+
+
+def test_q1_shape():
+    q = parse(QUERIES[1])
+    spec = q.body
+    assert isinstance(spec, N.QuerySpec)
+    assert len(spec.select) == 10
+    assert spec.select[2].alias == "sum_qty"
+    assert len(spec.group_by) == 2
+    assert len(q.order_by) == 2  # ORDER BY binds at query level
+    # date minus interval
+    w = spec.where
+    assert isinstance(w, N.BinaryOp) and w.op == "<="
+    assert isinstance(w.right, N.BinaryOp) and w.right.op == "-"
+    assert w.right.right.kind == "interval"
+    assert w.right.right.value == (90, "day")
+
+
+def test_precedence():
+    q = parse("select 1 + 2 * 3 as x")
+    e = q.body.select[0].expr
+    assert e.op == "+" and e.right.op == "*"
+    q = parse("select a or b and c from t")
+    e = q.body.select[0].expr
+    assert e.op == "or" and e.right.op == "and"
+    q = parse("select not a = b from t")
+    e = q.body.select[0].expr
+    assert isinstance(e, N.UnaryOp) and e.op == "not"
+    assert e.operand.op == "="
+
+
+def test_between_not_in_like():
+    q = parse("select * from t where x not between 1 and 2")
+    assert isinstance(q.body.where, N.Between) and q.body.where.negated
+    q = parse("select * from t where x not in (1, 2)")
+    assert isinstance(q.body.where, N.InList) and q.body.where.negated
+    q = parse("select * from t where x not like 'a%' escape '#'")
+    assert isinstance(q.body.where, N.Like) and q.body.where.negated
+    assert q.body.where.escape.value == "#"
+
+
+def test_join_forms():
+    q = parse("""select * from a left outer join b on a.x = b.y
+                 join c on b.z = c.z cross join d""")
+    j = q.body.from_[0]
+    assert isinstance(j, N.JoinRelation) and j.join_type == "cross"
+    assert j.left.join_type == "inner"
+    assert j.left.left.join_type == "left"
+
+
+def test_aliases_and_derived_tables():
+    q = parse("select s.x y from (select 1 as x) as s (x)")
+    item = q.body.select[0]
+    assert item.alias == "y"
+    rel = q.body.from_[0]
+    assert isinstance(rel, N.AliasedRelation)
+    assert rel.alias == "s" and rel.column_aliases == ("x",)
+    assert isinstance(rel.relation, N.SubqueryRelation)
+
+
+def test_with_and_setops():
+    q = parse("""with r (a) as (select 1) select a from r
+                 union all select 2""")
+    assert q.withs[0].name == "r"
+    assert isinstance(q.body, N.SetOp) and q.body.op == "union_all"
+
+
+def test_case_forms():
+    q = parse("""select case when a > 1 then 'x' else 'y' end,
+                        case b when 1 then 'p' end from t""")
+    searched, simple = (i.expr for i in q.body.select)
+    assert searched.operand is None and searched.default is not None
+    assert simple.operand is not None and simple.default is None
+
+
+def test_scalar_subquery_and_exists():
+    q = parse("""select * from t where x = (select max(y) from u)
+                 and exists (select * from v)""")
+    w = q.body.where
+    assert isinstance(w.left.right, N.ScalarSubquery)
+    assert isinstance(w.right, N.Exists)
+
+
+def test_count_star_and_distinct():
+    q = parse("select count(*), count(distinct x), sum(all y) from t")
+    c, d, s = (i.expr for i in q.body.select)
+    assert c.is_star and not c.args
+    assert d.distinct
+    assert not s.distinct
+
+
+def test_substring_from_for():
+    q = parse("select substring(x from 1 for 2), substring(x, 3) from t")
+    a, b = (i.expr for i in q.body.select)
+    assert a.name == "substr" and len(a.args) == 3
+    assert b.name == "substr" and len(b.args) == 2
+
+
+def test_cast_types():
+    q = parse("select cast(x as decimal(12,2)), cast(y as bigint) from t")
+    a, b = (i.expr for i in q.body.select)
+    assert a.type_name == "decimal(12,2)"
+    assert b.type_name == "bigint"
+
+
+def test_syntax_errors():
+    with pytest.raises(SqlSyntaxError):
+        parse("select from where")
+    with pytest.raises(SqlSyntaxError):
+        parse("select 1 extra_token !")
+    with pytest.raises(SqlSyntaxError):
+        parse("select * from t where x between 1")
+
+
+def test_comments_and_case_insensitivity():
+    q = parse("""-- leading comment
+        SELECT /* block
+        comment */ X FROM T""")
+    assert isinstance(q.body.select[0].expr, N.Identifier)
+    assert q.body.select[0].expr.name == "x"
