@@ -140,10 +140,16 @@ def finalize(
             cnt = xp.maximum(count, jnp.int64(1))
             # exact two-step 128/64 divide with round-half-up; derivation
             # assumes the non-negative domain (money sums); negative totals
-            # fall back through the same path with floor bias ≤ 1 ulp
-            qh = hi // cnt
-            rh = hi - qh * cnt
-            rest = (rh << jnp.int64(32)) + lo
+            # fall back through the same path with floor bias ≤ 1 ulp.
+            # lo is a segment-sum of 32-bit halves (up to n*2^32 for an
+            # n-row group), so fold its high half into the 2^32-weighted
+            # dividend first — keeps rest < (n+1)*2^32, in-range through
+            # the documented 2^31-rows-per-group bound.
+            hi2 = hi + (lo >> jnp.int64(32))
+            lo_low = lo & jnp.int64(0xFFFFFFFF)
+            qh = hi2 // cnt
+            rh = hi2 - qh * cnt
+            rest = (rh << jnp.int64(32)) + lo_low
             q2 = (rest + cnt // jnp.int64(2)) // cnt
             avg = (qh << jnp.int64(32)) + q2
             return Block(data=avg, type=out_type, nulls=hn)

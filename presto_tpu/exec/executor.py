@@ -55,7 +55,12 @@ def _canonical_join_cols(
     for lb, rb in zip(left_blocks, right_blocks):
         if lb.dictionary is not None or rb.dictionary is not None:
             ld, rd = lb.dictionary, rb.dictionary
-            if ld == rd:
+            # raw codes are equality-faithful only for a shared dictionary
+            # WITHOUT duplicate values; transform-produced dictionaries
+            # (substr/lower via _dict_map) map many codes to one value and
+            # must go through the merged-universe canonicalization too
+            if ld == rd and not (ld is not None and
+                                 ld.has_duplicate_values()):
                 lcols.append(lb.data.astype(jnp.int64).astype(jnp.uint64))
                 rcols.append(rb.data.astype(jnp.int64).astype(jnp.uint64))
             else:
@@ -291,8 +296,17 @@ class Executor:
             max_cap = _next_pow2(page.capacity)
             while True:
                 out, overflow = partial_fn(page, c)
-                if not bool(overflow) or c >= max_cap:
+                if not bool(overflow):
                     break
+                if c >= max_cap:
+                    # distinct groups <= rows <= max_cap, so overflow here
+                    # means the hashed grouping left rows unresolved after
+                    # max_iters probe rounds — accepting the page would
+                    # silently drop those rows from the aggregates
+                    raise RuntimeError(
+                        "group-by hash table failed to resolve at maximum "
+                        f"capacity {max_cap}; rerun with larger page_rows"
+                    )
                 c = min(c * 2, max_cap)
             partials.append(out)
         if not any_input:
@@ -416,8 +430,38 @@ def _project_page(exprs, page: Page) -> Page:
 
 def _group_ids(group_channels, page: Page, cap: int):
     key_blocks = [page.block(c) for c in group_channels]
+    # dense fast path: all keys dictionary-coded (unique values, no nulls) or
+    # boolean, and the combined code space fits the capacity — group id is
+    # computed arithmetically, no hash table at all (Q1: 2 flag columns).
+    # Reference analog: BigintGroupByHash's small-range fast path.
+    sizes = []
+    for b in key_blocks:
+        if (
+            b.dictionary is not None
+            and len(b.dictionary)
+            and not b.dictionary.has_duplicate_values()
+            and b.nulls is None
+        ):
+            sizes.append(len(b.dictionary))
+        elif isinstance(b.type, T.BooleanType) and b.nulls is None:
+            sizes.append(2)
+        else:
+            sizes = None
+            break
+    if sizes is not None:
+        space = 1
+        for s in sizes:
+            space *= s
+        if space <= cap:
+            gid = jnp.zeros(page.valid.shape, dtype=jnp.int64)
+            for b, s in zip(key_blocks, sizes):
+                code = jnp.clip(b.data.astype(jnp.int64), 0, s - 1)
+                gid = gid * s + code
+            return A.compute_groups_dense(
+                gid, page.valid, space, out_capacity=cap
+            )
     key_cols, key_nulls = K.block_key_columns(key_blocks)
-    return A.compute_groups_sorted(key_cols, key_nulls, page.valid, cap)
+    return A.compute_groups_hashed(key_cols, key_nulls, page.valid, cap)
 
 
 def _state_reduce(st, blk, kind, apply_pre, reducer):

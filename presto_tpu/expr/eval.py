@@ -97,6 +97,60 @@ def _as_bool3(ctx, val: Val):
     return data & ~v.nulls, v.nulls
 
 
+def _unify_string_vals(ctx, vals):
+    """Remap string-typed branch Vals onto ONE merged dictionary.
+
+    IF/SWITCH/COALESCE select raw codes across branches with where(); if the
+    branches carry different dictionaries (two different columns, a transform
+    output, a string literal), the selected codes must decode through a single
+    shared universe — keeping one branch's dictionary silently decodes the
+    other branch's codes through the wrong value table. Merging at trace time
+    is a compile-time constant gather, same trick as
+    functions._string_codes_for_compare.
+    """
+    from presto_tpu.page import Dictionary
+
+    xp = ctx.xp
+    dicts = [v.dictionary for v in vals]
+    real = {id(d): d for d in dicts if d is not None}
+    nondict_consts = [
+        v for v in vals
+        if v.dictionary is None and v.is_const and v.py_value is not None
+    ]
+    if not real and not nondict_consts:
+        return vals  # all NULL literals: nothing to decode
+    if (
+        len({d for d in dicts if d is not None}) == 1
+        and not nondict_consts
+        and all(d is not None for d in dicts)
+    ):
+        return vals  # one shared dictionary already
+    universe: dict = {}
+    for v in vals:
+        if v.dictionary is not None:
+            for x in v.dictionary.values:
+                universe.setdefault(x, len(universe))
+        elif v.is_const and v.py_value is not None:
+            universe.setdefault(v.py_value, len(universe))
+    merged = Dictionary(list(universe))
+    out = []
+    for v in vals:
+        if v.dictionary is not None and len(v.dictionary):
+            lut = np.array(
+                [universe[x] for x in v.dictionary.values], np.int32
+            )
+            codes = xp.clip(v.data, 0, len(v.dictionary) - 1)
+            data = xp.asarray(lut)[codes]
+        elif v.is_const and v.py_value is not None:
+            data = xp.broadcast_to(
+                xp.asarray(np.int32(universe[v.py_value])), (ctx.capacity,)
+            )
+        else:  # NULL literal or empty dictionary: code value is never read
+            data = xp.zeros((ctx.capacity,), dtype=np.int32)
+        out.append(Val(data, v.nulls, v.type, merged, v.py_value))
+    return out
+
+
 def _eval_special(ctx, node: ir.SpecialForm, page: Page) -> Val:
     from presto_tpu.expr import functions as F
 
@@ -140,6 +194,8 @@ def _eval_special(ctx, node: ir.SpecialForm, page: Page) -> Val:
         cond, _ = _as_bool3(ctx, _eval(ctx, node.args[0], page))
         t = _coerced(ctx, node.args[1], page, node.type)
         f = _coerced(ctx, node.args[2], page, node.type)
+        if T.is_string(node.type):
+            t, f = _unify_string_vals(ctx, [t, f])
         data = _select(xp, cond, t.data, f.data)
         tn = t.nulls if t.nulls is not None else xp.zeros(
             (ctx.capacity,), dtype=bool)
@@ -149,9 +205,11 @@ def _eval_special(ctx, node: ir.SpecialForm, page: Page) -> Val:
         return Val(data, nulls, node.type, t.dictionary or f.dictionary)
 
     if form == ir.COALESCE:
+        branches = [_coerced(ctx, a, page, node.type) for a in node.args]
+        if T.is_string(node.type):
+            branches = _unify_string_vals(ctx, branches)
         out = None
-        for a in node.args:
-            v = _coerced(ctx, a, page, node.type)
+        for v in branches:
             vn = v.nulls if v.nulls is not None else xp.zeros(
                 (ctx.capacity,), dtype=bool)
             if out is None:
@@ -189,14 +247,19 @@ def _eval_special(ctx, node: ir.SpecialForm, page: Page) -> Val:
         whens = pairs[0::2]
         thens = pairs[1::2]
         out = _coerced(ctx, default, page, node.type)
+        branch_vals = [
+            _coerced(ctx, t_, page, node.type) for t_ in thens
+        ]
+        if T.is_string(node.type):
+            unified = _unify_string_vals(ctx, [out] + branch_vals)
+            out, branch_vals = unified[0], unified[1:]
         data = out.data
         nulls = out.nulls if out.nulls is not None else xp.zeros(
             (ctx.capacity,), dtype=bool)
         dic = out.dictionary
         # later WHENs must not override earlier ones: fold right-to-left
-        for when, then in reversed(list(zip(whens, thens))):
+        for when, t in reversed(list(zip(whens, branch_vals))):
             c, _ = _as_bool3(ctx, _eval(ctx, when, page))
-            t = _coerced(ctx, then, page, node.type)
             tn = t.nulls if t.nulls is not None else xp.zeros(
                 (ctx.capacity,), dtype=bool)
             data = _select(xp, c, t.data, data)

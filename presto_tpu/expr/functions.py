@@ -687,8 +687,11 @@ def _impl_power(ctx: Ctx, rt, vals: List[Val]) -> Val:
     xp = ctx.xp
     x = _to_common(ctx, vals[0], T.DOUBLE).data
     y = _to_common(ctx, vals[1], T.DOUBLE).data
-    return Val(xp.power(xp.abs(x), y) * xp.where(
-        (x < 0) & (y % 2 == 1), -1.0, 1.0), None, T.DOUBLE)
+    out = xp.power(xp.abs(x), y) * xp.where(
+        (x < 0) & (y % 2 == 1), -1.0, 1.0)
+    # Java Math.pow: negative base with non-integer exponent -> NaN
+    out = xp.where((x < 0) & (y != xp.floor(y)), xp.float64(xp.nan), out)
+    return Val(out, None, T.DOUBLE)
 
 
 register("power", lambda a: T.DOUBLE, _impl_power)

@@ -141,32 +141,143 @@ def compute_groups_dense(
     group_ids: jnp.ndarray,
     valid: jnp.ndarray,
     num_groups: int,
+    out_capacity: Optional[int] = None,
 ) -> GroupbyResult:
     """Group ids already computed arithmetically (e.g. from dictionary codes:
-    gid = code_a * |dict_b| + code_b). Static group count, no sort.
+    gid = code_a * |dict_b| + code_b). Static group count, no sort, no hash
+    table — the Q1 fast path (reference analog: BigintGroupByHash's
+    small-range optimization). Output arrays are padded to out_capacity
+    (>= num_groups) so callers can mix this with the hashed path.
     """
-    ids = jnp.where(valid, group_ids.astype(jnp.int64), num_groups)
+    cap = out_capacity or num_groups
+    assert cap >= num_groups
+    ids = jnp.where(valid, group_ids.astype(jnp.int64), cap)
     counts = jax.ops.segment_sum(
         jnp.ones(valid.shape, dtype=jnp.int64),
         ids,
-        num_segments=num_groups + 1,
-    )[:num_groups]
+        num_segments=cap + 1,
+    )[:cap]
     group_valid = counts > 0
     # representative row per group: min input index holding that gid
     idx = jnp.arange(valid.shape[0], dtype=jnp.int64)
     rep = jax.ops.segment_min(
         jnp.where(valid, idx, jnp.int64(2**62)),
         ids,
-        num_segments=num_groups + 1,
-    )[:num_groups]
+        num_segments=cap + 1,
+    )[:cap]
     rep = jnp.clip(rep, 0, valid.shape[0] - 1)
     return GroupbyResult(
-        group_ids=jnp.clip(ids, 0, num_groups - 1),
+        group_ids=jnp.clip(ids, 0, cap - 1),
         row_valid=valid,
         rep_index=rep,
         group_valid=group_valid,
         num_groups=jnp.sum(group_valid.astype(jnp.int64)),
         overflow=jnp.asarray(False),
+    )
+
+
+def compute_groups_hashed(
+    key_cols: Sequence[jnp.ndarray],
+    key_nulls: Sequence[Optional[jnp.ndarray]],
+    valid: jnp.ndarray,
+    out_capacity: int,
+    max_iters: int = 64,
+) -> GroupbyResult:
+    """Group assignment via a vectorized linear-probing hash table — the
+    TPU-native GroupByHash (reference: operator/GroupByHash.java's
+    open-addressing probe/insert, re-expressed as data-parallel rounds).
+
+    Each round, every unsettled row claims its current slot with a
+    scatter-min of its row index (deterministic winner), then checks whether
+    the slot's owner carries an equal key; matching rows settle, losers probe
+    the next slot. Equal-key rows start at the same hash slot and observe the
+    same owners, so they advance in lockstep and can never split into two
+    groups; scatter-min is commutative, so the whole procedure is
+    deterministic. Compile cost is a handful of gather/scatter ops inside one
+    while_loop body — versus a multi-operand u64 lexsort whose XLA:TPU
+    comparator blows up exponentially in key count (measured: 17s -> 66s
+    compile going from 1 to 2 u64 sort operands).
+
+    Table capacity is 2x out_capacity (load factor <= 0.5 when the group
+    count fits). Unresolved rows after max_iters or group count overflow set
+    the overflow flag — callers retry with doubled capacity (SURVEY §8.2.1).
+    """
+    from presto_tpu.ops import hashing as H
+
+    n = valid.shape[0]
+    cols: List[jnp.ndarray] = []
+    for c, nl in zip(key_cols, key_nulls):
+        if nl is None:
+            cols.append(c.astype(jnp.uint64))
+        else:
+            # fold the null flag in as its own word: NULL groups with NULL
+            cols.append(jnp.where(nl, jnp.uint64(0), c.astype(jnp.uint64)))
+            cols.append(nl.astype(jnp.uint64))
+    h = H.hash_columns(cols, [None] * len(cols))
+
+    cap = max(2 * out_capacity, 16)
+    cap = 1 << (cap - 1).bit_length()  # pow2 for mask probing
+    mask = jnp.int64(cap - 1)
+    BIG = jnp.int64(n)
+    row_idx = jnp.arange(n, dtype=jnp.int64)
+    init_slot = (h & jnp.uint64(cap - 1)).astype(jnp.int64)
+
+    def key_eq_owner(owner, slot):
+        """settled mask: does the row's slot owner carry an equal key?"""
+        win = owner[slot]
+        winc = jnp.clip(win, 0, n - 1)
+        ok = win < n
+        for c in cols:
+            ok = ok & (c[winc] == c)
+        return valid & ok
+
+    def cond(state):
+        owner, slot, it = state
+        unsettled = valid & ~key_eq_owner(owner, slot)
+        return jnp.any(unsettled) & (it < max_iters)
+
+    def body(state):
+        owner, slot, it = state
+        settled = key_eq_owner(owner, slot)
+        claim = jnp.where(settled | ~valid, BIG, row_idx)
+        owner = owner.at[slot].min(claim)
+        settled2 = key_eq_owner(owner, slot)
+        slot = jnp.where(settled2 | ~valid, slot, (slot + 1) & mask)
+        return owner, slot, it + 1
+
+    owner0 = jnp.full((cap,), BIG, dtype=jnp.int64)
+    owner, slot, _ = jax.lax.while_loop(
+        cond, body, (owner0, init_slot, jnp.int64(0))
+    )
+
+    settled = key_eq_owner(owner, slot)
+    unresolved = jnp.any(valid & ~settled)
+    # occupied slots = slots some row actually settled in (ghost claims from
+    # rows that probed past are excluded by deriving occupancy from rows)
+    used = (
+        jnp.zeros((cap + 1,), dtype=jnp.bool_)
+        .at[jnp.where(settled, slot, cap)]
+        .set(True, mode="drop")[:cap]
+    )
+    gid_slot = jnp.cumsum(used.astype(jnp.int64)) - 1
+    num_groups = jnp.sum(used.astype(jnp.int64))
+    overflow = unresolved | (num_groups > out_capacity)
+
+    gids = jnp.clip(gid_slot[slot], 0, out_capacity - 1)
+    rep = (
+        jnp.full((out_capacity + 1,), jnp.int64(2**62))
+        .at[jnp.where(settled, gids, out_capacity)]
+        .min(row_idx, mode="drop")[:out_capacity]
+    )
+    rep = jnp.clip(rep, 0, n - 1)
+    group_valid = jnp.arange(out_capacity, dtype=jnp.int64) < num_groups
+    return GroupbyResult(
+        group_ids=gids,
+        row_valid=valid & settled,
+        rep_index=rep,
+        group_valid=group_valid,
+        num_groups=num_groups,
+        overflow=overflow,
     )
 
 

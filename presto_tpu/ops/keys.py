@@ -33,17 +33,47 @@ def _int_order_u64(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _float_order_u64(x: jnp.ndarray) -> jnp.ndarray:
-    x64 = x.astype(jnp.float64)
-    x64 = jnp.where(x64 == 0.0, 0.0, x64)  # -0.0 -> +0.0
-    bits = jax_bitcast_f64_u64(x64)
-    neg = (bits & _SIGN64) != 0
-    return jnp.where(neg, ~bits, bits | _SIGN64)
+    """IEEE-754 total-order u64 key for DOUBLE/REAL values.
 
-
-def jax_bitcast_f64_u64(x: jnp.ndarray) -> jnp.ndarray:
+    Backend-split, because the TPU backend (a) rejects f64<->u64 bitcasts at
+    compile time and (b) *represents* f64 as an (hi, lo) pair of f32s — f32
+    exponent range, ~49-bit mantissa; hi = RN32(x), lo = RN32(x - hi), and
+    hi + lo reconstructs every storable value exactly (verified on-device,
+    tests/test_tpu_smoke.py). On TPU the faithful order key is therefore the
+    pair key (order32(hi) << 32) | order32(lo): hi is monotone in x, and lo
+    breaks ties exactly. On CPU (true f64) we keep the classic bitcast trick.
+    Both: -0.0 normalized to +0.0, NaN sorts above +inf (engine's
+    NaN-is-largest rule).
+    """
+    import jax
     import jax.lax as lax
 
-    return lax.bitcast_convert_type(x, jnp.uint64)
+    x64 = x.astype(jnp.float64)
+    x64 = jnp.where(x64 == 0.0, 0.0, x64)  # -0.0 -> +0.0
+    isnan = jnp.isnan(x64)
+    if jax.default_backend() != "tpu":
+        bits = lax.bitcast_convert_type(x64, jnp.uint64)
+        neg = (bits & _SIGN64) != 0
+        out = jnp.where(neg, ~bits, bits | _SIGN64)
+        return jnp.where(isnan, jnp.uint64(0xFFFFFFFFFFFFFFFF), out)
+
+    sign32 = jnp.uint32(0x80000000)
+
+    def order32(f):
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # -0.0f -> +0.0f
+        bits = lax.bitcast_convert_type(f.astype(jnp.float32), jnp.uint32)
+        neg = (bits & sign32) != 0
+        return jnp.where(neg, ~bits, bits | sign32)
+
+    hi = x64.astype(jnp.float32)
+    resid = jnp.where(
+        jnp.isfinite(hi), x64 - hi.astype(jnp.float64), 0.0
+    )
+    lo = resid.astype(jnp.float32)
+    key = (order32(hi).astype(jnp.uint64) << 32) | order32(lo).astype(
+        jnp.uint64
+    )
+    return jnp.where(isnan, jnp.uint64(0xFFFFFFFFFFFFFFFF), key)
 
 
 def equality_encoding(block: Block) -> List[jnp.ndarray]:
@@ -83,45 +113,97 @@ def equality_encoding(block: Block) -> List[jnp.ndarray]:
     return [block.data.astype(jnp.int64).astype(jnp.uint64)]
 
 
-def order_encoding(
+def order_encoding_parts(
     block: Block,
     *,
     ascending: bool = True,
     nulls_first: bool = False,
-) -> List[jnp.ndarray]:
-    """uint64 key columns (most-significant first) whose ascending order is
-    the requested SQL order, including the null position. Invalid rows are
-    handled by the caller (sorted to the end via a leading validity key)."""
+) -> List[Tuple[jnp.ndarray, int]]:
+    """order_encoding with static bit widths: (u64 key, bits) pairs whose
+    MSB-first concatenation orders rows correctly.
+
+    Bit widths come from static knowledge — dictionary size, or the type's
+    value range (DATE fits 24 bits, INTEGER 32, ...). Narrow widths let
+    pack_sort_keys() fuse several sort keys into one u64 word, which matters
+    enormously on TPU: XLA's sort compile time roughly doubles per extra
+    operand, so a 5-operand lexsort is minutes while a packed 1-2 operand
+    sort is seconds.
+    """
     t = block.type
-    if isinstance(block.data, tuple):
+    parts: List[Tuple[jnp.ndarray, int]] = []
+    if isinstance(block.data, tuple):  # long decimal limbs
         hi, lo = block.data
-        keys = [_int_order_u64(hi), lo.astype(jnp.uint64)]
+        parts = [(_int_order_u64(hi), 64), (lo.astype(jnp.uint64), 64)]
     elif isinstance(t, (T.DoubleType, T.RealType)):
-        keys = [_float_order_u64(block.data)]
+        parts = [(_float_order_u64(block.data), 64)]
     elif isinstance(t, T.BooleanType):
-        keys = [block.data.astype(jnp.uint64)]
+        parts = [(block.data.astype(jnp.uint64), 1)]
     elif t.is_dictionary_encoded and block.dictionary is not None:
         if len(block.dictionary) == 0:
-            # all-NULL column: only the null key matters
-            keys = [jnp.zeros(block.data.shape, dtype=jnp.uint64)]
+            parts = [(jnp.zeros(block.data.shape, dtype=jnp.uint64), 1)]
         else:
             rank = jnp.asarray(block.dictionary.sort_rank())
             codes = jnp.clip(block.data, 0, len(block.dictionary) - 1)
-            keys = [rank[codes].astype(jnp.uint64)]
+            bits = max(1, (len(block.dictionary) - 1).bit_length())
+            parts = [(rank[codes].astype(jnp.uint64), bits)]
     else:
-        keys = [_int_order_u64(block.data)]
+        bits = 64
+        if isinstance(t, T.DateType):
+            bits = 24  # Presto DATE range (years 1582..9999) < 2^23 days
+        elif isinstance(t, T.IntegerType):
+            bits = 32
+        elif isinstance(t, T.SmallintType):
+            bits = 16
+        elif isinstance(t, T.TinyintType):
+            bits = 8
+        x = block.data.astype(jnp.int64)
+        if bits == 64:
+            enc = x.astype(jnp.uint64) ^ _SIGN64
+        else:
+            lo_b, hi_b = -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+            enc = (
+                jnp.clip(x, lo_b, hi_b) + jnp.int64(1 << (bits - 1))
+            ).astype(jnp.uint64)
+        parts = [(enc, bits)]
 
     if not ascending:
-        keys = [~k for k in keys]
+        parts = [
+            ((~k if b == 64 else (jnp.uint64((1 << b) - 1) - k)), b)
+            for k, b in parts
+        ]
 
     null = block.nulls
     if null is None:
-        null_key = jnp.zeros(keys[0].shape, dtype=jnp.uint64)
+        null_key = jnp.zeros(parts[0][0].shape, dtype=jnp.uint64)
     elif nulls_first:
         null_key = jnp.where(null, jnp.uint64(0), jnp.uint64(1))
     else:
         null_key = jnp.where(null, jnp.uint64(1), jnp.uint64(0))
-    return [null_key] + keys
+    return [(null_key, 1)] + parts
+
+
+def pack_sort_keys(
+    parts: List[Tuple[jnp.ndarray, int]]
+) -> List[jnp.ndarray]:
+    """Greedily pack (key, bits) pairs MSB-first into u64 words. Lexicographic
+    order of the packed words equals lexicographic order of the unpacked key
+    sequence (same static layout for every row)."""
+    words: List[jnp.ndarray] = []
+    acc = None
+    used = 0
+    for key, bits in parts:
+        if acc is not None and used + bits > 64:
+            words.append(acc)
+            acc, used = None, 0
+        if acc is None:
+            acc = key.astype(jnp.uint64)
+            used = bits
+        else:
+            acc = (acc << jnp.uint64(bits)) | key.astype(jnp.uint64)
+            used += bits
+    if acc is not None:
+        words.append(acc)
+    return words
 
 
 def block_key_columns(

@@ -53,6 +53,17 @@ class PlanningError(ValueError):
     pass
 
 
+class UnresolvedColumnError(PlanningError):
+    """A column name resolved in no scope. Distinguished from other planning
+    failures so correlation detection (_is_correlated) keys on *this* error
+    only — an uncorrelated subquery using an unsupported feature must surface
+    its real error, not be misrouted into the correlated decorrelator."""
+
+    def __init__(self, ident):
+        super().__init__(f"column not found: {'.'.join(ident.parts)}")
+        self.ident = ident
+
+
 @dataclasses.dataclass(frozen=True)
 class OuterRef(ir.RowExpression):
     """Planning-only placeholder for a correlated column (resolved in an
@@ -103,7 +114,14 @@ class Scope:
         if self.parent is not None:
             lvl, ch, f = self.parent.resolve(ident)
             return lvl + 1, ch, f
-        raise PlanningError(f"column not found: {'.'.join(ident.parts)}")
+        raise UnresolvedColumnError(ident)
+
+    def can_resolve(self, ident: N.Identifier) -> bool:
+        try:
+            self.resolve(ident)
+            return True
+        except PlanningError:
+            return False
 
 
 # --------------------------------------------------------------- utilities
@@ -715,11 +733,19 @@ class Planner:
         return False
 
     def _is_correlated(self, q: N.Query, scope: Scope) -> bool:
+        """Correlated iff planning without an outer scope hits an unresolved
+        column that DOES resolve in the outer scope. Any other planning
+        failure is a genuine error in the subquery and propagates as-is
+        (ADVICE r1: inferring correlation from arbitrary PlanningErrors sent
+        unsupported-feature errors into the decorrelator's misleading
+        'must be a single aggregate' path)."""
         try:
             self._plan_uncorrelated_probe(q)
             return False
-        except PlanningError:
-            return True
+        except UnresolvedColumnError as err:
+            if scope is not None and scope.can_resolve(err.ident):
+                return True
+            raise
 
     def _plan_uncorrelated_probe(self, q: N.Query):
         # planning without an outer scope raises on correlated refs
@@ -1337,6 +1363,21 @@ class ExprTranslator:
                 raise PlanningError(
                     f"aggregate {e.name} in invalid context"
                 )
+            # special forms spelled as function calls
+            if e.name == "coalesce":
+                return ir.coalesce(*[self._tr(a) for a in e.args])
+            if e.name == "nullif":
+                # `a` appears twice in the IR; XLA CSEs the identical
+                # subgraphs under jit, so it is not evaluated twice on device
+                a, b = (self._tr(x) for x in e.args)
+                return ir.if_(
+                    ir.call("eq", a, b), ir.Constant(None, a.type), a
+                )
+            if e.name == "if":
+                args = [self._tr(a) for a in e.args]
+                if len(args) == 2:
+                    args.append(ir.Constant(None, args[1].type))
+                return ir.if_(*args)
             return ir.call(e.name, *[self._tr(a) for a in e.args])
         if isinstance(e, N.ScalarSubquery):
             return self.planner.execute_scalar(e.query)

@@ -148,3 +148,90 @@ def test_key_encoding_through_blocks(rng):
     groups = A.compute_groups_sorted(cols, nulls, page.valid, 8)
     # groups: {0.0}, {nan}, {1.5}, {NULL}
     assert int(groups.num_groups) == 4
+
+
+def test_decimal_avg_finalize_huge_group_no_overflow():
+    """ADVICE r1 low #1: avg finalize must fold lo's high half into the
+    2^32-weighted dividend — a ~2^31-row group's lo segment-sum otherwise
+    overflows i64 in (rh << 32) + lo."""
+    import jax.numpy as jnp
+    from presto_tpu import types as T
+    from presto_tpu.exec import agg_states as S
+
+    n = 1 << 31  # rows in the group
+    value = 123_456  # unscaled decimal(12,2) cents, same every row
+    total = n * value
+    # states as _partial/_final produce them: sums of v>>32 and v&0xFFFFFFFF
+    hi = jnp.asarray([(value >> 32) * n], jnp.int64)
+    lo = jnp.asarray([(value & 0xFFFFFFFF) * n], jnp.int64)
+    cnt = jnp.asarray([n], jnp.int64)
+    blk = S.finalize(
+        "avg", T.DecimalType(12, 2), T.DecimalType(12, 2),
+        [(hi, None), (lo, None), (cnt, None)],
+    )
+    expected = (total + n // 2) // n  # round-half-up
+    assert int(blk.data[0]) == expected == value
+
+
+class TestHashedGroupby:
+    """compute_groups_hashed (the vectorized linear-probing GroupByHash that
+    replaces the multi-operand lexsort on TPU) vs the sorted oracle."""
+
+    def test_matches_sorted_randomized(self, rng):
+        for trial in range(5):
+            n = 257
+            cap = 256
+            k1 = rng.integers(0, 23, size=n).astype(np.uint64)
+            k2 = rng.integers(0, 5, size=n).astype(np.uint64)
+            k2n = rng.random(n) < 0.3
+            v = rng.integers(0, 1000, size=n).astype(np.int64)
+            valid = rng.random(n) < 0.85
+            cols = [jnp.asarray(k1), jnp.asarray(k2)]
+            nulls = [None, jnp.asarray(k2n)]
+            hashed = A.compute_groups_hashed(cols, nulls, jnp.asarray(valid), cap)
+            srt = A.compute_groups_sorted(cols, nulls, jnp.asarray(valid), cap)
+            assert not bool(hashed.overflow)
+            assert int(hashed.num_groups) == int(srt.num_groups)
+            sh, shn = A.aggregate(hashed, A.SUM, cap, jnp.asarray(v))
+            # map group -> (key, sum) via representative rows; compare as sets
+            def results(groups, s):
+                rep = np.asarray(groups.rep_index)
+                gv = np.asarray(groups.group_valid)
+                out = {}
+                for g in range(cap):
+                    if gv[g]:
+                        r = rep[g]
+                        key = (int(k1[r]), None if k2n[r] else int(k2[r]))
+                        out[key] = int(s[g])
+                return out
+            ss, _ = A.aggregate(srt, A.SUM, cap, jnp.asarray(v))
+            assert results(hashed, sh) == results(srt, ss)
+
+    def test_nulls_form_own_group(self):
+        k = jnp.asarray([1, 1, 2, 0], dtype=jnp.uint64)
+        knull = jnp.asarray([False, False, False, True])
+        valid = jnp.ones(4, dtype=bool)
+        groups = A.compute_groups_hashed([k], [knull], valid, 8)
+        assert int(groups.num_groups) == 3
+
+    def test_overflow_flag(self):
+        k = jnp.arange(64, dtype=jnp.uint64)
+        valid = jnp.ones(64, dtype=bool)
+        groups = A.compute_groups_hashed([k], [None], valid, 4)
+        assert bool(groups.overflow)
+
+    def test_adversarial_equal_hashes(self):
+        # all rows share one key -> one group regardless of probing dynamics
+        k = jnp.zeros(100, dtype=jnp.uint64)
+        valid = jnp.ones(100, dtype=bool)
+        groups = A.compute_groups_hashed([k], [None], valid, 8)
+        assert int(groups.num_groups) == 1
+        assert not bool(groups.overflow)
+
+    def test_deterministic(self, rng):
+        k = jnp.asarray(rng.integers(0, 50, size=500).astype(np.uint64))
+        valid = jnp.ones(500, dtype=bool)
+        a = A.compute_groups_hashed([k], [None], valid, 64)
+        b = A.compute_groups_hashed([k], [None], valid, 64)
+        assert np.array_equal(np.asarray(a.group_ids), np.asarray(b.group_ids))
+        assert np.array_equal(np.asarray(a.rep_index), np.asarray(b.rep_index))

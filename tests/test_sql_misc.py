@@ -110,3 +110,66 @@ class TestMisc:
         )
         assert len(res.rows) == 2
         assert sum(r[1] for r in res.rows) == 3000  # n_orders at SF0.002
+
+
+class TestAdviceRound1Regressions:
+    """Regressions for the round-1 advisor findings (ADVICE.md)."""
+
+    def test_case_mixing_two_dictionary_columns(self, runner):
+        # CASE selecting between two differently-coded string columns must
+        # decode each branch through its own values, not one branch's dict
+        res = runner.execute(
+            "select c_custkey, case when c_custkey % 2 = 0 then c_mktsegment "
+            "else c_name end from customer order by c_custkey limit 6"
+        )
+        for key, v in res.rows:
+            if key % 2 == 0:
+                assert v in {"AUTOMOBILE", "BUILDING", "FURNITURE",
+                             "HOUSEHOLD", "MACHINERY"}, v
+            else:
+                assert v.startswith("Customer#"), v
+
+    def test_case_string_literal_vs_column(self, runner):
+        res = runner.execute(
+            "select case when c_custkey % 2 = 0 then 'even' "
+            "else c_mktsegment end from customer limit 50"
+        )
+        vals = {r[0] for r in res.rows}
+        assert "even" in vals
+        assert any(v != "even" for v in vals)
+
+    def test_coalesce_string_literal_default(self, runner):
+        res = runner.execute(
+            "select coalesce(c_mktsegment, 'missing') from customer limit 5"
+        )
+        assert all(r[0] != "missing" for r in res.rows)
+
+    def test_semi_join_on_transformed_dictionary(self, runner):
+        # substr-produced dictionaries carry duplicate values; the join path
+        # must canonicalize codes by value (advisor high #2)
+        direct = runner.execute(
+            "select count(*) from customer where substr(c_phone, 1, 2) = "
+            "(select substr(c_phone, 1, 2) from customer where c_custkey = 1)"
+        ).rows[0][0]
+        via_in = runner.execute(
+            "select count(*) from customer where substr(c_phone, 1, 2) in "
+            "(select substr(c_phone, 1, 2) from customer where c_custkey = 1)"
+        ).rows[0][0]
+        assert direct == via_in and direct >= 1
+
+    def test_power_negative_base_fractional_exponent_nan(self, runner):
+        import math
+        res = runner.execute("select power(-8.0, 0.5), power(-8.0, 2.0), "
+                             "power(-2.0, 3.0)")
+        assert math.isnan(res.rows[0][0])
+        assert res.rows[0][1] == 64.0
+        assert res.rows[0][2] == -8.0
+
+    def test_uncorrelated_subquery_error_not_misrouted(self, runner):
+        # a typo'd column inside an uncorrelated scalar subquery must raise
+        # "column not found", not a decorrelator shape error
+        with pytest.raises(PlanningError, match="column not found"):
+            runner.execute(
+                "select count(*) from customer where c_custkey = "
+                "(select max(no_such_col) from orders)"
+            )
