@@ -17,13 +17,16 @@ import dataclasses
 from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.ops import agg as A
 from presto_tpu.page import Block
 
-_MASK32 = jnp.int64(0xFFFFFFFF)
-_U64_SIGN = jnp.uint64(0x8000000000000000)
+# numpy scalars, not jnp: module-level device buffers embedded as jit
+# constants permanently degrade the axon TPU runtime (see ops/hashing.py)
+_MASK32 = np.int64(0xFFFFFFFF)
+_U64_SIGN = np.uint64(0x8000000000000000)
 
 
 @dataclasses.dataclass(frozen=True)

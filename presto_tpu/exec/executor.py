@@ -107,6 +107,15 @@ class Executor:
         self.page_rows = page_rows
         self.use_jit = use_jit
         self._jit_cache: Dict = {}
+        # Deferred-sync discipline: the TPU runtime (axon) permanently
+        # degrades every subsequent kernel launch (~50ms floor) after ANY
+        # device->host read, so the hot path must never call bool()/int()/
+        # np.asarray on device values. Capacity-overflow flags accumulate
+        # here as device scalars and are checked ONCE per execute(); on
+        # overflow the whole query re-runs with boosted capacities
+        # (SURVEY §8.2.1's compiled-branch escape, moved to query scope).
+        self._pending_overflow: List[jnp.ndarray] = []
+        self._capacity_boost = 1
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
@@ -192,9 +201,16 @@ class Executor:
             if not right_pages:
                 return
             build_all = concat_all(right_pages)
-            build = compact_page(
-                build_all, _next_pow2(int(build_all.num_rows()))
+            # modest static build capacity (cross-join output is
+            # probe_cap x build_cap — capacity-sized builds would explode
+            # quadratically); dropped rows raise the deferred overflow
+            # flag and the query retries with boosted capacity
+            bcap = min(
+                _next_pow2(build_all.capacity),
+                _next_pow2(4096 * self._capacity_boost),
             )
+            self._pending_overflow.append(build_all.num_rows() > bcap)
+            build = compact_page(build_all, bcap)
             fn = self._jit(
                 ("cross", node, build.capacity),
                 _cross_join_page,
@@ -252,14 +268,33 @@ class Executor:
         """Materialize results: (column_names, list of row tuples).
 
         Reference analog: testing/MaterializedResult via LocalQueryRunner.
+
+        Runs the whole plan with no host synchronization (see __init__),
+        then checks the accumulated capacity-overflow flags once; on
+        overflow the query re-runs with 4x capacities (query-scope analog
+        of the reference's per-operator retry).
         """
         names = (
             list(node.names) if isinstance(node, P.Output) else None
         )
-        rows: List[tuple] = []
-        for page in self.pages(node):
-            rows.extend(_decode_result_page(page))
-        return names, rows
+        self._capacity_boost = 1  # per-query; grows only across retries
+        for _attempt in range(6):
+            self._pending_overflow = []
+            out_pages = list(self.pages(node))
+            if self._pending_overflow:
+                flag = self._pending_overflow[0]
+                for f in self._pending_overflow[1:]:
+                    flag = flag | f
+                if bool(flag):
+                    self._capacity_boost *= 4
+                    continue
+            rows: List[tuple] = []
+            for page in out_pages:
+                rows.extend(_decode_result_page(page))
+            return names, rows
+        raise RuntimeError(
+            "capacity overflow persisted after 6 boosted retries"
+        )
 
     # -------------------------------------------------------- aggregation
     def _agg_in_types(self, node: P.Aggregation) -> List[Optional[T.SqlType]]:
@@ -279,37 +314,32 @@ class Executor:
             yield self._exec_global_agg(node, in_types, layouts)
             return
 
-        cap = _next_pow2(min(node.capacity, self.page_rows))
+        # no global clamp: boosted retries must be able to grow past
+        # page_rows (join-output pages can exceed it); the per-page
+        # min(..., page.capacity) below bounds each launch
+        cap = _next_pow2(node.capacity * self._capacity_boost)
         partial_fn = self._jit(
             ("agg_partial", node),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts)
             ),
-            static_argnums=(1,),
+            static_argnums=(1, 2),
         )
+        # boosted retries also deepen the hash-probe iteration budget:
+        # when cap is already clipped at the page capacity the only
+        # remaining overflow source is unresolved probing after max_iters
+        # lockstep rounds, which more capacity alone cannot fix
+        max_iters = 64 * self._capacity_boost
         partials: List[Page] = []
-        any_input = False
         for page in self.pages(node.source):
-            any_input = True
-            c = cap
-            max_cap = _next_pow2(page.capacity)
-            while True:
-                out, overflow = partial_fn(page, c)
-                if not bool(overflow):
-                    break
-                if c >= max_cap:
-                    # distinct groups <= rows <= max_cap, so overflow here
-                    # means the hashed grouping left rows unresolved after
-                    # max_iters probe rounds — accepting the page would
-                    # silently drop those rows from the aggregates
-                    raise RuntimeError(
-                        "group-by hash table failed to resolve at maximum "
-                        f"capacity {max_cap}; rerun with larger page_rows"
-                    )
-                c = min(c * 2, max_cap)
+            # distinct groups <= rows, so clip the capacity to the page
+            out, overflow = partial_fn(
+                page, min(cap, _next_pow2(page.capacity)), max_iters
+            )
+            self._pending_overflow.append(overflow)
             partials.append(out)
-        if not any_input:
+        if not partials:
             return
 
         merged = concat_all(partials) if len(partials) > 1 else partials[0]
@@ -319,14 +349,14 @@ class Executor:
                 _final_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts), tuple(in_types)
             ),
-            static_argnums=(1,),
+            static_argnums=(1, 2),
         )
-        c = _next_pow2(node.capacity)
-        while True:
-            out, overflow = final_fn(merged, c)
-            if not bool(overflow):
-                break
-            c *= 2
+        fcap = min(
+            _next_pow2(node.capacity * self._capacity_boost),
+            _next_pow2(merged.capacity),
+        )
+        out, overflow = final_fn(merged, fcap, max_iters)
+        self._pending_overflow.append(overflow)
         yield out
 
     def _exec_global_agg(self, node, in_types, layouts) -> Page:
@@ -360,8 +390,10 @@ class Executor:
         if not build_pages:
             build_pages = [_empty_page(right_types)]
         build_all = concat_all(build_pages)
-        n_build = int(build_all.num_rows())
-        build = compact_page(build_all, _next_pow2(n_build))
+        # capacity-based sizing, not row count: reading num_rows() to the
+        # host mid-query would trigger the axon post-D2H degradation (see
+        # __init__); capacity is a static upper bound on rows
+        build = compact_page(build_all, _next_pow2(build_all.capacity))
 
         if node.join_type in ("semi", "anti"):
             fn = self._jit(
@@ -383,12 +415,15 @@ class Executor:
         )
         build_matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
         for page in self.pages(node.left):
-            out_cap = _next_pow2(max(page.capacity, n_build) * 2)
-            while True:
-                out, matched, overflow = probe_fn(page, build, out_cap)
-                if not bool(overflow):
-                    break
-                out_cap *= 2
+            # sized for both many-to-one (<= probe rows) and small-probe
+            # fan-out (<= build rows) shapes; multiplying joins beyond
+            # this hit the deferred overflow flag and re-run boosted
+            oc = _next_pow2(
+                max(page.capacity, build.capacity) * 2
+                * self._capacity_boost
+            )
+            out, matched, overflow = probe_fn(page, build, oc)
+            self._pending_overflow.append(overflow)
             build_matched = build_matched | matched
             yield out
         if node.join_type in ("right", "full"):
@@ -428,7 +463,7 @@ def _project_page(exprs, page: Page) -> Page:
     return Page(blocks=tuple(blocks), valid=page.valid)
 
 
-def _group_ids(group_channels, page: Page, cap: int):
+def _group_ids(group_channels, page: Page, cap: int, max_iters: int = 64):
     key_blocks = [page.block(c) for c in group_channels]
     # dense fast path: all keys dictionary-coded (unique values, no nulls) or
     # boolean, and the combined code space fits the capacity — group id is
@@ -457,11 +492,16 @@ def _group_ids(group_channels, page: Page, cap: int):
             for b, s in zip(key_blocks, sizes):
                 code = jnp.clip(b.data.astype(jnp.int64), 0, s - 1)
                 gid = gid * s + code
+            # size the output to the key space, not the caller's capacity:
+            # downstream segment ops scale with the group capacity (XLA:TPU
+            # expands them to dense [n, cap] one-hot products)
             return A.compute_groups_dense(
-                gid, page.valid, space, out_capacity=cap
+                gid, page.valid, space, out_capacity=_next_pow2(space)
             )
     key_cols, key_nulls = K.block_key_columns(key_blocks)
-    return A.compute_groups_hashed(key_cols, key_nulls, page.valid, cap)
+    return A.compute_groups_hashed(
+        key_cols, key_nulls, page.valid, cap, max_iters=max_iters
+    )
 
 
 def _state_reduce(st, blk, kind, apply_pre, reducer):
@@ -504,8 +544,10 @@ def _attach_dictionary(block: Block, dic) -> Block:
 
 
 def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
-                      cap: int):
-    groups = _group_ids(group_channels, page, cap)
+                      cap: int, max_iters: int = 64):
+    groups = _group_ids(group_channels, page, cap, max_iters)
+    # dense fast path may size output below cap (see _group_ids)
+    out_cap = groups.group_valid.shape[0]
     keys_page = gather_rows(
         page.select_channels(group_channels),
         groups.rep_index,
@@ -518,7 +560,7 @@ def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
             vals, out_nulls, dic = _state_reduce(
                 st, blk, st.input_kind, True,
                 lambda data, nulls, k=st.input_kind: A.aggregate(
-                    groups, k, cap, data, nulls
+                    groups, k, out_cap, data, nulls
                 ),
             )
             state_blocks.append(
@@ -533,10 +575,11 @@ def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
 
 
 def _final_agg_page(group_channels, aggregates, layouts, in_types,
-                    merged: Page, cap: int):
+                    merged: Page, cap: int, max_iters: int = 64):
     nkeys = len(group_channels)
     key_channels = tuple(range(nkeys))
-    groups = _group_ids(key_channels, merged, cap)
+    groups = _group_ids(key_channels, merged, cap, max_iters)
+    out_cap = groups.group_valid.shape[0]
     keys_page = gather_rows(
         merged.select_channels(key_channels),
         groups.rep_index,
@@ -553,7 +596,7 @@ def _final_agg_page(group_channels, aggregates, layouts, in_types,
             vals, out_nulls, dic = _state_reduce(
                 st, blk, st.merge_kind, False,
                 lambda data, nulls, k=st.merge_kind: A.aggregate(
-                    groups, k, cap, data, nulls
+                    groups, k, out_cap, data, nulls
                 ),
             )
             state_dic = state_dic or dic

@@ -82,13 +82,19 @@ class Aggregation(PhysicalNode):
     first (in group_channels order), then one per AggSpec.
 
     capacity = max distinct groups the executor sizes for; it retries with
-    doubled capacity on overflow (SURVEY §8.2.1 escape hatch).
+    boosted capacity on overflow (SURVEY §8.2.1 escape hatch).
+
+    step mirrors the reference's AggregationNode.Step: "single" does
+    partial+final internally; the distributed fragmenter splits it into
+    "partial" (emits accumulator state columns, runs shard-local) and
+    "final" (merges state pages after an exchange).
     """
 
     source: PhysicalNode
     group_channels: Tuple[int, ...]
     aggregates: Tuple[AggSpec, ...]
     capacity: int = 4096
+    step: str = "single"
 
     def children(self):
         return (self.source,)
@@ -176,6 +182,28 @@ class Limit(PhysicalNode):
     source: PhysicalNode
     count: int
     offset: int = 0
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Exchange(PhysicalNode):
+    """Distribution boundary (reference: sql/planner/plan/ExchangeNode
+    inserted by AddExchanges; executed by PartitionedOutputOperator /
+    ExchangeOperator over HTTP). TPU-native execution maps each kind to an
+    XLA collective over the device mesh (SURVEY §3.3):
+
+      repartition -> lax.all_to_all keyed on hash(keys) % n_devices
+      broadcast   -> lax.all_gather, every device gets all rows
+      gather      -> lax.all_gather to a replicated page (the
+                     SINGLE/COORDINATOR_ONLY partitioning analog; downstream
+                     single-stream operators run on the replicated copy)
+    """
+
+    source: PhysicalNode
+    kind: str  # "repartition" | "broadcast" | "gather"
+    keys: Tuple[int, ...] = ()
 
     def children(self):
         return (self.source,)

@@ -689,8 +689,12 @@ def _impl_power(ctx: Ctx, rt, vals: List[Val]) -> Val:
     y = _to_common(ctx, vals[1], T.DOUBLE).data
     out = xp.power(xp.abs(x), y) * xp.where(
         (x < 0) & (y % 2 == 1), -1.0, 1.0)
-    # Java Math.pow: negative base with non-integer exponent -> NaN
-    out = xp.where((x < 0) & (y != xp.floor(y)), xp.float64(xp.nan), out)
+    # Java Math.pow: FINITE negative base with non-integer exponent -> NaN
+    # (pow(-inf, 0.5) = +inf, pow(-inf, -0.5) = +0.0 — keep those)
+    out = xp.where(
+        (x < 0) & xp.isfinite(x) & (y != xp.floor(y)),
+        xp.float64(xp.nan), out,
+    )
     return Val(out, None, T.DOUBLE)
 
 

@@ -151,21 +151,26 @@ def compute_groups_dense(
     """
     cap = out_capacity or num_groups
     assert cap >= num_groups
-    ids = jnp.where(valid, group_ids.astype(jnp.int64), cap)
+    # Segment ops run over num_groups+1 segments, NOT cap+1: XLA:TPU expands
+    # small-segment scatters into a dense [n, num_segments] one-hot product,
+    # so segment count must match the true key space (6 for Q1), never the
+    # caller's generic capacity (4096 would materialize gigabytes per op).
+    ids = jnp.where(valid, group_ids.astype(jnp.int64), num_groups)
     counts = jax.ops.segment_sum(
         jnp.ones(valid.shape, dtype=jnp.int64),
         ids,
-        num_segments=cap + 1,
-    )[:cap]
-    group_valid = counts > 0
+        num_segments=num_groups + 1,
+    )[:num_groups]
+    pad = cap - num_groups
+    group_valid = jnp.pad(counts > 0, (0, pad))
     # representative row per group: min input index holding that gid
     idx = jnp.arange(valid.shape[0], dtype=jnp.int64)
     rep = jax.ops.segment_min(
         jnp.where(valid, idx, jnp.int64(2**62)),
         ids,
-        num_segments=cap + 1,
-    )[:cap]
-    rep = jnp.clip(rep, 0, valid.shape[0] - 1)
+        num_segments=num_groups + 1,
+    )[:num_groups]
+    rep = jnp.pad(jnp.clip(rep, 0, valid.shape[0] - 1), (0, pad))
     return GroupbyResult(
         group_ids=jnp.clip(ids, 0, cap - 1),
         row_valid=valid,

@@ -20,11 +20,18 @@ from typing import Optional, Sequence
 
 import jax.numpy as jnp
 
-_P1 = jnp.uint64(0x9E3779B185EBCA87)
-_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
-_P3 = jnp.uint64(0x165667B19E3779F9)
-_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
-_P5 = jnp.uint64(0x27D4EB2F165667C5)
+# numpy scalars, NOT jnp: a module-level jnp constant is a device buffer
+# that jit traces embed by reference, and on the axon TPU runtime any
+# executable with an embedded device-buffer constant permanently degrades
+# every subsequent kernel launch (~56ms floor, measured). numpy scalars
+# fold to HLO literals at trace time instead.
+import numpy as _np
+
+_P1 = _np.uint64(0x9E3779B185EBCA87)
+_P2 = _np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = _np.uint64(0x165667B19E3779F9)
+_P4 = _np.uint64(0x85EBCA77C2B2AE63)
+_P5 = _np.uint64(0x27D4EB2F165667C5)
 
 
 def _rotl(x: jnp.ndarray, r: int) -> jnp.ndarray:

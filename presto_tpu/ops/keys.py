@@ -21,11 +21,14 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.page import Block
 
-_SIGN64 = jnp.uint64(0x8000000000000000)
+# numpy scalar, not jnp: module-level device buffers embedded as jit
+# constants permanently degrade the axon TPU runtime (see ops/hashing.py)
+_SIGN64 = np.uint64(0x8000000000000000)
 
 
 def _int_order_u64(x: jnp.ndarray) -> jnp.ndarray:
