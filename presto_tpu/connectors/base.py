@@ -81,6 +81,15 @@ class Connector:
     ) -> Page:
         raise NotImplementedError
 
+    def gen_body(self, table: str, n: int, names: Tuple[str, ...]):
+        """Optional traceable chunk generator for SPMD scans: a pure
+        function ``start_row -> (tuple of column arrays, valid mask)`` the
+        distributed executor can call inside shard_map so each mesh device
+        generates its own split on-device. Return None if the connector
+        can only produce host pages (the executor then stages host data
+        shard by shard)."""
+        return None
+
     def pages(
         self,
         table: str,

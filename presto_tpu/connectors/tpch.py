@@ -470,17 +470,23 @@ class TpchConnector(Connector):
         (reference analog: TpchRecordSet cursors parameterized by split)."""
         key = (table, n, names)
         if key not in self._gen_cache:
-            gen = getattr(self, f"_gen_{table}")
-
-            def fn(start):
-                lazy = gen(start, n)
-                return (
-                    tuple(lazy.get(nm) for nm in names),
-                    lazy.get("__valid__"),
-                )
-
-            self._gen_cache[key] = jax.jit(fn)
+            self._gen_cache[key] = jax.jit(self.gen_body(table, n, names))
         return self._gen_cache[key]
+
+    def gen_body(self, table: str, n: int, names: tuple):
+        """Traceable chunk generator (Connector.gen_body): pure function of
+        the traced start row, safe to call inside jit or shard_map — the
+        SPMD scan path generates each device's shard on-device."""
+        gen = getattr(self, f"_gen_{table}")
+
+        def fn(start):
+            lazy = gen(start, n)
+            return (
+                tuple(lazy.get(nm) for nm in names),
+                lazy.get("__valid__"),
+            )
+
+        return fn
 
     # ---- per-table generators: return a _Lazy of column thunks over
     # traced global row keys. All values are pure functions of row keys.

@@ -1,0 +1,15 @@
+"""Distributed execution over a jax device Mesh.
+
+Reference: presto-main's distribution stack — AddExchanges (distribution
+choice), PlanFragmenter (stage cutting), PartitionedOutputOperator /
+ExchangeOperator (HTTP shuffle). TPU-native redesign (SURVEY §3.3, §8.1.5):
+the pod presents as ONE fat worker; pages are global jax.Arrays sharded
+row-wise over the mesh; exchanges are XLA collectives compiled into the
+stage programs (all_to_all repartition, all_gather broadcast/gather)
+instead of serialized HTTP pages.
+"""
+
+from presto_tpu.dist.fragmenter import add_exchanges
+from presto_tpu.dist.executor import DistExecutor, make_mesh
+
+__all__ = ["add_exchanges", "DistExecutor", "make_mesh"]

@@ -1,0 +1,697 @@
+"""DistExecutor: interpret a fragmented plan over a jax device Mesh.
+
+Reference: the worker-side execution of exchanges — operator/
+PartitionedOutputOperator.java (hash rows -> partition -> serialize ->
+HTTP buffer) and operator/ExchangeOperator.java (fetch + deserialize) —
+plus LocalExecutionPlanner wiring. TPU-native redesign: a "page" is ONE
+global jax.Array per column, sharded row-wise across the mesh
+(NamedSharding over axis "d"), and every exchange is an XLA collective
+compiled into the neighboring kernel via shard_map:
+
+    repartition -> per-shard bucketing + lax.all_to_all
+    broadcast   -> lax.all_gather(tiled) to a replicated page
+    gather      -> same collective; semantically the SINGLE partitioning
+                   (every device holds the full stream and runs the final
+                   stage redundantly — replicated compute is free compared
+                   to leaving devices idle)
+
+Shard-local operators reuse the single-device kernels unchanged inside
+shard_map bodies — the Driver loop compiled away, the shuffle compiled in.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from presto_tpu import types as T
+from presto_tpu.exec import agg_states as S
+from presto_tpu.exec import plan as P
+from presto_tpu.exec.executor import (
+    Executor,
+    _final_agg_page,
+    _final_global_agg,
+    _next_pow2,
+    _null_blocks,
+    _partial_agg_page,
+    _partial_global_agg,
+    _probe_join_page,
+    _semi_join_page,
+)
+from presto_tpu.ops import hashing as H
+from presto_tpu.ops import keys as K
+from presto_tpu.ops.compact import compact_indices, concat_all, scatter_column
+from presto_tpu.page import Block, Page
+
+SHARDED = "sharded"
+REPLICATED = "replicated"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), ("d",))
+
+
+class DistExecutor(Executor):
+    """Executes plans produced by dist.fragmenter.add_exchanges.
+
+    Page distribution is tracked statically per node ("sharded" over the
+    mesh vs "replicated"); replicated subtrees run the inherited single-
+    stream code paths (XLA replicates the compute across devices), sharded
+    nodes run shard_map-wrapped kernels.
+    """
+
+    def __init__(self, catalogs, mesh: Mesh, **kw):
+        super().__init__(catalogs, **kw)
+        self.mesh = mesh
+        self.D = int(mesh.devices.size)
+        self._dist_cache: Dict[int, str] = {}
+
+    # ---------------------------------------------------------- dist tags
+    def dist(self, node: P.PhysicalNode) -> str:
+        key = id(node)
+        if key not in self._dist_cache:
+            self._dist_cache[key] = self._compute_dist(node)
+        return self._dist_cache[key]
+
+    def _compute_dist(self, node) -> str:
+        if isinstance(node, P.TableScan):
+            return SHARDED
+        if isinstance(node, P.Values):
+            return REPLICATED
+        if isinstance(node, P.Exchange):
+            return SHARDED if node.kind == "repartition" else REPLICATED
+        if isinstance(node, (P.HashJoin, P.CrossJoin)):
+            return self.dist(node.left)
+        if isinstance(node, P.Union):
+            return self.dist(node.sources[0])
+        children = node.children()
+        return self.dist(children[0]) if children else REPLICATED
+
+    # ------------------------------------------------------------- pages
+    def pages(self, node: P.PhysicalNode) -> Iterator[Page]:
+        if isinstance(node, P.Exchange):
+            yield from self._exec_exchange(node)
+            return
+        if self.dist(node) == REPLICATED and all(
+            self.dist(c) == REPLICATED for c in node.children()
+        ):
+            yield from super().pages(node)
+            return
+        if isinstance(node, P.TableScan):
+            yield from self._scan_sharded(node)
+            return
+        if isinstance(node, P.Filter):
+            from presto_tpu.expr.eval import evaluate_filter
+
+            fn = self._shard_page_kernel(
+                ("d_filter", node.predicate),
+                lambda page, _pred=node.predicate: evaluate_filter(
+                    _pred, page, jnp
+                ),
+            )
+            for page in self.pages(node.source):
+                yield fn(page)
+            return
+        if isinstance(node, P.Project):
+            from presto_tpu.exec.executor import _project_page
+
+            fn = self._shard_page_kernel(
+                ("d_project", node.exprs),
+                functools.partial(_project_page, node.exprs),
+            )
+            for page in self.pages(node.source):
+                yield fn(page)
+            return
+        if isinstance(node, P.Aggregation):
+            yield from self._dist_aggregation(node)
+            return
+        if isinstance(node, P.HashJoin):
+            yield from self._dist_join(node)
+            return
+        if isinstance(node, P.CrossJoin):
+            yield from self._dist_cross_join(node)
+            return
+        if isinstance(node, P.UniqueId):
+            yield from self._dist_unique_id(node)
+            return
+        if isinstance(node, P.Union):
+            for src in node.sources:
+                yield from self.pages(src)
+            return
+        if isinstance(node, P.Output):
+            yield from self.pages(node.source)
+            return
+        raise TypeError(
+            f"DistExecutor: node {type(node).__name__} requires a "
+            f"replicated input (fragmenter should have inserted a gather)"
+        )
+
+    # ----------------------------------------------------------- helpers
+    def _shard_page_kernel(self, key, fn):
+        """shard_map-wrap a pure page->page kernel (shard-local map)."""
+        if key not in self._jit_cache:
+            body = jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(PS("d"),),
+                out_specs=PS("d"), check_vma=False,
+            )
+            self._jit_cache[key] = jax.jit(body)
+        return self._jit_cache[key]
+
+    # -------------------------------------------------------------- scan
+    def _scan_sharded(self, node: P.TableScan) -> Iterator[Page]:
+        conn = self.catalogs[node.catalog]
+        schema = conn.table_schema(node.table)
+        names = tuple(node.columns)
+        splits = conn.splits(node.table, target_rows=self.page_rows)
+        n = splits[0].row_count
+        total = splits[-1].start_row + splits[-1].row_count
+        body = conn.gen_body(node.table, n, names)
+        if body is None:
+            yield from self._scan_staged(node, conn, names)
+            return
+        dicts = getattr(conn, "_dicts", {}).get(node.table, {})
+
+        def gen_local(start_arr):
+            start = start_arr[0]
+            datas, valid = body(start)
+            # rounds are padded to D devices; slots past the table are
+            # masked out here (the generator itself has no bound)
+            in_range = (
+                start + jnp.arange(n, dtype=jnp.int64)
+            ) < jnp.int64(total)
+            return datas, valid & in_range
+
+        key = ("d_scan", node.catalog, node.table, names, n)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                gen_local, mesh=self.mesh,
+                in_specs=(PS("d"),), out_specs=PS("d"), check_vma=False,
+            ))
+        fn = self._jit_cache[key]
+
+        starts = [s.start_row for s in splits]
+        spec = NamedSharding(self.mesh, PS("d"))
+        for r in range(0, len(starts), self.D):
+            chunk = starts[r:r + self.D]
+            # pad the tail round; padded starts generate fully-masked rows
+            chunk = chunk + [total] * (self.D - len(chunk))
+            start_arr = jax.device_put(
+                np.asarray(chunk, dtype=np.int64), spec
+            )
+            datas, valid = fn(start_arr)
+            blocks = tuple(
+                Block(
+                    data=data,
+                    type=schema.column_type(nm),
+                    nulls=None,
+                    dictionary=dicts.get(nm),
+                )
+                for nm, data in zip(names, datas)
+            )
+            yield Page(blocks=blocks, valid=valid)
+
+    def _scan_staged(self, node, conn, names) -> Iterator[Page]:
+        """Host-page connectors (e.g. memory connector): stage each round
+        of host splits onto the mesh devices directly."""
+        spec = NamedSharding(self.mesh, PS("d"))
+        pages = list(conn.pages(node.table, names,
+                                target_rows=self.page_rows))
+        if not pages:
+            return
+        cap = max(p.capacity for p in pages)
+        for r in range(0, len(pages), self.D):
+            chunk = pages[r:r + self.D]
+            yield _stack_to_mesh(chunk, cap, self.D, spec)
+
+    # --------------------------------------------------------- exchanges
+    def _exec_exchange(self, node: P.Exchange) -> Iterator[Page]:
+        src_dist = self.dist(node.source)
+        if node.kind in ("gather", "broadcast"):
+            if src_dist == REPLICATED:
+                yield from self.pages(node.source)
+                return
+            fn = self._gather_fn()
+            for page in self.pages(node.source):
+                yield fn(page)
+            return
+        if node.kind == "repartition":
+            if src_dist == REPLICATED:
+                # replicated -> sharded: each device keeps its hash
+                # residues (deterministic disjoint split, no comms)
+                fn = self._residue_fn(node.keys)
+            else:
+                fn = self._repartition_fn(node.keys)
+            for page in self.pages(node.source):
+                out, overflow = fn(page)
+                self._pending_overflow.append(overflow)
+                yield out
+            return
+        raise ValueError(f"unknown exchange kind {node.kind!r}")
+
+    def _gather_fn(self):
+        key = ("d_gather",)
+        if key not in self._jit_cache:
+            def body(page):
+                return jax.tree.map(
+                    lambda x: jax.lax.all_gather(x, "d", tiled=True), page
+                )
+
+            # check_vma=False: all_gather(tiled) output IS replicated but
+            # jax's varying-axis inference cannot prove it
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=(PS("d"),), out_specs=PS(),
+                check_vma=False,
+            ))
+        return self._jit_cache[key]
+
+    def _key_hash(self, page: Page, keys: Tuple[int, ...]) -> jnp.ndarray:
+        blocks = [page.block(c) for c in keys]
+        cols, nulls = K.block_key_columns(blocks)
+        return H.hash_columns(cols, nulls)
+
+    def _repartition_fn(self, keys: Tuple[int, ...]):
+        """hash(keys) % D routing via lax.all_to_all — the
+        PartitionedOutputOperator -> ExchangeOperator data plane as one
+        compiled collective (SURVEY §3.3 north-star mapping)."""
+        D = self.D
+
+        def body(page: Page):
+            R = page.capacity  # local rows per device
+            h = self._key_hash(page, keys)
+            tgt = (h % jnp.uint64(D)).astype(jnp.int32)
+            tgt = jnp.where(page.valid, tgt, D)
+            # stable-sort rows by destination, compute position within
+            # each destination bucket
+            perm = jnp.argsort(tgt, stable=True)
+            st = tgt[perm]
+            first = jnp.searchsorted(
+                st, jnp.arange(D, dtype=st.dtype), side="left"
+            )
+            pos = jnp.arange(R, dtype=jnp.int64) - first[
+                jnp.clip(st, 0, D - 1)].astype(jnp.int64)
+            # send layout [D, R]: slot (dest, pos); invalid rows drop
+            slot = jnp.where(
+                (st < D) & (pos < R),
+                st.astype(jnp.int64) * R + pos,
+                jnp.int64(D * R),
+            )
+
+            def to_send(x):
+                out = jnp.zeros((D * R,), dtype=x.dtype)
+                return out.at[slot].set(x[perm], mode="drop").reshape(D, R)
+
+            sent = jax.tree.map(to_send, page)  # includes valid
+            recv = jax.tree.map(
+                lambda x: jax.lax.all_to_all(
+                    x, "d", split_axis=0, concat_axis=0, tiled=False
+                ),
+                sent,
+            )
+            flat = jax.tree.map(
+                lambda x: x.reshape((D * R,) + x.shape[2:]), recv
+            )
+            flat_valid = flat.valid
+            # compact the D*R landing zone back to a bounded local page
+            out_cap = min(D * R, _next_pow2(2 * R))
+            targets, out_valid, num = compact_indices(flat_valid, out_cap)
+            blocks = []
+            for blk in flat.blocks:
+                if isinstance(blk.data, tuple):
+                    data = tuple(
+                        scatter_column(d, targets, out_cap)
+                        for d in blk.data
+                    )
+                else:
+                    data = scatter_column(blk.data, targets, out_cap)
+                nulls = (
+                    scatter_column(blk.nulls, targets, out_cap)
+                    if blk.nulls is not None else None
+                )
+                blocks.append(blk.with_data(data, nulls=nulls))
+            out = Page(blocks=tuple(blocks), valid=out_valid)
+            overflow = jax.lax.psum(
+                (num > out_cap).astype(jnp.int32), "d") > 0
+            return out, overflow
+
+        key = ("d_repart", keys, self.D)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=(PS("d"),),
+                out_specs=(PS("d"), PS()), check_vma=False,
+            ))
+        return self._jit_cache[key]
+
+    def _residue_fn(self, keys: Tuple[int, ...]):
+        """Replicated -> sharded: device i keeps rows with
+        hash(keys) % D == i (no data movement; the replica is local)."""
+        D = self.D
+
+        def body(page: Page):
+            me = jax.lax.axis_index("d")
+            h = self._key_hash(page, keys)
+            mine = (h % jnp.uint64(D)).astype(jnp.int32) == me
+            out = page.with_valid(page.valid & mine)
+            return out, jnp.asarray(False)
+
+        key = ("d_residue", keys, self.D)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=(PS(),),
+                out_specs=(PS("d"), PS()), check_vma=False,
+            ))
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------- aggregation
+    def _dist_aggregation(self, node: P.Aggregation) -> Iterator[Page]:
+        src_dist = self.dist(node.source)
+        if node.step == "partial" and src_dist == SHARDED:
+            in_types = self._agg_in_types(node)
+            layouts = tuple(
+                tuple(S.state_layout(s.function, t))
+                for s, t in zip(node.aggregates, in_types)
+            )
+            if not node.group_channels:
+                fn = self._shard_page_kernel(
+                    ("d_gagg_partial", node),
+                    functools.partial(
+                        _partial_global_agg, node.aggregates, layouts
+                    ),
+                )
+                for page in self.pages(node.source):
+                    yield fn(page)
+                return
+            cap = _next_pow2(node.capacity * self._capacity_boost)
+            max_iters = 64 * self._capacity_boost
+
+            def make(local_cap):
+                def body(page):
+                    out, ovf = _partial_agg_page(
+                        node.group_channels, node.aggregates, layouts,
+                        page, local_cap, max_iters,
+                    )
+                    return out, jax.lax.psum(
+                        ovf.astype(jnp.int32), "d") > 0
+
+                return jax.jit(jax.shard_map(
+                    body, mesh=self.mesh, in_specs=(PS("d"),),
+                    out_specs=(PS("d"), PS()), check_vma=False,
+            ))
+
+            for page in self.pages(node.source):
+                local_cap = min(
+                    cap, _next_pow2(page.capacity // self.D)
+                )
+                key = ("d_agg_partial", node, local_cap, max_iters)
+                if key not in self._jit_cache:
+                    self._jit_cache[key] = make(local_cap)
+                out, overflow = self._jit_cache[key](page)
+                self._pending_overflow.append(overflow)
+                yield out
+            return
+        if node.step == "final" and src_dist == SHARDED:
+            # repartitioned state pages: keys are co-located per device,
+            # final agg runs shard-locally
+            origin = self._partial_origin(node)
+            in_types = self._agg_in_types(origin)
+            layouts = tuple(
+                tuple(S.state_layout(s.function, t))
+                for s, t in zip(node.aggregates, in_types)
+            )
+            pages = list(self.pages(node.source))
+            if not pages:
+                return
+            local_caps = tuple(p.capacity // self.D for p in pages)
+            fcap = min(
+                _next_pow2(node.capacity * self._capacity_boost),
+                _next_pow2(sum(local_caps)),
+            )
+            max_iters = 64 * self._capacity_boost
+
+            def body(*pgs):
+                merged = concat_all(pgs) if len(pgs) > 1 else pgs[0]
+                out, ovf = _final_agg_page(
+                    node.group_channels, node.aggregates, layouts,
+                    tuple(in_types), merged, fcap, max_iters,
+                )
+                return out, jax.lax.psum(ovf.astype(jnp.int32), "d") > 0
+
+            key = ("d_agg_final", node, local_caps, fcap, max_iters)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=tuple(PS("d") for _ in pages),
+                    out_specs=(PS("d"), PS()), check_vma=False,
+            ))
+            out, overflow = self._jit_cache[key](*pages)
+            self._pending_overflow.append(overflow)
+            yield out
+            return
+        # replicated input: inherited single-stream paths
+        yield from super()._exec_aggregation(node)
+
+    # -------------------------------------------------------------- join
+    def _dist_join(self, node: P.HashJoin) -> Iterator[Page]:
+        dl, dr = self.dist(node.left), self.dist(node.right)
+        if dl == REPLICATED and dr == REPLICATED:
+            yield from super()._exec_join(node)
+            return
+        # build side: replicated (broadcast) or sharded (partitioned)
+        build_pages = list(self.pages(node.right))
+        right_types = self.output_types(node.right)
+        left_types = self.output_types(node.left)
+        if not build_pages:
+            from presto_tpu.exec.executor import _empty_page
+
+            build_pages = [_empty_page(right_types, cap=self.D * 8)]
+        build_all = (
+            concat_all(build_pages) if len(build_pages) > 1
+            else build_pages[0]
+        )
+        build_spec = PS() if dr == REPLICATED else PS("d")
+        probe_spec = PS("d") if dl == SHARDED else PS()
+
+        if node.join_type in ("semi", "anti"):
+            def semi_body(page, build):
+                return _semi_join_page(
+                    node.left_keys, node.right_keys, page, build
+                )
+
+            key = ("d_semi", node, build_all.capacity)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(jax.shard_map(
+                    semi_body, mesh=self.mesh,
+                    in_specs=(probe_spec, build_spec),
+                    out_specs=PS("d") if dl == SHARDED else PS(), check_vma=False,
+            ))
+            for page in self.pages(node.left):
+                yield self._jit_cache[key](page, build_all)
+            return
+
+        local_build_cap = (
+            build_all.capacity if dr == REPLICATED
+            else build_all.capacity // self.D
+        )
+        matched_acc = None
+        probe_pages = list(self.pages(node.left))
+        for page in probe_pages:
+            local_probe = (
+                page.capacity // self.D if dl == SHARDED
+                else page.capacity
+            )
+            oc = _next_pow2(
+                max(local_probe, local_build_cap) * 2
+                * self._capacity_boost
+            )
+
+            def probe_body(pg, build, oc=oc):
+                out, matched, ovf = _probe_join_page(
+                    node.left_keys, node.right_keys, node.join_type,
+                    pg, build, oc,
+                )
+                ovf = jax.lax.psum(ovf.astype(jnp.int32), "d") > 0
+                if dr == REPLICATED:
+                    # matched refers to replicated build rows: OR the
+                    # per-device views so outer emission sees every match
+                    matched = jax.lax.psum(
+                        matched.astype(jnp.int32), "d") > 0
+                return out, matched, ovf
+
+            key = ("d_probe", node, page.capacity, build_all.capacity,
+                   oc, dl, dr)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(jax.shard_map(
+                    probe_body, mesh=self.mesh,
+                    in_specs=(probe_spec, build_spec),
+                    out_specs=(
+                        PS("d"),
+                        PS() if dr == REPLICATED else PS("d"),
+                        PS(),
+                    ), check_vma=False,
+            ))
+            out, matched, overflow = self._jit_cache[key](page, build_all)
+            self._pending_overflow.append(overflow)
+            matched_acc = (
+                matched if matched_acc is None else matched_acc | matched
+            )
+            yield out
+        if node.join_type in ("right", "full"):
+            yield self._outer_build_rows(
+                node, build_all, matched_acc, left_types, dr
+            )
+
+    def _outer_build_rows(self, node, build_all, matched, left_types, dr):
+        """Unmatched build rows with a null probe side. Replicated builds
+        are emitted once per hash residue so the sharded stream holds each
+        row exactly once."""
+        D = self.D
+
+        def body(build, m):
+            unmatched = build.valid & ~m
+            if dr == REPLICATED:
+                me = jax.lax.axis_index("d")
+                idx = jnp.arange(build.capacity, dtype=jnp.int32)
+                unmatched = unmatched & ((idx % D) == me)
+            nulls = _null_blocks(left_types, build.capacity)
+            return Page(
+                blocks=tuple(nulls) + build.blocks, valid=unmatched
+            )
+
+        key = ("d_outer", node, build_all.capacity, dr)
+        if key not in self._jit_cache:
+            bspec = PS() if dr == REPLICATED else PS("d")
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=(bspec, bspec),
+                out_specs=PS("d"), check_vma=False,
+            ))
+        return self._jit_cache[key](build_all, matched)
+
+    def _dist_cross_join(self, node: P.CrossJoin) -> Iterator[Page]:
+        from presto_tpu.exec.executor import _cross_join_page, compact_page
+
+        # fragmenter guarantees the right side is replicated
+        right_pages = list(self.pages(node.right))
+        if not right_pages:
+            return
+        build_all = concat_all(right_pages)
+        bcap = min(
+            _next_pow2(build_all.capacity),
+            _next_pow2(4096 * self._capacity_boost),
+        )
+        self._pending_overflow.append(build_all.num_rows() > bcap)
+        build = compact_page(build_all, bcap)
+
+        def body(pg, b):
+            return _cross_join_page(pg, b)
+
+        for page in self.pages(node.left):
+            key = ("d_cross", node, page.capacity, bcap)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(jax.shard_map(
+                    body, mesh=self.mesh, in_specs=(PS("d"), PS()),
+                    out_specs=PS("d"), check_vma=False,
+            ))
+            yield self._jit_cache[key](page, build)
+
+    def _dist_unique_id(self, node: P.UniqueId) -> Iterator[Page]:
+        # globally-unique bigint per row: device index in the high bits
+        offset = 0
+
+        def body(page, off):
+            me = jax.lax.axis_index("d").astype(jnp.int64)
+            ids = (
+                (me << jnp.int64(40))
+                + off
+                + jnp.arange(page.capacity, dtype=jnp.int64)
+            )
+            blk = Block(data=ids, type=T.BIGINT)
+            return Page(blocks=page.blocks + (blk,), valid=page.valid)
+
+        for page in self.pages(node.source):
+            key = ("d_uid", node, page.capacity)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(jax.shard_map(
+                    body, mesh=self.mesh, in_specs=(PS("d"), PS()),
+                    out_specs=PS("d"), check_vma=False,
+            ))
+            yield self._jit_cache[key](page, jnp.int64(offset))
+            offset += page.capacity
+
+
+def _stack_to_mesh(pages: List[Page], cap: int, D: int, spec) -> Page:
+    """Stage up to D host pages as one mesh-sharded global page (host
+    data path for connectors without on-device generators)."""
+    import numpy as _np
+
+    padded: List[Optional[Page]] = list(pages) + [None] * (D - len(pages))
+
+    first = pages[0]
+    blocks = []
+    for ch in range(first.channel_count):
+        datas, nulls_l = [], []
+        any_nulls = any(
+            p is not None and p.block(ch).nulls is not None for p in padded
+        )
+        for p in padded:
+            if p is None:
+                blk0 = first.block(ch)
+                if isinstance(blk0.data, tuple):
+                    datas.append(tuple(
+                        _np.zeros(cap, _np.asarray(d).dtype)
+                        for d in blk0.data
+                    ))
+                else:
+                    datas.append(
+                        _np.zeros(cap, _np.asarray(blk0.data).dtype)
+                    )
+                nulls_l.append(_np.ones(cap, bool))
+                continue
+            blk = p.block(ch)
+            if isinstance(blk.data, tuple):
+                datas.append(tuple(
+                    _pad_np(_np.asarray(d), cap) for d in blk.data
+                ))
+            else:
+                datas.append(_pad_np(_np.asarray(blk.data), cap))
+            nulls_l.append(
+                _pad_np(_np.asarray(blk.nulls), cap)
+                if blk.nulls is not None else _np.zeros(cap, bool)
+            )
+        blk0 = first.block(ch)
+        if isinstance(blk0.data, tuple):
+            data = tuple(
+                jax.device_put(
+                    _np.concatenate([d[i] for d in datas]), spec
+                )
+                for i in range(2)
+            )
+        else:
+            data = jax.device_put(_np.concatenate(datas), spec)
+        nulls = (
+            jax.device_put(_np.concatenate(nulls_l), spec)
+            if any_nulls else None
+        )
+        blocks.append(Block(
+            data=data, type=blk0.type, nulls=nulls,
+            dictionary=blk0.dictionary,
+        ))
+    valid = _np.concatenate([
+        _pad_np(_np.asarray(p.valid), cap) if p is not None
+        else _np.zeros(cap, bool)
+        for p in padded
+    ])
+    return Page(blocks=tuple(blocks), valid=jax.device_put(valid, spec))
+
+
+def _pad_np(arr, cap):
+    if arr.shape[0] == cap:
+        return arr
+    out = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out

@@ -1,0 +1,172 @@
+"""Exchange insertion: decide each operator's distribution and place
+Exchange nodes at the boundaries.
+
+Reference: presto-main sql/planner/optimizations/AddExchanges.java (the
+partitioned-vs-broadcast join decision, SINGLE gathers before final
+stages) + PlanFragmenter.java (stage cutting). Our stages need no explicit
+fragment objects: every Exchange in the tree IS the stage boundary, and
+the DistExecutor compiles the collectives directly into the neighboring
+kernels.
+
+Distributions (PartitioningHandle analogs):
+  "sharded"    — rows split across mesh devices (FIXED/SOURCE distribution)
+  "replicated" — every device holds all rows (the degenerate same-everywhere
+                 form of SINGLE: gather-to-one with free replication, which
+                 is how a SINGLE stage looks when every device runs it)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+from presto_tpu.exec import plan as P
+
+SHARDED = "sharded"
+REPLICATED = "replicated"
+
+# build sides up to this many estimated rows replicate to every device
+# (reference: join-distribution-type=broadcast + small-table heuristic)
+BROADCAST_ROWS = 1 << 21
+# grouped aggregations up to this capacity gather partials to one stream;
+# larger ones repartition by group key so final state stays sharded
+GATHER_CAPACITY = 1 << 17
+
+
+def est_rows(node: P.PhysicalNode, catalogs) -> int:
+    """Crude static cardinality estimate (reference: the pre-CBO era's
+    source-size heuristics in DetermineJoinDistributionType)."""
+    if isinstance(node, P.TableScan):
+        return catalogs[node.catalog].row_count(node.table)
+    if isinstance(node, P.Values):
+        return len(node.rows)
+    if isinstance(node, (P.Filter, P.Project, P.UniqueId, P.Exchange)):
+        return est_rows(node.source, catalogs)
+    if isinstance(node, P.Aggregation):
+        base = est_rows(node.source, catalogs)
+        return 1 if not node.group_channels else min(base, node.capacity)
+    if isinstance(node, P.HashJoin):
+        left = est_rows(node.left, catalogs)
+        if node.join_type in ("semi", "anti", "left"):
+            return left
+        return max(left, est_rows(node.right, catalogs))
+    if isinstance(node, P.CrossJoin):
+        return est_rows(node.left, catalogs) * max(
+            est_rows(node.right, catalogs), 1
+        )
+    if isinstance(node, P.Union):
+        return sum(est_rows(s, catalogs) for s in node.sources)
+    if isinstance(node, (P.Sort, P.Output)):
+        return est_rows(node.source, catalogs)
+    if isinstance(node, P.TopN):
+        return min(est_rows(node.source, catalogs), node.limit)
+    if isinstance(node, P.Limit):
+        return min(est_rows(node.source, catalogs),
+                   node.count + node.offset)
+    return 1 << 30
+
+
+def _gather(node):
+    return P.Exchange(source=node, kind="gather")
+
+
+def add_exchanges(
+    node: P.PhysicalNode,
+    catalogs,
+    *,
+    broadcast_rows: int = BROADCAST_ROWS,
+    gather_capacity: int = GATHER_CAPACITY,
+) -> Tuple[P.PhysicalNode, str]:
+    """Rewrite a single-stream physical plan into a distributed one.
+
+    Returns (plan', distribution of its output). The root is always
+    gathered so Output decodes a replicated page.
+    """
+
+    def rewrite(n) -> Tuple[P.PhysicalNode, str]:
+        if isinstance(n, P.TableScan):
+            return n, SHARDED
+        if isinstance(n, P.Values):
+            return n, REPLICATED
+        if isinstance(n, (P.Filter, P.Project, P.UniqueId)):
+            src, d = rewrite(n.source)
+            return dataclasses.replace(n, source=src), d
+        if isinstance(n, P.Union):
+            parts = [rewrite(s) for s in n.sources]
+            if all(d == REPLICATED for _, d in parts):
+                return P.Union(tuple(s for s, _ in parts)), REPLICATED
+            # mixed or all-sharded: bring everything to sharded? a
+            # replicated branch concatenated into a sharded stream would
+            # duplicate rows per device — gather the sharded branches
+            # instead (correct for the small unions the planner emits)
+            srcs = tuple(
+                s if d == REPLICATED else _gather(s) for s, d in parts
+            )
+            return P.Union(srcs), REPLICATED
+        if isinstance(n, P.Aggregation):
+            src, d = rewrite(n.source)
+            if d == REPLICATED:
+                return dataclasses.replace(n, source=src), REPLICATED
+            partial = dataclasses.replace(n, source=src, step="partial")
+            nkeys = len(n.group_channels)
+            if not nkeys or n.capacity <= gather_capacity:
+                ex = _gather(partial)
+                out_d = REPLICATED
+            else:
+                ex = P.Exchange(
+                    source=partial, kind="repartition",
+                    keys=tuple(range(nkeys)),
+                )
+                out_d = SHARDED
+            final = dataclasses.replace(
+                n, source=ex, step="final",
+                group_channels=tuple(range(nkeys)),
+            )
+            return final, out_d
+        if isinstance(n, P.HashJoin):
+            left, dl = rewrite(n.left)
+            right, dr = rewrite(n.right)
+            if dl == REPLICATED and dr == REPLICATED:
+                return dataclasses.replace(
+                    n, left=left, right=right), REPLICATED
+            if dr == SHARDED:
+                if est_rows(n.right, catalogs) <= broadcast_rows:
+                    right = P.Exchange(source=right, kind="broadcast")
+                    dr = REPLICATED
+                elif dl == REPLICATED:
+                    right = _gather(right)
+                    dr = REPLICATED
+                else:
+                    # partitioned join: both sides repartition on the
+                    # equi-join keys so matching rows co-locate
+                    left = P.Exchange(
+                        source=left, kind="repartition",
+                        keys=n.left_keys,
+                    )
+                    right = P.Exchange(
+                        source=right, kind="repartition",
+                        keys=n.right_keys,
+                    )
+                    return dataclasses.replace(
+                        n, left=left, right=right), SHARDED
+            # dr now REPLICATED; output follows probe side
+            return dataclasses.replace(n, left=left, right=right), dl
+        if isinstance(n, P.CrossJoin):
+            left, dl = rewrite(n.left)
+            right, dr = rewrite(n.right)
+            if dl == SHARDED and est_rows(n.left, catalogs) > 0:
+                # keep probe sharded, replicate the (small) build side
+                if dr == SHARDED:
+                    right = P.Exchange(source=right, kind="broadcast")
+                return P.CrossJoin(left, right), SHARDED
+            if dr == SHARDED:
+                right = _gather(right)
+            return P.CrossJoin(left, right), dl
+        if isinstance(n, (P.Sort, P.TopN, P.Limit, P.Output)):
+            src, d = rewrite(n.source)
+            if d == SHARDED:
+                src = _gather(src)
+            return dataclasses.replace(n, source=src), REPLICATED
+        raise TypeError(f"add_exchanges: unknown node {n!r}")
+
+    return rewrite(node)

@@ -138,12 +138,37 @@ class Executor:
         if isinstance(node, P.Project):
             return [e.type for e in node.exprs]
         if isinstance(node, P.Aggregation):
+            if node.step == "partial":
+                # keys followed by accumulator state columns (reference:
+                # AggregationNode.Step.PARTIAL emits intermediate types)
+                src = self.output_types(node.source)
+                out = [src[c] for c in node.group_channels]
+                for spec, in_t in zip(
+                    node.aggregates, self._agg_in_types(node)
+                ):
+                    for st in S.state_layout(spec.function, in_t):
+                        out.append(st.type)
+                return out
+            if node.step == "final":
+                origin = self._partial_origin(node)
+                src = self.output_types(origin.source)
+                out = [
+                    self.output_types(node.source)[i]
+                    for i in range(len(node.group_channels))
+                ]
+                for spec in node.aggregates:
+                    in_t = (None if spec.channel is None
+                            else src[spec.channel])
+                    out.append(S.result_type(spec.function, in_t))
+                return out
             src = self.output_types(node.source)
             out = [src[c] for c in node.group_channels]
             for spec in node.aggregates:
                 in_t = None if spec.channel is None else src[spec.channel]
                 out.append(S.result_type(spec.function, in_t))
             return out
+        if isinstance(node, P.Exchange):
+            return self.output_types(node.source)
         if isinstance(node, P.HashJoin):
             left = self.output_types(node.left)
             if node.join_type in ("semi", "anti"):
@@ -262,6 +287,12 @@ class Executor:
         if isinstance(node, P.Output):
             yield from self.pages(node.source)
             return
+        if isinstance(node, P.Exchange):
+            # single-device execution: every exchange is a no-op pass-
+            # through (one device holds everything); DistExecutor overrides
+            # with the collective implementations
+            yield from self.pages(node.source)
+            return
         raise TypeError(f"unknown node: {node!r}")
 
     def execute(self, node: P.PhysicalNode):
@@ -304,7 +335,103 @@ class Executor:
             for s in node.aggregates
         ]
 
+    def _partial_origin(self, node: P.Aggregation) -> P.Aggregation:
+        """The partial-step aggregation feeding a final-step one (possibly
+        through exchanges); needed to recover original input types."""
+        src = node.source
+        while isinstance(src, P.Exchange):
+            src = src.source
+        if not (isinstance(src, P.Aggregation) and src.step == "partial"):
+            raise TypeError(
+                "final-step aggregation must consume a partial-step one"
+            )
+        return src
+
+    def _exec_agg_partial(self, node: P.Aggregation) -> Iterator[Page]:
+        """Partial step only: one state page per input page (reference:
+        AggregationNode.Step.PARTIAL before the exchange)."""
+        in_types = self._agg_in_types(node)
+        layouts = [
+            S.state_layout(s.function, t)
+            for s, t in zip(node.aggregates, in_types)
+        ]
+        if not node.group_channels:
+            fn = self._jit(
+                ("gagg_partial", node),
+                functools.partial(
+                    _partial_global_agg, node.aggregates,
+                    tuple(tuple(l) for l in layouts)
+                ),
+            )
+            for page in self.pages(node.source):
+                yield fn(page)
+            return
+        cap = _next_pow2(node.capacity * self._capacity_boost)
+        max_iters = 64 * self._capacity_boost
+        fn = self._jit(
+            ("agg_partial", node),
+            functools.partial(
+                _partial_agg_page, node.group_channels, node.aggregates,
+                tuple(tuple(l) for l in layouts)
+            ),
+            static_argnums=(1, 2),
+        )
+        for page in self.pages(node.source):
+            out, overflow = fn(
+                page, min(cap, _next_pow2(page.capacity)), max_iters
+            )
+            self._pending_overflow.append(overflow)
+            yield out
+
+    def _exec_agg_final(self, node: P.Aggregation) -> Iterator[Page]:
+        """Final step: merge partial-state pages after an exchange."""
+        origin = self._partial_origin(node)
+        in_types = self._agg_in_types(origin)
+        layouts = [
+            S.state_layout(s.function, t)
+            for s, t in zip(node.aggregates, in_types)
+        ]
+        pages = list(self.pages(node.source))
+        if not node.group_channels:
+            merged = (
+                concat_all(pages) if pages
+                else _empty_state_page(node.aggregates, layouts)
+            )
+            fn = self._jit(
+                ("gagg_final", node),
+                functools.partial(
+                    _final_global_agg, node.aggregates,
+                    tuple(tuple(l) for l in layouts), tuple(in_types)
+                ),
+            )
+            yield fn(merged)
+            return
+        if not pages:
+            return
+        merged = concat_all(pages) if len(pages) > 1 else pages[0]
+        fn = self._jit(
+            ("agg_final", node),
+            functools.partial(
+                _final_agg_page, node.group_channels, node.aggregates,
+                tuple(tuple(l) for l in layouts), tuple(in_types)
+            ),
+            static_argnums=(1, 2),
+        )
+        fcap = min(
+            _next_pow2(node.capacity * self._capacity_boost),
+            _next_pow2(merged.capacity),
+        )
+        out, overflow = fn(merged, fcap, 64 * self._capacity_boost)
+        self._pending_overflow.append(overflow)
+        yield out
+
     def _exec_aggregation(self, node: P.Aggregation) -> Iterator[Page]:
+        if node.step == "partial":
+            yield from self._exec_agg_partial(node)
+            return
+        if node.step == "final":
+            yield from self._exec_agg_final(node)
+            return
         in_types = self._agg_in_types(node)
         layouts = [
             S.state_layout(s.function, t)

@@ -26,21 +26,47 @@ class QueryResult:
 
 
 class LocalRunner:
+    """mesh=None runs single-stream; passing a jax.sharding.Mesh turns
+    this into the distributed runner (reference analog: LocalQueryRunner
+    vs DistributedQueryRunner — same engine, exchanges become real)."""
+
     def __init__(
         self,
         catalogs: Dict[str, Connector],
         default_catalog: str = "tpch",
         page_rows: int = 1 << 18,
+        mesh=None,
+        dist_options: Optional[Dict] = None,
     ):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
-        self.executor = Executor(catalogs, page_rows=page_rows)
+        self.mesh = mesh
+        self.dist_options = dist_options or {}
+        if mesh is None:
+            self.executor = Executor(catalogs, page_rows=page_rows)
+        else:
+            from presto_tpu.dist.executor import DistExecutor
+
+            self.executor = DistExecutor(
+                catalogs, mesh, page_rows=page_rows
+            )
 
     def _planner(self) -> Planner:
+        def scalar_exec(node):
+            # plan-time scalar subqueries must also be fragmented before
+            # they hit a distributed executor
+            if self.mesh is not None:
+                from presto_tpu.dist.fragmenter import add_exchanges
+
+                node, _ = add_exchanges(
+                    node, self.catalogs, **self.dist_options
+                )
+            return self.executor.execute(node)[1]
+
         return Planner(
             self.catalogs,
             self.default_catalog,
-            scalar_executor=lambda node: self.executor.execute(node)[1],
+            scalar_executor=scalar_exec,
         )
 
     def plan(self, sql: str) -> P.Output:
@@ -48,7 +74,14 @@ class LocalRunner:
         if isinstance(stmt, N.Explain):
             stmt = stmt.query
         out = self._planner().plan_statement(stmt)
-        return prune_plan(out, self.catalogs)
+        out = prune_plan(out, self.catalogs)
+        if self.mesh is not None:
+            from presto_tpu.dist.fragmenter import add_exchanges
+
+            out, _dist = add_exchanges(
+                out, self.catalogs, **self.dist_options
+            )
+        return out
 
     def execute(self, sql: str) -> QueryResult:
         stmt = parse(sql)
@@ -79,8 +112,12 @@ def explain_text(node: P.PhysicalNode, indent: int = 0) -> str:
             f"{s.function}({'' if s.channel is None else '#%d' % s.channel})"
             for s in node.aggregates
         )
+        step = "" if node.step == "single" else f" step={node.step}"
         line = (f"{pad}Aggregate[keys={list(node.group_channels)} "
-                f"aggs=[{fns}]]")
+                f"aggs=[{fns}]{step}]")
+    elif isinstance(node, P.Exchange):
+        keys = f" keys={list(node.keys)}" if node.keys else ""
+        line = f"{pad}Exchange[{node.kind}{keys}]"
     elif isinstance(node, P.HashJoin):
         line = (f"{pad}{node.join_type.capitalize()}Join"
                 f"[probe={list(node.left_keys)} "
