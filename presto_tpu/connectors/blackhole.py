@@ -1,0 +1,57 @@
+"""Blackhole connector: accepts any write and discards it; reads return
+empty tables. Reference: presto-blackhole (BlackHoleConnector) — the null
+sink/source used by perf tests and as a fixture double.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import (
+    ColumnSchema,
+    Connector,
+    Split,
+    TableSchema,
+)
+from presto_tpu.page import Page
+
+
+class BlackholeConnector(Connector):
+    name = "blackhole"
+
+    def __init__(self):
+        self._schemas: Dict[str, TableSchema] = {}
+
+    def create_table(self, name, column_names, column_types, rows,
+                     *, replace: bool = False) -> int:
+        self._schemas[name] = TableSchema(
+            name,
+            tuple(
+                ColumnSchema(n, t)
+                for n, t in zip(column_names, column_types)
+            ),
+        )
+        return len(rows)  # acknowledged, discarded
+
+    def insert(self, name, rows) -> int:
+        return len(rows)
+
+    def drop_table(self, name) -> None:
+        self._schemas.pop(name, None)
+
+    def tables(self) -> List[str]:
+        return sorted(self._schemas)
+
+    def table_schema(self, table: str) -> TableSchema:
+        if table not in self._schemas:
+            raise KeyError(f"no table {table!r}")
+        return self._schemas[table]
+
+    def row_count(self, table: str) -> int:
+        return 0
+
+    def page_for_split(
+        self, split: Split, columns: Optional[Sequence[str]] = None
+    ) -> Page:  # pragma: no cover - zero splits are never generated
+        raise AssertionError("blackhole tables have no rows")

@@ -1,0 +1,120 @@
+"""In-memory connector: CREATE TABLE AS stores pages in host RAM; scans
+stage them to the device (the real host->HBM path, unlike the tpch
+generator which computes rows in HBM).
+
+Reference: presto-memory (MemoryConnector, MemoryPagesStore,
+MemoryPageSinkProvider) — named in BASELINE config 1.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import (
+    ColumnSchema,
+    Connector,
+    Split,
+    TableSchema,
+)
+from presto_tpu.page import Dictionary, Page
+
+
+class _StoredTable:
+    """Host-RAM column store (MemoryPagesStore analog): plain python/numpy
+    columns plus per-column dictionaries for strings, built once at write
+    time so scans stage straight into device pages."""
+
+    def __init__(self, schema: TableSchema, rows: List[tuple]):
+        self.schema = schema
+        self.rows = rows
+        self.dictionaries: Dict[str, Optional[Dictionary]] = {}
+        cols = list(zip(*rows)) if rows else [
+            [] for _ in schema.columns
+        ]
+        self.columns = [list(c) for c in cols]
+        for col, cs in zip(self.columns, schema.columns):
+            if cs.type.is_dictionary_encoded:
+                distinct = sorted({v for v in col if v is not None})
+                self.dictionaries[cs.name] = Dictionary(distinct)
+            else:
+                self.dictionaries[cs.name] = None
+
+    @property
+    def row_count(self) -> int:
+        return len(self.rows)
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self):
+        self._tables: Dict[str, _StoredTable] = {}
+
+    # ------------------------------------------------------------- write
+    def create_table(
+        self,
+        name: str,
+        column_names: Sequence[str],
+        column_types: Sequence[T.SqlType],
+        rows: List[tuple],
+        *,
+        replace: bool = False,
+    ) -> int:
+        if name in self._tables and not replace:
+            raise ValueError(f"table already exists: {name}")
+        schema = TableSchema(
+            name,
+            tuple(
+                ColumnSchema(n, t)
+                for n, t in zip(column_names, column_types)
+            ),
+        )
+        self._tables[name] = _StoredTable(schema, list(rows))
+        return len(rows)
+
+    def insert(self, name: str, rows: List[tuple]) -> int:
+        t = self._tables.get(name)
+        if t is None:
+            raise KeyError(f"no table {name!r}")
+        self._tables[name] = _StoredTable(t.schema, t.rows + list(rows))
+        return len(rows)
+
+    def drop_table(self, name: str) -> None:
+        if name not in self._tables:
+            raise KeyError(f"no table {name!r}")
+        del self._tables[name]
+
+    # -------------------------------------------------------------- read
+    def tables(self) -> List[str]:
+        return sorted(self._tables)
+
+    def table_schema(self, table: str) -> TableSchema:
+        t = self._tables.get(table)
+        if t is None:
+            raise KeyError(f"no table {table!r}")
+        return t.schema
+
+    def row_count(self, table: str) -> int:
+        return self._tables[table].row_count
+
+    def page_for_split(
+        self, split: Split, columns: Optional[Sequence[str]] = None
+    ) -> Page:
+        t = self._tables[split.table]
+        names = (
+            tuple(columns) if columns is not None
+            else tuple(t.schema.column_names())
+        )
+        lo, hi = split.start_row, split.start_row + split.row_count
+        cols = []
+        types = []
+        dicts = []
+        for nm in names:
+            idx = t.schema.column_index(nm)
+            cols.append(t.columns[idx][lo:hi])
+            types.append(t.schema.columns[idx].type)
+            dicts.append(t.dictionaries.get(nm))
+        return Page.from_arrays(cols, types, dictionaries=dicts)

@@ -95,14 +95,14 @@ class DistExecutor(Executor):
         return self.dist(children[0]) if children else REPLICATED
 
     # ------------------------------------------------------------- pages
-    def pages(self, node: P.PhysicalNode) -> Iterator[Page]:
+    def _pages_impl(self, node: P.PhysicalNode) -> Iterator[Page]:
         if isinstance(node, P.Exchange):
             yield from self._exec_exchange(node)
             return
         if self.dist(node) == REPLICATED and all(
             self.dist(c) == REPLICATED for c in node.children()
         ):
-            yield from super().pages(node)
+            yield from super()._pages_impl(node)
             return
         if isinstance(node, P.TableScan):
             yield from self._scan_sharded(node)

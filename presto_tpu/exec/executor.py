@@ -92,6 +92,20 @@ def _canonical_join_cols(
     return lcols, lnulls, rcols, rnulls
 
 
+@dataclasses.dataclass
+class NodeStats:
+    """Per-plan-node execution stats (reference: OperatorStats)."""
+
+    label: str
+    wall_s: float = 0.0
+    pages: int = 0
+    row_counts: list = dataclasses.field(default_factory=list)
+
+    @property
+    def rows(self) -> int:
+        return sum(int(c) for c in self.row_counts)
+
+
 class Executor:
     """Reference: LocalQueryRunner's local execution half — interpret a
     physical plan against in-process connectors, no scheduler, no HTTP."""
@@ -116,6 +130,7 @@ class Executor:
         # (SURVEY §8.2.1's compiled-branch escape, moved to query scope).
         self._pending_overflow: List[jnp.ndarray] = []
         self._capacity_boost = 1
+        self._collect_stats = None  # id(node) -> NodeStats when ANALYZE
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
@@ -185,6 +200,32 @@ class Executor:
 
     # ------------------------------------------------------------- execute
     def pages(self, node: P.PhysicalNode) -> Iterator[Page]:
+        """Stream pages for a node, collecting per-node stats when an
+        EXPLAIN ANALYZE run enabled them (reference: OperatorContext
+        wall/row accounting feeding PlanPrinter)."""
+        impl = self._pages_impl(node)
+        if self._collect_stats is None:
+            yield from impl
+            return
+        import time as _time
+
+        st = self._collect_stats.setdefault(
+            id(node), NodeStats(type(node).__name__)
+        )
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                page = next(impl)
+            except StopIteration:
+                st.wall_s += _time.perf_counter() - t0
+                break
+            st.wall_s += _time.perf_counter() - t0
+            st.pages += 1
+            # device scalar; resolved after the run (deferred-sync rule)
+            st.row_counts.append(page.num_rows())
+            yield page
+
+    def _pages_impl(self, node: P.PhysicalNode) -> Iterator[Page]:
         if isinstance(node, P.TableScan):
             conn = self.catalogs[node.catalog]
             yield from conn.pages(
@@ -311,6 +352,8 @@ class Executor:
         self._capacity_boost = 1  # per-query; grows only across retries
         for _attempt in range(6):
             self._pending_overflow = []
+            if self._collect_stats is not None:
+                self._collect_stats.clear()  # drop failed-attempt stats
             out_pages = list(self.pages(node))
             if self._pending_overflow:
                 flag = self._pending_overflow[0]
@@ -326,6 +369,18 @@ class Executor:
         raise RuntimeError(
             "capacity overflow persisted after 6 boosted retries"
         )
+
+    def execute_with_stats(self, node: P.PhysicalNode):
+        """EXPLAIN ANALYZE support: run the query collecting per-node
+        wall time / page count / output rows. Row counts stay device-side
+        during the run and resolve here (one sync at the end)."""
+        self._collect_stats = {}
+        try:
+            names, rows = self.execute(node)
+            stats = dict(self._collect_stats)
+        finally:
+            self._collect_stats = None
+        return names, rows, stats
 
     # -------------------------------------------------------- aggregation
     def _agg_in_types(self, node: P.Aggregation) -> List[Optional[T.SqlType]]:

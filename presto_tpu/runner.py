@@ -23,6 +23,8 @@ from presto_tpu.sql.planner import Planner
 class QueryResult:
     column_names: List[str]
     rows: List[tuple]
+    update_type: Optional[str] = None
+    column_types: Optional[List[str]] = None
 
 
 class LocalRunner:
@@ -37,11 +39,15 @@ class LocalRunner:
         page_rows: int = 1 << 18,
         mesh=None,
         dist_options: Optional[Dict] = None,
+        session=None,
     ):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
         self.mesh = mesh
         self.dist_options = dist_options or {}
+        from presto_tpu.session import Session
+
+        self.session = session or Session(catalog=default_catalog)
         if mesh is None:
             self.executor = Executor(catalogs, page_rows=page_rows)
         else:
@@ -59,44 +65,134 @@ class LocalRunner:
                 from presto_tpu.dist.fragmenter import add_exchanges
 
                 node, _ = add_exchanges(
-                    node, self.catalogs, **self.dist_options
+                    node, self.catalogs, **self._session_dist_options()
                 )
             return self.executor.execute(node)[1]
 
         return Planner(
             self.catalogs,
-            self.default_catalog,
+            self._current_catalog(),
             scalar_executor=scalar_exec,
         )
+
+    def _current_catalog(self) -> str:
+        # session catalog (X-Presto-Catalog / CLI --catalog) wins over the
+        # engine default (reference: Session.getCatalog)
+        cat = getattr(self.session, "catalog", None)
+        return cat if cat in self.catalogs else self.default_catalog
+
+    def _session_dist_options(self) -> Dict:
+        opts = dict(self.dist_options)
+        jd = self.session.get("join_distribution_type")
+        if "broadcast_rows" not in opts:
+            if jd == "broadcast":
+                opts["broadcast_rows"] = 1 << 62
+            elif jd == "partitioned":
+                opts["broadcast_rows"] = 0
+            else:
+                opts["broadcast_rows"] = self.session.get(
+                    "broadcast_join_rows"
+                )
+        if "gather_capacity" not in opts:
+            opts["gather_capacity"] = self.session.get(
+                "agg_gather_capacity"
+            )
+        return opts
 
     def plan(self, sql: str) -> P.Output:
         stmt = parse(sql)
         if isinstance(stmt, N.Explain):
             stmt = stmt.query
-        out = self._planner().plan_statement(stmt)
+        if isinstance(stmt, N.CreateTableAs):
+            stmt = stmt.query
+        return self._plan_statement_query(stmt)
+
+    def _resolve_write_target(self, parts):
+        if len(parts) >= 2 and parts[0] in self.catalogs:
+            catalog, table = parts[0], parts[-1]
+        else:
+            catalog, table = self._current_catalog(), parts[-1]
+        conn = self.catalogs.get(catalog)
+        if conn is None or not hasattr(conn, "create_table"):
+            raise ValueError(
+                f"catalog {catalog!r} does not support writes"
+            )
+        return conn, table
+
+    def execute(self, sql: str) -> QueryResult:
+        stmt = parse(sql)
+        # session properties gate the accelerator path per query
+        # (reference: SystemSessionProperties; north-star's
+        # tpu_offload_enabled -> compiled XLA vs eager fallback)
+        self.executor.use_jit = bool(
+            self.session.get("tpu_offload_enabled")
+        )
+        if isinstance(stmt, N.SetSession):
+            self.session.set(stmt.name, stmt.value)
+            return QueryResult([], [], update_type="SET SESSION")
+        if isinstance(stmt, N.ShowSession):
+            return QueryResult(
+                ["name", "value", "default", "type", "description"],
+                self.session.rows(),
+            )
+        if isinstance(stmt, N.ShowTables):
+            cat = stmt.catalog or self._current_catalog()
+            conn = self.catalogs.get(cat)
+            if conn is None:
+                raise ValueError(f"unknown catalog: {cat}")
+            return QueryResult(
+                ["table"], [(t,) for t in conn.tables()]
+            )
+        if isinstance(stmt, N.DropTable):
+            conn, table = self._resolve_write_target(stmt.parts)
+            conn.drop_table(table)
+            return QueryResult([], [], update_type="DROP TABLE")
+        if isinstance(stmt, (N.CreateTableAs, N.InsertInto)):
+            inner_plan = self._plan_statement_query(stmt.query)
+            types = self.executor.output_types(inner_plan)
+            names, rows = self.executor.execute(inner_plan)
+            conn, table = self._resolve_write_target(stmt.parts)
+            if isinstance(stmt, N.CreateTableAs):
+                n = conn.create_table(table, names or [], types, rows)
+                return QueryResult(
+                    ["rows"], [(n,)], update_type="CREATE TABLE AS",
+                    column_types=["bigint"],
+                )
+            n = conn.insert(table, rows)
+            return QueryResult(["rows"], [(n,)], update_type="INSERT",
+                               column_types=["bigint"])
+        if isinstance(stmt, N.Explain):
+            out = self.plan(sql)
+            if stmt.analyze:
+                _names, _rows, stats = (
+                    self.executor.execute_with_stats(out)
+                )
+                text = explain_text(out, stats=stats)
+            else:
+                text = explain_text(out)
+            return QueryResult(["Query Plan"],
+                               [(line,) for line in text.splitlines()])
+        out = self.plan(sql)
+        names, rows = self.executor.execute(out)
+        types = [str(t) for t in self.executor.output_types(out)]
+        return QueryResult(list(names or []), rows, column_types=types)
+
+    def _plan_statement_query(self, query: N.Query) -> P.Output:
+        out = self._planner().plan_statement(query)
         out = prune_plan(out, self.catalogs)
         if self.mesh is not None:
             from presto_tpu.dist.fragmenter import add_exchanges
 
             out, _dist = add_exchanges(
-                out, self.catalogs, **self.dist_options
+                out, self.catalogs, **self._session_dist_options()
             )
         return out
 
-    def execute(self, sql: str) -> QueryResult:
-        stmt = parse(sql)
-        if isinstance(stmt, N.Explain):
-            out = self.plan(sql)
-            text = explain_text(out)
-            return QueryResult(["Query Plan"],
-                               [(line,) for line in text.splitlines()])
-        out = self.plan(sql)
-        names, rows = self.executor.execute(out)
-        return QueryResult(list(names or []), rows)
 
-
-def explain_text(node: P.PhysicalNode, indent: int = 0) -> str:
-    """Plan rendering (reference: sql/planner/planPrinter/PlanPrinter)."""
+def explain_text(node: P.PhysicalNode, indent: int = 0, stats=None) -> str:
+    """Plan rendering (reference: sql/planner/planPrinter/PlanPrinter);
+    with stats (EXPLAIN ANALYZE) each line carries per-node wall time,
+    page count, and output rows from the actual run."""
     pad = "    " * indent
     if isinstance(node, P.Output):
         line = f"{pad}Output[{', '.join(node.names)}]"
@@ -138,7 +234,12 @@ def explain_text(node: P.PhysicalNode, indent: int = 0) -> str:
         line = f"{pad}Values[{len(node.rows)} rows]"
     else:
         line = f"{pad}{type(node).__name__}"
+    if stats is not None:
+        st = stats.get(id(node))
+        if st is not None:
+            line += (f"   [wall {st.wall_s*1e3:.1f}ms, {st.pages} pages, "
+                     f"{st.rows:,} rows]")
     parts = [line]
     for child in node.children():
-        parts.append(explain_text(child, indent + 1))
+        parts.append(explain_text(child, indent + 1, stats=stats))
     return "\n".join(parts)

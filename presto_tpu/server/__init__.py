@@ -1,0 +1,3 @@
+from presto_tpu.server.http_server import PrestoTpuServer
+
+__all__ = ["PrestoTpuServer"]

@@ -1,0 +1,337 @@
+"""Coordinator HTTP service speaking the Presto client protocol.
+
+Reference: presto-main server/protocol/StatementResource.java (the
+/v1/statement paged REST protocol: POST the SQL, follow nextUri until it
+disappears, token-addressed result pages, DELETE to cancel) plus
+server/PrestoServer bootstrap. Sessions are client-carried exactly like
+the reference: X-Presto-Session request headers hold property overrides,
+SET SESSION responds with X-Presto-Set-Session and the client echoes it
+back on later requests — the server itself stays stateless per query.
+
+The engine is the in-process LocalRunner (single- or mesh-distributed);
+queries execute on a worker thread under a global lock (one query on the
+device at a time) while the protocol surface stays responsive.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+from presto_tpu import types as T
+from presto_tpu.session import SYSTEM_SESSION_PROPERTIES, Session
+
+_PAGE_ROWS = 4096  # rows per protocol fetch (client paging granularity)
+
+
+class _Query:
+    """Reference: server/protocol/Query.java — one statement's life."""
+
+    def __init__(self, qid: str, sql: str, session: Session):
+        self.id = qid
+        self.sql = sql
+        self.session = session
+        self.state = "QUEUED"
+        self.columns: Optional[List[Dict]] = None
+        self.rows: List[tuple] = []
+        self.error: Optional[Dict] = None
+        self.update_type: Optional[str] = None
+        self.set_session: Dict[str, str] = {}
+        self.created = time.time()
+        self.finished_at: Optional[float] = None
+        self.cancelled = False
+        self.done = threading.Event()
+
+    def info(self) -> Dict:
+        return {
+            "queryId": self.id,
+            "state": self.state,
+            "query": self.sql,
+            "elapsedTimeMillis": int(
+                ((self.finished_at or time.time()) - self.created) * 1000
+            ),
+            "error": self.error,
+            "rowCount": len(self.rows),
+        }
+
+
+class QueryManager:
+    """Reference: execution/SqlQueryManager.java — registry + lifecycle
+    (QUEUED -> RUNNING -> FINISHED/FAILED/CANCELED)."""
+
+    def __init__(self, runner_factory):
+        self._runner_factory = runner_factory
+        self._queries: Dict[str, _Query] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._exec_lock = threading.Lock()  # one query on the device
+
+    def submit(self, sql: str, session: Session) -> _Query:
+        with self._lock:
+            self._seq += 1
+            qid = time.strftime("%Y%m%d_%H%M%S") + \
+                f"_{self._seq:05d}_{uuid.uuid4().hex[:5]}"
+            q = _Query(qid, sql, session)
+            self._queries[qid] = q
+        threading.Thread(
+            target=self._run, args=(q,), daemon=True
+        ).start()
+        return q
+
+    def get(self, qid: str) -> Optional[_Query]:
+        return self._queries.get(qid)
+
+    def cancel(self, qid: str) -> bool:
+        q = self._queries.get(qid)
+        if q is None:
+            return False
+        q.cancelled = True
+        if not q.done.is_set():
+            q.state = "CANCELED"
+            q.finished_at = time.time()
+            q.done.set()
+        return True
+
+    def _run(self, q: _Query) -> None:
+        with self._exec_lock:
+            if q.cancelled:
+                return
+            q.state = "RUNNING"
+            try:
+                runner = self._runner_factory(q.session)
+                result = runner.execute(q.sql)
+                types = result.column_types or [
+                    "unknown" for _ in result.column_names
+                ]
+                q.columns = [
+                    {"name": n, "type": t}
+                    for n, t in zip(result.column_names, types)
+                ]
+                q.rows = [_json_row(r) for r in result.rows]
+                q.update_type = result.update_type
+                if result.update_type == "SET SESSION":
+                    # surface the new value so clients echo it back
+                    # (X-Presto-Set-Session round trip)
+                    from presto_tpu.sql.parser import parse
+                    from presto_tpu.sql import ast_nodes as N
+
+                    stmt = parse(q.sql)
+                    if isinstance(stmt, N.SetSession):
+                        q.set_session[stmt.name] = str(stmt.value)
+                if not q.cancelled:
+                    q.state = "FINISHED"
+            except Exception as e:  # noqa: BLE001
+                if not q.cancelled:
+                    q.error = {
+                        "message": str(e)[:2000],
+                        "errorName": type(e).__name__,
+                    }
+                    q.state = "FAILED"
+            finally:
+                if q.finished_at is None:
+                    q.finished_at = time.time()
+                q.done.set()
+
+
+def _json_row(row: tuple) -> list:
+    out = []
+    for v in row:
+        if v is None or isinstance(v, (bool, int, float, str)):
+            out.append(v)
+        else:
+            out.append(str(v))
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "presto-tpu/0.2"
+    protocol_version = "HTTP/1.1"
+
+    # silence default stderr logging
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    @property
+    def app(self) -> "PrestoTpuServer":
+        return self.server.app  # type: ignore[attr-defined]
+
+    def _send_json(self, obj, status=200, headers=None):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _session_from_headers(self) -> Session:
+        props = {}
+        hdr = self.headers.get("X-Presto-Session", "")
+        for part in hdr.split(","):
+            part = part.strip()
+            if part and "=" in part:
+                k, v = part.split("=", 1)
+                if k.strip() in SYSTEM_SESSION_PROPERTIES:
+                    props[k.strip()] = v.strip()
+        return Session(
+            user=self.headers.get("X-Presto-User", "presto"),
+            catalog=self.headers.get("X-Presto-Catalog"),
+            schema=self.headers.get("X-Presto-Schema", "default"),
+            properties=props,
+        )
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        if path != "/v1/statement":
+            self._send_json({"error": "not found"}, 404)
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        sql = self.rfile.read(length).decode()
+        q = self.app.manager.submit(sql, self._session_from_headers())
+        # brief wait so fast statements (SET SESSION, DDL) answer in one
+        # round trip with their headers (reference: ~100ms initial wait)
+        q.done.wait(timeout=0.5)
+        headers = {}
+        for k, v in q.set_session.items():
+            headers["X-Presto-Set-Session"] = f"{k}={v}"
+        self._send_json(self._results(q, 0), headers=headers)
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        parts = [p for p in path.split("/") if p]
+        if parts[:2] == ["v1", "statement"] and len(parts) == 4:
+            q = self.app.manager.get(parts[2])
+            if q is None:
+                self._send_json({"error": "no such query"}, 404)
+                return
+            token = int(parts[3])
+            # long-poll up to ~1s for progress (reference client behavior)
+            q.done.wait(timeout=1.0)
+            headers = {}
+            for k, v in q.set_session.items():
+                headers["X-Presto-Set-Session"] = f"{k}={v}"
+            self._send_json(self._results(q, token), headers=headers)
+            return
+        if parts[:2] == ["v1", "query"] and len(parts) == 3:
+            q = self.app.manager.get(parts[2])
+            if q is None:
+                self._send_json({"error": "no such query"}, 404)
+                return
+            self._send_json(q.info())
+            return
+        if parts == ["v1", "info"] or parts == ["v1", "status"]:
+            self._send_json({
+                "nodeId": "presto-tpu-coordinator",
+                "coordinator": True,
+                "uptime": time.time() - self.app.started,
+                "backend": self.app.backend_name,
+            })
+            return
+        self._send_json({"error": "not found"}, 404)
+
+    def do_DELETE(self):
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if parts[:2] == ["v1", "statement"] and len(parts) >= 3:
+            ok = self.app.manager.cancel(parts[2])
+            self._send_json({"cancelled": ok})
+            return
+        self._send_json({"error": "not found"}, 404)
+
+    # --------------------------------------------------------- protocol
+    def _results(self, q: _Query, token: int) -> Dict:
+        base = f"http://{self.headers.get('Host', 'localhost')}"
+        out: Dict = {
+            "id": q.id,
+            "infoUri": f"{base}/v1/query/{q.id}",
+            "stats": {
+                "state": q.state,
+                "queued": q.state == "QUEUED",
+                "elapsedTimeMillis": q.info()["elapsedTimeMillis"],
+            },
+        }
+        if q.error is not None:
+            out["error"] = q.error
+            return out
+        if not q.done.is_set():
+            # still running: client polls the same token
+            out["nextUri"] = f"{base}/v1/statement/{q.id}/{token}"
+            return out
+        if q.columns is not None:
+            out["columns"] = q.columns
+        if q.update_type:
+            out["updateType"] = q.update_type
+        lo = token * _PAGE_ROWS
+        hi = lo + _PAGE_ROWS
+        chunk = q.rows[lo:hi]
+        if chunk:
+            out["data"] = chunk
+        if hi < len(q.rows):
+            out["nextUri"] = f"{base}/v1/statement/{q.id}/{token + 1}"
+        return out
+
+
+class PrestoTpuServer:
+    """Reference: server/PrestoServer.java + StatementResource wiring."""
+
+    def __init__(
+        self,
+        catalogs,
+        default_catalog: str = "tpch",
+        port: int = 8080,
+        mesh=None,
+        page_rows: int = 1 << 18,
+    ):
+        from presto_tpu.runner import LocalRunner
+
+        self.catalogs = catalogs
+        self.port = port
+        self.started = time.time()
+        try:
+            import jax
+
+            self.backend_name = jax.default_backend()
+        except Exception:  # pragma: no cover
+            self.backend_name = "unknown"
+
+        # one engine, re-sessioned per query (plans/jit caches persist)
+        self._runner = LocalRunner(
+            catalogs, default_catalog=default_catalog,
+            page_rows=page_rows, mesh=mesh,
+        )
+
+        def runner_factory(session: Session):
+            self._runner.session = session
+            return self._runner
+
+        self.manager = QueryManager(runner_factory)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)
+        self._httpd.app = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    def serve_forever(self) -> None:  # pragma: no cover - CLI entry
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            self.stop()

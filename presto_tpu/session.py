@@ -1,0 +1,137 @@
+"""Typed session properties + per-query session state.
+
+Reference: presto-main SystemSessionProperties.java (typed, defaulted,
+per-query overrides settable via SET SESSION / X-Presto-Session headers)
+and Session.java (user, catalog, property map). The north-star's
+`tpu_offload_enabled` gate lives here: it decides whether query kernels
+run as compiled XLA programs on the accelerator path or fall back to
+op-by-op eager evaluation (the row-oracle fallback, BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyMetadata:
+    """Reference: spi/session/PropertyMetadata.java."""
+
+    name: str
+    description: str
+    type: type  # bool | int | str
+    default: Any
+    validate: Optional[Callable[[Any], bool]] = None
+
+
+def _parse_value(prop: PropertyMetadata, value: Any) -> Any:
+    if isinstance(value, str) and prop.type is bool:
+        low = value.strip().lower()
+        if low in ("true", "1", "on"):
+            return True
+        if low in ("false", "0", "off"):
+            return False
+        raise ValueError(f"{prop.name}: expected boolean, got {value!r}")
+    if isinstance(value, str) and prop.type is int:
+        return int(value)
+    if not isinstance(value, prop.type):
+        try:
+            return prop.type(value)
+        except Exception:
+            raise ValueError(
+                f"{prop.name}: expected {prop.type.__name__}, "
+                f"got {value!r}"
+            )
+    return value
+
+
+SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
+    p.name: p
+    for p in [
+        PropertyMetadata(
+            "tpu_offload_enabled",
+            "compile operator pipelines to XLA and run them on the "
+            "accelerator; false falls back to eager op-by-op execution",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "join_distribution_type",
+            "auto | broadcast | partitioned (reference: "
+            "join_distribution_type)",
+            str, "auto",
+            validate=lambda v: v in ("auto", "broadcast", "partitioned"),
+        ),
+        PropertyMetadata(
+            "broadcast_join_rows",
+            "build sides up to this many estimated rows replicate to "
+            "every mesh device instead of repartitioning",
+            int, 1 << 21,
+        ),
+        PropertyMetadata(
+            "agg_gather_capacity",
+            "grouped aggregations up to this capacity gather partial "
+            "states to one stream; larger ones repartition by group key",
+            int, 1 << 17,
+        ),
+        PropertyMetadata(
+            "page_rows",
+            "target rows per page (split granularity)",
+            int, 1 << 18,
+        ),
+        PropertyMetadata(
+            "hash_partition_count",
+            "devices used for repartitioned stages (0 = whole mesh)",
+            int, 0,
+        ),
+    ]
+}
+
+
+class Session:
+    """Reference: Session.java — user + catalog + property overrides."""
+
+    def __init__(
+        self,
+        user: str = "presto",
+        catalog: Optional[str] = None,
+        schema: str = "default",
+        properties: Optional[Dict[str, Any]] = None,
+    ):
+        self.user = user
+        self.catalog = catalog
+        self.schema = schema
+        self._values: Dict[str, Any] = {}
+        for k, v in (properties or {}).items():
+            self.set(k, v)
+
+    def set(self, name: str, value: Any) -> None:
+        prop = SYSTEM_SESSION_PROPERTIES.get(name)
+        if prop is None:
+            raise KeyError(f"unknown session property: {name}")
+        parsed = _parse_value(prop, value)
+        if prop.validate and not prop.validate(parsed):
+            raise ValueError(
+                f"invalid value for {name}: {value!r}"
+            )
+        self._values[name] = parsed
+
+    def get(self, name: str) -> Any:
+        prop = SYSTEM_SESSION_PROPERTIES.get(name)
+        if prop is None:
+            raise KeyError(f"unknown session property: {name}")
+        return self._values.get(name, prop.default)
+
+    def rows(self) -> List[tuple]:
+        """SHOW SESSION rows: (name, value, default, type, description)."""
+        out = []
+        for name, p in sorted(SYSTEM_SESSION_PROPERTIES.items()):
+            out.append((
+                name,
+                str(self._values.get(name, p.default)).lower()
+                if p.type is bool else str(self._values.get(name, p.default)),
+                str(p.default).lower() if p.type is bool else str(p.default),
+                p.type.__name__,
+                p.description,
+            ))
+        return out

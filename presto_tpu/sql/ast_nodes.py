@@ -226,3 +226,44 @@ class Query(Node):
 class Explain(Node):
     query: Query
     analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTableAs(Node):
+    """CREATE TABLE [catalog.]name AS query (reference:
+    sql/tree/CreateTableAsSelect.java)."""
+
+    parts: Tuple[str, ...]
+    query: Query
+    replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InsertInto(Node):
+    """INSERT INTO [catalog.]name query (reference: sql/tree/Insert)."""
+
+    parts: Tuple[str, ...]
+    query: Query
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Node):
+    parts: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class SetSession(Node):
+    """SET SESSION name = value (reference: sql/tree/SetSession)."""
+
+    name: str
+    value: object
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSession(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Node):
+    catalog: Optional[str] = None

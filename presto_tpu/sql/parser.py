@@ -36,7 +36,8 @@ KEYWORDS = {
     "inner", "left", "right", "full", "outer", "cross", "on", "using",
     "with", "asc", "desc", "nulls", "first", "last", "date", "time",
     "timestamp", "interval", "true", "false", "explain", "analyze",
-    "substring", "for",
+    "substring", "for", "create", "table", "drop", "insert", "into",
+    "set", "session", "show", "tables",
 }
 
 
@@ -159,9 +160,61 @@ class Parser:
             q = self.parse_query()
             self._finish()
             return N.Explain(q, analyze)
+        if self.accept_keyword("create"):
+            self.expect_keyword("table")
+            parts = self._qualified_name()
+            self.expect_keyword("as")
+            q = self.parse_query()
+            self._finish()
+            return N.CreateTableAs(parts, q)
+        if self.accept_keyword("insert"):
+            self.expect_keyword("into")
+            parts = self._qualified_name()
+            q = self.parse_query()
+            self._finish()
+            return N.InsertInto(parts, q)
+        if self.accept_keyword("drop"):
+            self.expect_keyword("table")
+            parts = self._qualified_name()
+            self._finish()
+            return N.DropTable(parts)
+        if self.accept_keyword("set"):
+            self.expect_keyword("session")
+            name = self.expect_name()
+            self.expect_op("=")
+            t = self.next()
+            if t.kind in ("string", "number"):
+                value = t.value
+            elif t.kind == "keyword" and t.value in ("true", "false"):
+                value = t.value
+            elif t.kind == "name":
+                value = t.value
+            else:
+                raise SqlSyntaxError(
+                    f"expected session value, found {t.value!r}"
+                )
+            self._finish()
+            return N.SetSession(name, value)
+        if self.accept_keyword("show"):
+            if self.accept_keyword("session"):
+                self._finish()
+                return N.ShowSession()
+            if self.accept_keyword("tables"):
+                catalog = None
+                if self.accept_keyword("from") or self.accept_keyword("in"):
+                    catalog = self.expect_name()
+                self._finish()
+                return N.ShowTables(catalog)
+            raise SqlSyntaxError("expected SESSION or TABLES after SHOW")
         q = self.parse_query()
         self._finish()
         return q
+
+    def _qualified_name(self) -> Tuple[str, ...]:
+        parts = [self.expect_name()]
+        while self.accept_op("."):
+            parts.append(self.expect_name())
+        return tuple(parts)
 
     def _finish(self):
         self.accept_op(";")

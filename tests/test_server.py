@@ -1,0 +1,150 @@
+"""Coordinator protocol tests: /v1/statement paging, session headers,
+DDL via the wire, error surfaces, cancel, CLI client round trip.
+
+Reference test analog: TestingPrestoServer + client protocol tests
+(presto-main server/testing, presto-client)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from presto_tpu.client import StatementClient
+from presto_tpu.connectors.blackhole import BlackholeConnector
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.server import PrestoTpuServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = PrestoTpuServer(
+        {
+            "tpch": TpchConnector(scale=0.001),
+            "memory": MemoryConnector(),
+            "blackhole": BlackholeConnector(),
+        },
+        port=0,  # ephemeral
+        page_rows=1 << 12,
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return StatementClient(server=f"http://127.0.0.1:{server.port}")
+
+
+def test_simple_query(client):
+    res = client.execute("select 1 + 1 as two")
+    assert res.error is None
+    assert [c["name"] for c in res.columns] == ["two"]
+    assert res.rows == [[2]]
+    assert res.state == "FINISHED"
+
+
+def test_scan_aggregate(client):
+    res = client.execute(
+        "select count(*), sum(n_nationkey) from nation"
+    )
+    assert res.error is None
+    assert res.rows == [[25, 300]]
+    assert res.columns[0]["type"] == "bigint"
+
+
+def test_paged_results(client):
+    # more rows than one protocol page (4096) forces nextUri paging
+    res = client.execute(
+        "select l_orderkey from lineitem"
+    )
+    assert res.error is None
+    assert len(res.rows) > 4096
+
+
+def test_ddl_roundtrip(client):
+    res = client.execute(
+        "create table memory.n2 as select n_name, n_regionkey from nation"
+    )
+    assert res.update_type == "CREATE TABLE AS"
+    res = client.execute(
+        "select count(*) from memory.n2"
+    )
+    assert res.rows == [[25]]
+    res = client.execute("show tables from memory")
+    assert ["n2"] in res.rows
+    client.execute("drop table memory.n2")
+    res = client.execute("show tables from memory")
+    assert ["n2"] not in res.rows
+
+
+def test_set_session_roundtrip(client):
+    res = client.execute("set session tpu_offload_enabled = false")
+    assert res.update_type == "SET SESSION"
+    # client carries the property forward (X-Presto-Set-Session echo)
+    assert client.session_properties["tpu_offload_enabled"] == "false"
+    res = client.execute("select count(*) from region")
+    assert res.rows == [[5]]
+    client.execute("set session tpu_offload_enabled = true")
+    assert client.session_properties["tpu_offload_enabled"] == "true"
+
+
+def test_show_session(client):
+    res = client.execute("show session")
+    names = [r[0] for r in res.rows]
+    assert "tpu_offload_enabled" in names
+    assert "join_distribution_type" in names
+
+
+def test_session_catalog(server):
+    """X-Presto-Catalog steers unqualified names and write targets."""
+    c = StatementClient(
+        server=f"http://127.0.0.1:{server.port}", catalog="memory"
+    )
+    res = c.execute("create table t3 as select 42 as x")
+    assert res.error is None, res.error
+    assert res.update_type == "CREATE TABLE AS"
+    res = c.execute("select x from t3")
+    assert res.rows == [[42]]
+    res = c.execute("show tables")
+    assert ["t3"] in res.rows
+    c.execute("drop table t3")
+
+
+def test_error_surface(client):
+    res = client.execute("select bogus_column from nation")
+    assert res.error is not None
+    assert res.state == "FAILED"
+    assert "bogus_column" in res.error["message"]
+
+
+def test_syntax_error(client):
+    res = client.execute("selec 1")
+    assert res.error is not None
+
+
+def test_info_endpoints(server, client):
+    base = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(f"{base}/v1/info") as r:
+        info = json.loads(r.read())
+    assert info["coordinator"] is True
+    res = client.execute("select 1 as x")
+    with urllib.request.urlopen(
+        f"{base}/v1/query/{res.query_id}"
+    ) as r:
+        qinfo = json.loads(r.read())
+    assert qinfo["state"] == "FINISHED"
+    assert qinfo["rowCount"] == 1
+
+
+def test_cli_execute(server, capsys):
+    from presto_tpu.cli import main
+
+    rc = main([
+        "--server", f"http://127.0.0.1:{server.port}",
+        "--execute", "select r_name from region order by r_name limit 2",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "r_name" in out and "(2 rows)" in out
