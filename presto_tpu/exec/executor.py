@@ -184,6 +184,17 @@ class Executor:
             return out
         if isinstance(node, P.Exchange):
             return self.output_types(node.source)
+        if isinstance(node, P.Window):
+            from presto_tpu.ops import window as W
+
+            src = self.output_types(node.source)
+            out = list(src)
+            for fn in node.functions:
+                in_t = (
+                    None if fn.arg_channel is None else src[fn.arg_channel]
+                )
+                out.append(W.result_type(fn, in_t))
+            return out
         if isinstance(node, P.HashJoin):
             left = self.output_types(node.left)
             if node.join_type in ("semi", "anti"):
@@ -297,6 +308,24 @@ class Executor:
         if isinstance(node, P.Union):
             for src in node.sources:
                 yield from self.pages(src)
+            return
+        if isinstance(node, P.Window):
+            from presto_tpu.ops import window as W
+
+            pages = list(self.pages(node.source))
+            if not pages:
+                return
+            merged = concat_all(pages) if len(pages) > 1 else pages[0]
+            src_types = self.output_types(node.source)
+            out_types = tuple(self.output_types(node)[len(src_types):])
+            fn = self._jit(
+                ("window", node, merged.capacity),
+                functools.partial(
+                    W.window_page, node.partition_channels,
+                    node.order_keys, node.functions, out_types,
+                ),
+            )
+            yield fn(merged)
             return
         if isinstance(node, (P.Sort, P.TopN)):
             pages = list(self.pages(node.source))

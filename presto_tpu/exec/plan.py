@@ -188,6 +188,23 @@ class Limit(PhysicalNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Window(PhysicalNode):
+    """Window functions sharing one OVER clause (reference:
+    sql/planner/plan/WindowNode + operator/WindowOperator). Output
+    channels: all source channels, then one per function. Executed as
+    segmented scans over a partition-sorted permutation
+    (presto_tpu/ops/window.py)."""
+
+    source: PhysicalNode
+    partition_channels: Tuple[int, ...]
+    order_keys: Tuple[SortKey, ...]
+    functions: Tuple  # of ops.window.WindowFunc
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
 class Exchange(PhysicalNode):
     """Distribution boundary (reference: sql/planner/plan/ExchangeNode
     inserted by AddExchanges; executed by PartitionedOutputOperator /

@@ -61,6 +61,8 @@ def _channel_count(node: P.PhysicalNode, counts: Dict) -> int:
         n = _channel_count(node.source, counts) + 1
     elif isinstance(node, P.Union):
         n = _channel_count(node.sources[0], counts)
+    elif isinstance(node, P.Window):
+        n = _channel_count(node.source, counts) + len(node.functions)
     elif isinstance(node, (P.Filter, P.Sort, P.TopN, P.Limit, P.Output)):
         n = _channel_count(node.children()[0], counts)
     else:
@@ -100,6 +102,15 @@ def output_types(node: P.PhysicalNode, catalogs: Dict) -> List[T.SqlType]:
         return output_types(node.source, catalogs) + [T.BIGINT]
     if isinstance(node, P.Union):
         return output_types(node.sources[0], catalogs)
+    if isinstance(node, P.Window):
+        from presto_tpu.ops import window as W
+
+        src = output_types(node.source, catalogs)
+        out = list(src)
+        for fn in node.functions:
+            in_t = None if fn.arg_channel is None else src[fn.arg_channel]
+            out.append(W.result_type(fn, in_t))
+        return out
     if isinstance(node, (P.Filter, P.Sort, P.TopN, P.Limit, P.Output)):
         return output_types(node.children()[0], catalogs)
     raise TypeError(f"unknown node: {node!r}")
@@ -266,4 +277,46 @@ def _prune(node: P.PhysicalNode, needed: Set[int], ctx: Dict):
     if isinstance(node, P.Limit):
         src, m = _prune(node.source, needed, ctx)
         return P.Limit(src, node.count, node.offset), m
+    if isinstance(node, P.Window):
+        import dataclasses as _dc
+
+        from presto_tpu.ops.sort import SortKey
+
+        nsrc = _channel_count(node.source, counts)
+        keep_fns = sorted(
+            i for i in range(len(node.functions))
+            if (nsrc + i) in needed
+        )
+        child_needed = {c for c in needed if c < nsrc}
+        child_needed.update(node.partition_channels)
+        child_needed.update(k.channel for k in node.order_keys)
+        for i in keep_fns:
+            ch = node.functions[i].arg_channel
+            if ch is not None:
+                child_needed.add(ch)
+        src, m = _prune(node.source, child_needed, ctx)
+        fns = tuple(
+            _dc.replace(
+                node.functions[i],
+                arg_channel=(
+                    None if node.functions[i].arg_channel is None
+                    else m[node.functions[i].arg_channel]
+                ),
+            )
+            for i in keep_fns
+        )
+        new_node = P.Window(
+            src,
+            tuple(m[c] for c in node.partition_channels),
+            tuple(
+                SortKey(m[k.channel], k.ascending, k.nulls_first)
+                for k in node.order_keys
+            ),
+            fns,
+        )
+        new_nsrc = len(m)
+        mapping = dict(m)
+        for out_pos, i in enumerate(keep_fns):
+            mapping[nsrc + i] = new_nsrc + out_pos
+        return new_node, mapping
     raise TypeError(f"unknown node: {node!r}")

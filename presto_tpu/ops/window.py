@@ -1,0 +1,335 @@
+"""Window function kernels: segmented scans over a partition-sorted
+permutation.
+
+Reference: presto-main operator/WindowOperator.java + operator/window/*
+(PagesIndex sorted by partition+order keys, per-partition frame walks).
+TPU-native redesign (SURVEY §3.2 "WindowOperator -> segmented scans"):
+
+  1. one stable sort by (validity, partition equality words, order words)
+     — bit-packed into few u64 operands (ops/keys.pack_sort_keys);
+  2. partition/peer boundaries by adjacent-word comparison;
+  3. rank/row_number from boundary positions, running aggregates from
+     prefix sums re-based at segment starts, min/max via a segmented
+     associative scan, lag/lead/first/last as bounded gathers;
+  4. scatter results back to input row order.
+
+Default SQL frames are honored: with ORDER BY the frame is RANGE
+UNBOUNDED PRECEDING..CURRENT ROW (peer-extended running values), without
+ORDER BY it is the whole partition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.ops import keys as K
+from presto_tpu.ops.sort import SortKey
+from presto_tpu.page import Block, Page
+
+# functions producing BIGINT positions
+RANKING = ("row_number", "rank", "dense_rank")
+# running/frame aggregates
+AGGREGATES = ("sum", "count", "count_star", "avg", "min", "max")
+# offset/navigation functions
+NAVIGATION = ("lag", "lead", "first_value", "last_value")
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFunc:
+    function: str
+    arg_channel: Optional[int] = None
+    offset: int = 1  # lag/lead
+    default_null: bool = True  # lag/lead default is NULL
+
+
+def result_type(fn: WindowFunc, in_type: Optional[T.SqlType]) -> T.SqlType:
+    from presto_tpu.exec import agg_states as S
+
+    if fn.function in RANKING or fn.function in ("count", "count_star"):
+        return T.BIGINT
+    if fn.function in ("sum", "avg", "min", "max"):
+        rt = S.result_type(fn.function, in_type)
+        if isinstance(rt, T.DecimalType) and not rt.is_short:
+            # window frames are per-partition prefixes; sums stay within
+            # i64 at any realistic partition size, so keep the fast short
+            # representation (the grouped-agg path uses 128-bit limbs)
+            return T.DecimalType(18, rt.scale)
+        return rt
+    return in_type  # lag/lead/first_value/last_value
+
+
+def _scan_max(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.associative_scan(jnp.maximum, x)
+
+
+def _suffix_min(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.associative_scan(jnp.minimum, x, reverse=True)
+
+
+def _segmented_scan(op, values: jnp.ndarray, boundary: jnp.ndarray):
+    """Inclusive per-segment scan: resets at each boundary (classic
+    segmented-scan combine, associative)."""
+
+    def combine(a, b):
+        ab, av = a
+        bb, bv = b
+        return ab | bb, jnp.where(bb, bv, op(av, bv))
+
+    _, out = jax.lax.associative_scan(combine, (boundary, values))
+    return out
+
+
+def window_page(
+    partition_channels: Tuple[int, ...],
+    order_keys: Tuple[SortKey, ...],
+    functions: Tuple[WindowFunc, ...],
+    out_types: Tuple[T.SqlType, ...],
+    page: Page,
+) -> Page:
+    """Compute all window functions sharing one OVER clause; returns the
+    input page with one appended Block per function."""
+    n = page.capacity
+    iota = jnp.arange(n, dtype=jnp.int64)
+
+    # ---- 1. sort permutation: valid, partition words, order words ----
+    parts: List = [(jnp.where(page.valid, jnp.uint64(0), jnp.uint64(1)), 1)]
+    part_cols, part_nulls = K.block_key_columns(
+        [page.block(c) for c in partition_channels]
+    )
+    for col, null in zip(part_cols, part_nulls):
+        if null is not None:
+            parts.append((null.astype(jnp.uint64), 1))
+            col = jnp.where(null, jnp.uint64(0), col)
+        parts.append((col, 64))
+    for sk in order_keys:
+        parts.extend(
+            K.order_encoding_parts(
+                page.block(sk.channel),
+                ascending=sk.ascending,
+                nulls_first=sk.resolved_nulls_first(),
+            )
+        )
+    words = K.pack_sort_keys(parts)
+    sorted_out = jax.lax.sort(
+        tuple(words) + (iota,), num_keys=len(words), is_stable=True
+    )
+    perm = sorted_out[-1]
+    inv = jnp.zeros((n,), dtype=jnp.int64).at[perm].set(iota)
+    svalid = page.valid[perm]
+
+    # ---- 2. boundaries in sorted order ----
+    def changed(ws):
+        ch = jnp.zeros((n,), dtype=jnp.bool_).at[0].set(True)
+        for w in ws:
+            sw = w[perm]
+            ch = ch | jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), sw[1:] != sw[:-1]]
+            )
+        return ch
+
+    # partition words: null flags + null-masked equality encodings
+    pw: List[jnp.ndarray] = []
+    for col, null in zip(part_cols, part_nulls):
+        if null is not None:
+            pw.append(null.astype(jnp.uint64))
+            pw.append(jnp.where(null, jnp.uint64(0), col))
+        else:
+            pw.append(col)
+    part_boundary = changed(pw) | ~svalid  # invalid rows: own segments
+    order_words: List[jnp.ndarray] = []
+    for sk in order_keys:
+        for w, _bits in K.order_encoding_parts(
+            page.block(sk.channel), ascending=sk.ascending,
+            nulls_first=sk.resolved_nulls_first(),
+        ):
+            order_words.append(w)
+    peer_boundary = part_boundary | (
+        changed(order_words) if order_words else part_boundary
+    )
+    has_order = bool(order_keys)
+
+    seg_start = _scan_max(jnp.where(part_boundary, iota, jnp.int64(0)))
+    peer_start = _scan_max(jnp.where(peer_boundary, iota, jnp.int64(0)))
+    # segment/peer end: next boundary - 1 (suffix-min of boundary starts)
+    nxt_part = _suffix_min(
+        jnp.where(
+            jnp.concatenate([part_boundary[1:],
+                             jnp.ones((1,), jnp.bool_)]),
+            iota, jnp.int64(n - 1),
+        )
+    )
+    nxt_peer = _suffix_min(
+        jnp.where(
+            jnp.concatenate([peer_boundary[1:],
+                             jnp.ones((1,), jnp.bool_)]),
+            iota, jnp.int64(n - 1),
+        )
+    )
+    seg_end = nxt_part
+    peer_end = nxt_peer
+
+    cum_peer = jnp.cumsum(peer_boundary.astype(jnp.int64))
+
+    out_blocks: List[Block] = []
+    for fn, out_t in zip(functions, out_types):
+        blk = (
+            page.block(fn.arg_channel)
+            if fn.arg_channel is not None else None
+        )
+        res_data, res_nulls, dic = _one_function(
+            fn, blk, page, perm, inv, svalid, iota, n,
+            seg_start, seg_end, peer_end, peer_start, cum_peer,
+            has_order, out_t,
+        )
+        out_blocks.append(
+            Block(data=res_data, type=out_t, nulls=res_nulls,
+                  dictionary=dic)
+        )
+    return Page(blocks=page.blocks + tuple(out_blocks), valid=page.valid)
+
+
+def _one_function(fn, blk, page, perm, inv, svalid, iota, n,
+                  seg_start, seg_end, peer_end, peer_start, cum_peer,
+                  has_order, out_t):
+    """Result arrays in INPUT row order for one window function."""
+    if fn.function == "row_number":
+        res = iota - seg_start + 1
+        return res[inv], None, None
+    if fn.function == "rank":
+        res = peer_start - seg_start + 1
+        return res[inv], None, None
+    if fn.function == "dense_rank":
+        res = cum_peer - cum_peer[jnp.clip(seg_start, 0, n - 1)] + 1
+        return res[inv], None, None
+
+    if fn.function in ("lag", "lead", "first_value", "last_value"):
+        data = blk.data
+        is_tuple = isinstance(data, tuple)
+        snulls = (
+            blk.nulls[perm] if blk.nulls is not None else None
+        )
+        if fn.function == "lag":
+            src = iota - fn.offset
+            ok = src >= seg_start
+        elif fn.function == "lead":
+            src = iota + fn.offset
+            ok = src <= seg_end
+        elif fn.function == "first_value":
+            src = seg_start
+            ok = jnp.ones((n,), jnp.bool_)
+        else:  # last_value over default frame = end of current peer group
+            src = peer_end if has_order else seg_end
+            ok = jnp.ones((n,), jnp.bool_)
+        srcc = jnp.clip(src, 0, n - 1)
+
+        def gather(d):
+            sd = d[perm]
+            return sd[srcc]
+
+        out = (
+            tuple(gather(d) for d in data) if is_tuple else gather(data)
+        )
+        nulls = jnp.where(ok, False, True)
+        if snulls is not None:
+            nulls = nulls | snulls[srcc]
+        # back to input order
+        if is_tuple:
+            out = tuple(d[inv] for d in out)
+        else:
+            out = out[inv]
+        return out, nulls[inv], blk.dictionary
+
+    # ---- running / whole-partition aggregates ----
+    contributing = svalid
+    if blk is not None and blk.nulls is not None:
+        contributing = contributing & ~blk.nulls[perm]
+    # frame end in sorted coordinates: RANGE peers with ORDER BY, whole
+    # partition without
+    f_end = peer_end if has_order else seg_end
+
+    ones = contributing.astype(jnp.int64)
+    cnt_cum = jnp.cumsum(ones)
+    cnt_base = jnp.where(
+        seg_start > 0, cnt_cum[jnp.clip(seg_start - 1, 0, n - 1)], 0
+    )
+    count_to = lambda idx: cnt_cum[jnp.clip(idx, 0, n - 1)] - cnt_base  # noqa: E731
+    frame_count = count_to(f_end)
+
+    if fn.function in ("count", "count_star"):
+        if fn.arg_channel is None:
+            valid_ones = svalid.astype(jnp.int64)
+            vc = jnp.cumsum(valid_ones)
+            vb = jnp.where(
+                seg_start > 0, vc[jnp.clip(seg_start - 1, 0, n - 1)], 0
+            )
+            res = vc[jnp.clip(f_end, 0, n - 1)] - vb
+        else:
+            res = frame_count
+        return res[inv], None, None
+
+    data = blk.data
+    if isinstance(data, tuple):
+        raise NotImplementedError(
+            "window aggregates over long decimals not supported yet"
+        )
+    dic = blk.dictionary
+    inv_rank = None
+    if dic is not None and fn.function in ("min", "max") and len(dic):
+        rank = jnp.asarray(dic.sort_rank().astype(np.int64))
+        inv_rank = jnp.asarray(np.argsort(dic.sort_rank()).astype(np.int64))
+        data = rank[jnp.clip(data, 0, len(dic) - 1)]
+
+    sdata = data[perm]
+    empty = frame_count == 0
+
+    if fn.function in ("sum", "avg"):
+        acc = jnp.where(contributing, sdata, 0).astype(
+            jnp.float64 if jnp.issubdtype(sdata.dtype, jnp.floating)
+            else jnp.int64
+        )
+        cum = jnp.cumsum(acc)
+        base = jnp.where(
+            seg_start > 0, cum[jnp.clip(seg_start - 1, 0, n - 1)], 0
+        )
+        total = cum[jnp.clip(f_end, 0, n - 1)] - base
+        if fn.function == "sum":
+            res = total.astype(np.dtype(out_t.numpy_dtype))
+            return res[inv], empty[inv], None
+        # avg
+        cnt = jnp.maximum(frame_count, 1)
+        if T.is_floating(out_t):
+            res = total.astype(jnp.float64) / cnt.astype(jnp.float64)
+        else:
+            # integer/decimal: round-half-up like the aggregation path
+            tot = total.astype(jnp.int64)
+            sign = jnp.where(tot < 0, -1, 1)
+            res = sign * ((jnp.abs(tot) + cnt // 2) // cnt)
+        res = res.astype(np.dtype(out_t.numpy_dtype))
+        return res[inv], empty[inv], None
+
+    if fn.function in ("min", "max"):
+        op = jnp.minimum if fn.function == "min" else jnp.maximum
+        if jnp.issubdtype(sdata.dtype, jnp.floating):
+            ident = jnp.inf if fn.function == "min" else -jnp.inf
+        else:
+            info = jnp.iinfo(sdata.dtype)
+            ident = info.max if fn.function == "min" else info.min
+        filled = jnp.where(contributing, sdata,
+                           jnp.asarray(ident, dtype=sdata.dtype))
+        # inclusive running value, then extend to the frame end
+        part_boundary = seg_start == iota
+        run = _segmented_scan(op, filled, part_boundary)
+        res = run[jnp.clip(f_end, 0, n - 1)]
+        if inv_rank is not None:
+            res = inv_rank[jnp.clip(res, 0, inv_rank.shape[0] - 1)].astype(
+                data.dtype
+            )
+        res = jnp.where(empty, jnp.zeros((), dtype=res.dtype), res)
+        return res[inv], empty[inv], dic
+    raise ValueError(f"unknown window function {fn.function!r}")

@@ -211,6 +211,10 @@ def explain_text(node: P.PhysicalNode, indent: int = 0, stats=None) -> str:
         step = "" if node.step == "single" else f" step={node.step}"
         line = (f"{pad}Aggregate[keys={list(node.group_channels)} "
                 f"aggs=[{fns}]{step}]")
+    elif isinstance(node, P.Window):
+        fns = ", ".join(f.function for f in node.functions)
+        line = (f"{pad}Window[partition={list(node.partition_channels)} "
+                f"fns=[{fns}]]")
     elif isinstance(node, P.Exchange):
         keys = f" keys={list(node.keys)}" if node.keys else ""
         line = f"{pad}Exchange[{node.kind}{keys}]"

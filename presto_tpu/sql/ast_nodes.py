@@ -128,6 +128,17 @@ class FunctionCall(Node):
     args: Tuple[Node, ...]
     distinct: bool = False
     is_star: bool = False  # count(*)
+    window: Optional["WindowSpec"] = None  # fn(...) OVER (...)
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec(Node):
+    """OVER clause (reference: sql/tree/Window). Only the default frames
+    are representable: RANGE UNBOUNDED PRECEDING..CURRENT ROW with an
+    ORDER BY, the whole partition without."""
+
+    partition_by: Tuple[Node, ...] = ()
+    order_by: Tuple["OrderItem", ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -37,7 +37,7 @@ KEYWORDS = {
     "with", "asc", "desc", "nulls", "first", "last", "date", "time",
     "timestamp", "interval", "true", "false", "explain", "analyze",
     "substring", "for", "create", "table", "drop", "insert", "into",
-    "set", "session", "show", "tables",
+    "set", "session", "show", "tables", "over", "partition",
 }
 
 
@@ -616,7 +616,9 @@ class Parser:
             self.next()
             if self.accept_op("*"):
                 self.expect_op(")")
-                return N.FunctionCall(name, (), is_star=True)
+                return self._maybe_over(
+                    N.FunctionCall(name, (), is_star=True)
+                )
             distinct = False
             args: List[N.Node] = []
             if not (self.peek().kind == "op" and self.peek().value == ")"):
@@ -628,12 +630,36 @@ class Parser:
                 while self.accept_op(","):
                     args.append(self.parse_expr())
             self.expect_op(")")
-            return N.FunctionCall(name, tuple(args), distinct=distinct)
+            return self._maybe_over(
+                N.FunctionCall(name, tuple(args), distinct=distinct)
+            )
         parts = [name]
         while self.peek().kind == "op" and self.peek().value == ".":
             self.next()
             parts.append(self.expect_name())
         return N.Identifier(tuple(parts))
+
+    def _maybe_over(self, call: N.FunctionCall) -> N.Node:
+        """fn(...) [OVER ( [PARTITION BY e,...] [ORDER BY ...] )]"""
+        if not self.accept_keyword("over"):
+            return call
+        self.expect_op("(")
+        partition: List[N.Node] = []
+        order: Tuple[N.OrderItem, ...] = ()
+        if self.accept_keyword("partition"):
+            self.expect_keyword("by")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        if self.at_keyword("order"):
+            order = self.parse_order_by()
+        self.expect_op(")")
+        import dataclasses as _dc
+
+        return _dc.replace(
+            call,
+            window=N.WindowSpec(tuple(partition), tuple(order)),
+        )
 
 
 def parse(sql: str) -> N.Node:

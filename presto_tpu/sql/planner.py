@@ -243,6 +243,32 @@ def outer_to_input(e: ir.RowExpression, offset_outer: int, offset_inner: int):
     return e
 
 
+def find_windows(e: N.Node) -> List[N.FunctionCall]:
+    """Windowed function calls (fn(...) OVER ...) in an expression, not
+    crossing subquery boundaries."""
+    out: List[N.FunctionCall] = []
+
+    def walk(x):
+        if isinstance(x, N.Query):
+            return
+        if isinstance(x, N.FunctionCall) and x.window is not None:
+            out.append(x)
+            return
+        for f in (
+            dataclasses.fields(x) if dataclasses.is_dataclass(x) else []
+        ):
+            v = getattr(x, f.name)
+            if isinstance(v, N.Node):
+                walk(v)
+            elif isinstance(v, tuple):
+                for item in v:
+                    if isinstance(item, N.Node):
+                        walk(item)
+
+    walk(e)
+    return out
+
+
 def find_aggregates(e: N.Node) -> List[N.FunctionCall]:
     """Aggregate calls in an AST expression (not nested in another agg and
     not inside a subquery — those belong to the inner SELECT)."""
@@ -251,6 +277,16 @@ def find_aggregates(e: N.Node) -> List[N.FunctionCall]:
     def walk(x):
         if isinstance(x, N.Query):
             return  # subquery boundary: its aggregates are its own
+        if isinstance(x, N.FunctionCall) and x.window is not None:
+            # windowed call: not an aggregate; its args/spec may still
+            # contain real aggregates (rank() over (order by sum(x)))
+            for a in x.args:
+                walk(a)
+            for p in x.window.partition_by:
+                walk(p)
+            for o in x.window.order_by:
+                walk(o.expr)
+            return
         if isinstance(x, N.FunctionCall) and (
             x.name in AGG_FUNCTIONS or x.is_star
         ):
@@ -990,6 +1026,25 @@ class Planner:
         for o in spec.order_by:
             aggs.extend(find_aggregates(o.expr))
 
+        windows: List[N.FunctionCall] = []
+        for item in spec.select:
+            if not isinstance(item.expr, N.Star):
+                windows.extend(find_windows(item.expr))
+        for o in spec.order_by:
+            windows.extend(find_windows(o.expr))
+        if windows and (spec.group_by or aggs):
+            raise PlanningError(
+                "window functions over aggregations in the same query "
+                "block are not supported yet; aggregate in a subquery"
+            )
+
+        if windows:
+            plan, scope, win_subst = self._plan_windows(
+                plan, scope, windows
+            )
+        else:
+            win_subst = {}
+
         if spec.group_by or aggs:
             tr = ExprTranslator(self, scope)
             group_irs = []
@@ -1008,7 +1063,7 @@ class Planner:
         else:
             names = []
             exprs = []
-            tr = ExprTranslator(self, scope)
+            tr = ExprTranslator(self, scope, agg_subst=win_subst)
             out_fields = []
             for item in spec.select:
                 if isinstance(item.expr, N.Star):
@@ -1042,6 +1097,110 @@ class Planner:
         # ORDER BY / LIMIT are query-level (plan_query) — the parser never
         # attaches them to a QuerySpec
         return plan
+
+    def _plan_windows(self, plan, scope, windows):
+        """Plan windowed calls over the FROM/WHERE result: pre-project the
+        partition/order/argument expressions, add one Window node per
+        distinct OVER clause, and return a substitution map call->channel
+        for the final projection (reference: QueryPlanner.window +
+        WindowNode; execution is ops/window.py's segmented scans)."""
+        from presto_tpu.ops import window as W
+        from presto_tpu.ops.sort import SortKey
+
+        tr = ExprTranslator(self, scope)
+        pre_exprs: List[ir.RowExpression] = [
+            ir.InputRef(i, f.type) for i, f in enumerate(plan.fields)
+        ]
+
+        def chan_for(ast_expr) -> int:
+            e = tr.translate(ast_expr)
+            if isinstance(e, ir.InputRef):
+                return e.channel
+            for i, existing in enumerate(pre_exprs):
+                if existing == e:
+                    return i
+            pre_exprs.append(e)
+            return len(pre_exprs) - 1
+
+        # group calls by their OVER clause
+        by_spec: Dict[object, List[N.FunctionCall]] = {}
+        for call in windows:
+            by_spec.setdefault(call.window, [])
+            if call not in by_spec[call.window]:
+                by_spec[call.window].append(call)
+
+        specs = []
+        for wspec, calls in by_spec.items():
+            part_chs = tuple(chan_for(p) for p in wspec.partition_by)
+            order_keys = tuple(
+                SortKey(chan_for(o.expr), o.ascending, o.nulls_first)
+                for o in wspec.order_by
+            )
+            fns = []
+            for call in calls:
+                fname = call.name
+                arg_ch = None
+                offset = 1
+                if fname in ("lag", "lead"):
+                    if len(call.args) > 2:
+                        raise PlanningError(
+                            "lag/lead default argument not supported"
+                        )
+                    arg_ch = chan_for(call.args[0])
+                    if len(call.args) == 2:
+                        off = call.args[1]
+                        if not (isinstance(off, N.Literal)
+                                and off.kind == "long"):
+                            raise PlanningError(
+                                "lag/lead offset must be an integer "
+                                "literal"
+                            )
+                        offset = int(off.value)
+                elif fname in ("row_number", "rank", "dense_rank"):
+                    pass
+                elif fname in ("count",) and (call.is_star or
+                                              not call.args):
+                    fname = "count_star"
+                elif fname in ("sum", "avg", "min", "max", "count",
+                               "first_value", "last_value"):
+                    arg_ch = chan_for(call.args[0])
+                else:
+                    raise PlanningError(
+                        f"unsupported window function: {fname}"
+                    )
+                fns.append(W.WindowFunc(fname, arg_ch, offset))
+            specs.append((part_chs, order_keys, tuple(fns), calls))
+
+        node = plan.node
+        if len(pre_exprs) > len(plan.fields) or any(
+            not isinstance(e, ir.InputRef) or e.channel != i
+            for i, e in enumerate(pre_exprs)
+        ):
+            node = P.Project(node, tuple(pre_exprs))
+        pre_fields = list(plan.fields) + [
+            Field(None, e.type) for e in pre_exprs[len(plan.fields):]
+        ]
+
+        win_subst: Dict[object, ir.RowExpression] = {}
+        ch = len(pre_exprs)
+        all_fields = list(pre_fields)
+        for part_chs, order_keys, fns, calls in specs:
+            node = P.Window(node, part_chs, order_keys, fns)
+            for fn, call in zip(fns, calls):
+                in_t = (
+                    None if fn.arg_channel is None
+                    else pre_fields[fn.arg_channel].type
+                    if fn.arg_channel < len(pre_fields)
+                    else pre_exprs[fn.arg_channel].type
+                )
+                out_t = W.result_type(fn, in_t)
+                win_subst[call] = ir.InputRef(ch, out_t)
+                all_fields.append(Field(None, out_t))
+                ch += 1
+
+        new_plan = RelationPlan(node, all_fields)
+        new_scope = Scope(pre_fields, scope.parent)
+        return new_plan, new_scope, win_subst
 
     def _plan_aggregation_block(
         self,
