@@ -52,6 +52,12 @@ from tests.tpch_queries import QUERIES  # noqa: E402
 RUNGS = [
     ("q1_sf1", 1, 1.0),
     ("q6_sf1", 6, 1.0),
+    # q3 runs at SF0.1: an axon/XLA:TPU runtime bug silently faults
+    # kernels touching >= ~4M-row buffers (see SKILL.md "Known perf
+    # issues"); Q3's final aggregation at SF1 crosses that line. The
+    # sorted fallback is wired but the fault persists in composition —
+    # tracked for next round.
+    ("q3_sf01", 3, 0.1),
     ("q1_sf10", 1, 10.0),
     ("q6_sf10", 6, 10.0),
 ]
@@ -123,18 +129,32 @@ def main() -> int:
               f"({slots_in/steady/1e6:.0f}M slots/s), compile {compile_s:.0f}s",
               file=sys.stderr)
 
+    # timing data is safe on disk before any device->host read: the
+    # first D2H can fault on a flaky tunnel, and the timed numbers
+    # (block_until_ready only) must survive that
+    _write_details(details)
+
     # ---- phase 2: overflow + decode + small-SF correctness ----
     for name, (pages, flags) in rung_state.items():
-        overflow = any(bool(f) for f in flags)
-        rows = []
-        for p in pages:
-            rows.extend(p.to_pylist())
-        details["rungs"][name]["overflow"] = overflow
-        details["rungs"][name]["result_rows"] = len(rows)
-        details["rungs"][name]["valid"] = not overflow
+        try:
+            overflow = any(bool(f) for f in flags)
+            rows = []
+            for p in pages:
+                rows.extend(p.to_pylist())
+            details["rungs"][name]["overflow"] = overflow
+            details["rungs"][name]["result_rows"] = len(rows)
+            details["rungs"][name]["valid"] = not overflow
+        except Exception as e:  # pragma: no cover - device faults
+            details["rungs"][name]["decode_error"] = repr(e)[:200]
+    _write_details(details)
 
     details["oracle_sf"] = ORACLE_SF
-    details["oracle_ok"] = _small_sf_check(sorted({q for _, q, _ in RUNGS}))
+    try:
+        details["oracle_ok"] = _small_sf_check(
+            sorted({q for _, q, _ in RUNGS})
+        )
+    except Exception as e:  # pragma: no cover
+        details["oracle_ok"] = {"error": repr(e)[:200]}
 
     # ---- phase 3: sqlite wall-clock baseline (cached) ----
     cache_path = os.path.join(REPO, "bench_baseline.json")
@@ -144,9 +164,14 @@ def main() -> int:
             cache = json.load(f)
     for name, qid, sf in RUNGS:
         key = f"q{qid}_sf{sf}"
-        if key not in cache:
+        if cache.get(key) is None:
+            # None never sticks: a transient sqlite failure must retry on
+            # the next bench run instead of poisoning the cache file
             if sf <= MAX_SQLITE_SF:
-                cache[key] = _sqlite_time(runner_for(sf), qid)
+                try:
+                    cache[key] = _sqlite_time(runner_for(sf), qid)
+                except Exception:  # pragma: no cover
+                    cache[key] = None
             else:
                 cache[key] = None
         details["rungs"][name]["sqlite_s"] = cache[key]
@@ -157,8 +182,7 @@ def main() -> int:
     with open(cache_path, "w") as f:
         json.dump(cache, f, indent=1, sort_keys=True)
 
-    with open(os.path.join(REPO, "BENCH_DETAILS.json"), "w") as f:
-        json.dump(details, f, indent=1, sort_keys=True)
+    _write_details(details)
 
     head = details["rungs"][HEADLINE]
     print(json.dumps({
@@ -168,6 +192,11 @@ def main() -> int:
         "vs_baseline": head.get("speedup_vs_sqlite") or 0.0,
     }))
     return 0
+
+
+def _write_details(details) -> None:
+    with open(os.path.join(REPO, "BENCH_DETAILS.json"), "w") as f:
+        json.dump(details, f, indent=1, sort_keys=True)
 
 
 def _small_sf_check(qids):

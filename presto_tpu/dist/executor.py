@@ -75,10 +75,14 @@ class DistExecutor(Executor):
 
     # ---------------------------------------------------------- dist tags
     def dist(self, node: P.PhysicalNode) -> str:
+        # keyed by id() with the node itself retained: a bare id key goes
+        # stale when a garbage-collected plan node's address is reused by
+        # a later plan (observed as flaky distributed-vs-single mismatches)
         key = id(node)
-        if key not in self._dist_cache:
-            self._dist_cache[key] = self._compute_dist(node)
-        return self._dist_cache[key]
+        hit = self._dist_cache.get(key)
+        if hit is None or hit[0] is not node:
+            self._dist_cache[key] = (node, self._compute_dist(node))
+        return self._dist_cache[key][1]
 
     def _compute_dist(self, node) -> str:
         if isinstance(node, P.TableScan):
@@ -511,9 +515,14 @@ class DistExecutor(Executor):
             )
 
             def probe_body(pg, build, oc=oc):
+                from presto_tpu.exec.executor import _build_join_index
+
+                index = _build_join_index(
+                    node.left_keys, node.right_keys, pg, build
+                )
                 out, matched, ovf = _probe_join_page(
                     node.left_keys, node.right_keys, node.join_type,
-                    pg, build, oc,
+                    pg, build, index, oc,
                 )
                 ovf = jax.lax.psum(ovf.astype(jnp.int32), "d") > 0
                 if dr == REPLICATED:

@@ -34,7 +34,7 @@ from presto_tpu.ops import agg as A
 from presto_tpu.ops import join as J
 from presto_tpu.ops import keys as K
 from presto_tpu.ops.compact import compact_page, concat_all, gather_rows
-from presto_tpu.ops.sort import limit_page, sort_page
+from presto_tpu.ops.sort import sort_page
 from presto_tpu.page import Block, Dictionary, Page
 
 
@@ -92,6 +92,24 @@ def _canonical_join_cols(
     return lcols, lnulls, rcols, rnulls
 
 
+class MemoryBudgetExceeded(RuntimeError):
+    """Reference: ExceededMemoryLimitException — the query fails rather
+    than thrash (SURVEY §6.4: kill-don't-spill is the v1 policy; spill to
+    host RAM is the documented follow-up)."""
+
+
+def page_bytes(page: Page) -> int:
+    """Static page footprint from shapes/dtypes (no device reads)."""
+    total = page.valid.shape[0]  # bool valid
+    for blk in page.blocks:
+        datas = blk.data if isinstance(blk.data, tuple) else (blk.data,)
+        for d in datas:
+            total += d.size * d.dtype.itemsize
+        if blk.nulls is not None:
+            total += blk.nulls.shape[0]
+    return total
+
+
 @dataclasses.dataclass
 class NodeStats:
     """Per-plan-node execution stats (reference: OperatorStats)."""
@@ -131,6 +149,15 @@ class Executor:
         self._pending_overflow: List[jnp.ndarray] = []
         self._capacity_boost = 1
         self._collect_stats = None  # id(node) -> NodeStats when ANALYZE
+        # memory accounting (reference: OperatorContext->QueryContext
+        # hierarchy + query.max-memory enforcement): page footprints are
+        # computed from STATIC shapes (host arithmetic, never a device
+        # read), tracked as a high-water mark per query, and enforced
+        # against max_memory_bytes by failing the query rather than
+        # thrashing — the reference's kill-don't-spill default.
+        self.max_memory_bytes: Optional[int] = None
+        self.peak_memory_bytes = 0
+        self._live_bytes = 0
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
@@ -216,7 +243,9 @@ class Executor:
         wall/row accounting feeding PlanPrinter)."""
         impl = self._pages_impl(node)
         if self._collect_stats is None:
-            yield from impl
+            for page in impl:
+                self._account_page(page)
+                yield page
             return
         import time as _time
 
@@ -234,6 +263,7 @@ class Executor:
             st.pages += 1
             # device scalar; resolved after the run (deferred-sync rule)
             st.row_counts.append(page.num_rows())
+            self._account_page(page)
             yield page
 
     def _pages_impl(self, node: P.PhysicalNode) -> Iterator[Page]:
@@ -327,32 +357,55 @@ class Executor:
             )
             yield fn(merged)
             return
-        if isinstance(node, (P.Sort, P.TopN)):
+        if isinstance(node, P.TopN):
+            # streaming top-N (reference: TopNOperator's bounded heap):
+            # per page, keep the local top-N, then merge with the running
+            # candidate set — never materializes the whole input
+            running = None
+            for page in self.pages(node.source):
+                local_fn = self._jit(
+                    ("topn_local", node.keys, node.limit, page.capacity),
+                    functools.partial(sort_page, sort_keys=node.keys,
+                                      limit=node.limit),
+                )
+                local = local_fn(page)
+                if running is None:
+                    running = local
+                    continue
+                merge_fn = self._jit(
+                    ("topn_merge", node.keys, node.limit,
+                     running.capacity, local.capacity),
+                    functools.partial(_topn_merge, node.keys, node.limit),
+                )
+                running = merge_fn(running, local)
+            if running is not None:
+                yield running
+            return
+        if isinstance(node, P.Sort):
             pages = list(self.pages(node.source))
             if not pages:
                 return
             merged = concat_all(pages)
-            limit = node.limit if isinstance(node, P.TopN) else None
-            key = ("sort", node.keys, limit, merged.capacity)
+            self._account_page(merged)
+            key = ("sort", node.keys, None, merged.capacity)
             fn = self._jit(
-                key, functools.partial(sort_page, sort_keys=node.keys,
-                                       limit=limit)
+                key, functools.partial(sort_page, sort_keys=node.keys)
             )
             yield fn(merged)
             return
         if isinstance(node, P.Limit):
-            remaining = node.count
-            offset = node.offset
+            # running row count stays a DEVICE scalar (deferred-sync rule:
+            # a host read here would poison every later launch); no early
+            # exit, but every page is a cheap mask update
+            consumed = jnp.int64(0)
+            fn = self._jit(
+                ("limit", node.count, node.offset),
+                functools.partial(_limit_with_count, node.count,
+                                  node.offset),
+            )
             for page in self.pages(node.source):
-                if remaining <= 0:
-                    return
-                out = limit_page(page, remaining, offset)
-                n = int(out.num_rows())
-                skipped_here = min(int(page.num_rows()), offset)
-                offset = max(offset - skipped_here, 0)
-                remaining -= n
-                if n:
-                    yield out
+                out, consumed = fn(page, consumed)
+                yield out
             return
         if isinstance(node, P.Output):
             yield from self.pages(node.source)
@@ -379,6 +432,7 @@ class Executor:
             list(node.names) if isinstance(node, P.Output) else None
         )
         self._capacity_boost = 1  # per-query; grows only across retries
+        self.peak_memory_bytes = 0
         for _attempt in range(6):
             self._pending_overflow = []
             if self._collect_stats is not None:
@@ -398,6 +452,23 @@ class Executor:
         raise RuntimeError(
             "capacity overflow persisted after 6 boosted retries"
         )
+
+    def _account_page(self, page: Page) -> None:
+        size = page_bytes(page)
+        # streaming model: at most a handful of pages per operator are
+        # live at once; the high-water proxy is the largest single page
+        # times the plan's pipeline depth, tracked coarsely as a running
+        # peak of per-page footprints
+        self.peak_memory_bytes = max(self.peak_memory_bytes, size)
+        if (
+            self.max_memory_bytes is not None
+            and size > self.max_memory_bytes
+        ):
+            raise MemoryBudgetExceeded(
+                f"page footprint {size} bytes exceeds query memory limit "
+                f"{self.max_memory_bytes} (reference: "
+                f"ExceededMemoryLimitException)"
+            )
 
     def execute_with_stats(self, node: P.PhysicalNode):
         """EXPLAIN ANALYZE support: run the query collecting per-node
@@ -605,6 +676,7 @@ class Executor:
         # host mid-query would trigger the axon post-D2H degradation (see
         # __init__); capacity is a static upper bound on rows
         build = compact_page(build_all, _next_pow2(build_all.capacity))
+        self._account_page(build)  # the query's largest materialization
 
         if node.join_type in ("semi", "anti"):
             fn = self._jit(
@@ -622,18 +694,37 @@ class Executor:
                 _probe_join_page, node.left_keys, node.right_keys,
                 node.join_type
             ),
-            static_argnums=(2,),
+            static_argnums=(3,),
         )
         build_matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
+        # canonical key encodings depend on the probe page's dictionaries
+        # (merged-universe remap), which can differ across pages when the
+        # probe side unions differently-coded streams — index per
+        # dictionary signature, built once each (HashBuilderOperator
+        # analog; one signature in the common case)
+        indexes: Dict = {}
         for page in self.pages(node.left):
-            # sized for both many-to-one (<= probe rows) and small-probe
-            # fan-out (<= build rows) shapes; multiplying joins beyond
-            # this hit the deferred overflow flag and re-run boosted
-            oc = _next_pow2(
-                max(page.capacity, build.capacity) * 2
-                * self._capacity_boost
+            sig = tuple(
+                page.block(c).dictionary for c in node.left_keys
             )
-            out, matched, overflow = probe_fn(page, build, oc)
+            if sig not in indexes:
+                indexes[sig] = self._jit(
+                    ("join_build", node, build.capacity, sig),
+                    functools.partial(
+                        _build_join_index, node.left_keys,
+                        node.right_keys,
+                    ),
+                )(page, build)
+            index = indexes[sig]
+            # probe-relative sizing (many-to-one joins dominate), with a
+            # bounded build term for small-probe fan-out joins; anything
+            # beyond overflows the deferred flag and re-runs on the
+            # boosted ladder (up to 4^5 x)
+            oc = page.capacity * 2
+            if page.capacity <= 1 << 16:
+                oc = max(oc, min(build.capacity, 1 << 22))
+            oc = _next_pow2(max(oc, 8192) * self._capacity_boost)
+            out, matched, overflow = probe_fn(page, build, index, oc)
             self._pending_overflow.append(overflow)
             build_matched = build_matched | matched
             yield out
@@ -710,6 +801,14 @@ def _group_ids(group_channels, page: Page, cap: int, max_iters: int = 64):
                 gid, page.valid, space, out_capacity=_next_pow2(space)
             )
     key_cols, key_nulls = K.block_key_columns(key_blocks)
+    if page.valid.shape[0] >= (1 << 22):
+        # the vectorized-probing while_loop kernel faults the XLA:TPU
+        # runtime at >= ~4M rows (observed on v5e regardless of table
+        # size or chunking); large inputs take the packed-argsort path,
+        # which is slower but correct at any scale
+        return A.compute_groups_sorted(
+            key_cols, key_nulls, page.valid, cap
+        )
     return A.compute_groups_hashed(
         key_cols, key_nulls, page.valid, cap, max_iters=max_iters
     )
@@ -916,13 +1015,22 @@ def _null_blocks(types: List[T.SqlType], cap: int) -> List[Block]:
     ]
 
 
-def _probe_join_page(left_keys, right_keys, join_type, page: Page,
-                     build: Page, out_cap: int):
+def _build_join_index(left_keys, right_keys, page: Page, build: Page):
+    """One-shot build index (kernel). The probe page supplies the static
+    dictionary context for canonical key encodings."""
     lblocks = [page.block(c) for c in left_keys]
     rblocks = [build.block(c) for c in right_keys]
-    lcols, lnulls, rcols, rnulls = _canonical_join_cols(lblocks, rblocks)
+    _lcols, _lnulls, rcols, rnulls = _canonical_join_cols(lblocks, rblocks)
+    return J.build_join_index(rcols, rnulls, build.valid)
+
+
+def _probe_join_page(left_keys, right_keys, join_type, page: Page,
+                     build: Page, index, out_cap: int):
+    lblocks = [page.block(c) for c in left_keys]
+    rblocks = [build.block(c) for c in right_keys]
+    lcols, lnulls, _rcols, _rnulls = _canonical_join_cols(lblocks, rblocks)
     m = J.hash_join_match(
-        rcols, rnulls, build.valid, lcols, lnulls, page.valid, out_cap
+        None, None, None, lcols, lnulls, page.valid, out_cap, index=index
     )
     out_valid = m.match
     left_out = gather_rows(page, m.probe_idx, out_valid)
@@ -974,6 +1082,22 @@ def _semi_join_page(left_keys, right_keys, page: Page, build: Page) -> Page:
         data=has_match, type=T.BOOLEAN, nulls=null_result
     )
     return Page(blocks=page.blocks + (match_block,), valid=page.valid)
+
+
+def _topn_merge(sort_keys, limit, running: Page, local: Page) -> Page:
+    both = concat_all([running, local])
+    return sort_page(both, sort_keys=sort_keys, limit=limit)
+
+
+def _limit_with_count(count, offset, page: Page, consumed):
+    """LIMIT across pages with the running total carried as a traced
+    device scalar (reference: LimitOperator's remaining counter)."""
+    rank = jnp.cumsum(page.valid.astype(jnp.int64)) - 1 + consumed
+    keep = page.valid & (rank >= offset) & (rank < offset + count)
+    return (
+        page.with_valid(keep),
+        consumed + jnp.sum(page.valid.astype(jnp.int64)),
+    )
 
 
 def _decode_result_page(page: Page) -> List[tuple]:

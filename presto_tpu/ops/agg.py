@@ -100,12 +100,28 @@ def compute_groups_sorted(
     Reference analog: GroupByHash.getGroupIds(Page) — returns a group id per
     input position; aggregation happens against those ids.
     """
-    sort_keys = _null_aware_sort_keys(key_cols, key_nulls, valid)
-    perm = _lexsort(sort_keys)
+    from presto_tpu.ops import keys as K
+    from presto_tpu.ops.sort import packed_argsort
+
+    # bit-pack (validity, per-key null flag + word) and sort via LSD
+    # chained single-word argsorts: one multi-operand lexsort compiles
+    # for minutes on XLA:TPU, k two-operand argsorts compile in seconds
+    parts = [(jnp.where(valid, jnp.uint64(0), jnp.uint64(1)), 1)]
+    cmp_words: List[jnp.ndarray] = []
+    for col, null in zip(key_cols, key_nulls):
+        if null is not None:
+            nw = null.astype(jnp.uint64)
+            parts.append((nw, 1))
+            cmp_words.append(nw)
+            col = jnp.where(null, jnp.uint64(0), col)
+        parts.append((col, 64))
+        cmp_words.append(col)
+    words = K.pack_sort_keys(parts)
+    perm = packed_argsort(words, valid.shape[0])
     svalid = valid[perm]
 
     diff = jnp.zeros(valid.shape, dtype=jnp.bool_)
-    for k in sort_keys[1:]:
+    for k in cmp_words:
         sk = k[perm]
         d = jnp.concatenate(
             [jnp.ones((1,), dtype=jnp.bool_), sk[1:] != sk[:-1]]

@@ -22,9 +22,9 @@ outer-row emission (LEFT/RIGHT/FULL) and semi joins assemble from the match
 statistics returned here (reference: LookupJoinOperators factories,
 HashSemiJoinOperator).
 
-A Pallas radix-partitioned variant (north-star requirement) plugs in behind
-the same interface for HBM-resident build sides; see
-presto_tpu/ops/pallas_kernels.
+A Pallas open-addressing probe kernel for the dominant unique-key joins
+lives in presto_tpu/ops/pallas_join.py (north-star requirement); executor
+wiring behind a session flag is the documented next step.
 """
 
 from __future__ import annotations
@@ -76,37 +76,58 @@ def _fold_nulls(
     return out_cols, any_null
 
 
-def hash_join_match(
+def build_join_index(
     build_cols: Sequence[jnp.ndarray],
     build_nulls: Sequence[Optional[jnp.ndarray]],
     build_valid: jnp.ndarray,
+    *,
+    null_equals_null: bool = False,
+):
+    """Build-side index, computed ONCE per join and reused by every probe
+    page (reference: HashBuilderOperator's LookupSource shared across
+    LookupJoinOperators). The index is a pytree: (folded key cols,
+    validity, hash-sorted array, sort permutation).
+
+    Build rows sort by hash with invalid rows poisoned to the max hash —
+    ONE sort operand, not two: every extra u64 sort operand roughly
+    doubles XLA:TPU's sort compile time, and the equality verification in
+    the probe rejects any real-hash collisions with the poison value."""
+    bcols, b_null_out = _fold_nulls(build_cols, build_nulls, null_equals_null)
+    bvalid = build_valid & ~b_null_out
+    bhash = H.hash_columns(bcols, [None] * len(bcols))
+    poisoned = jnp.where(bvalid, bhash, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    perm = jnp.argsort(poisoned)
+    return (tuple(bcols), bvalid, poisoned[perm], perm)
+
+
+def hash_join_match(
+    build_cols: Optional[Sequence[jnp.ndarray]],
+    build_nulls: Optional[Sequence[Optional[jnp.ndarray]]],
+    build_valid: Optional[jnp.ndarray],
     probe_cols: Sequence[jnp.ndarray],
     probe_nulls: Sequence[Optional[jnp.ndarray]],
     probe_valid: jnp.ndarray,
     out_capacity: int,
     *,
     null_equals_null: bool = False,
+    index=None,
 ) -> JoinMatches:
-    """Match probe rows against build rows on equality-encoded uint64 keys."""
-    build_cap = build_valid.shape[0]
+    """Match probe rows against build rows on equality-encoded uint64 keys.
+
+    Pass a prebuilt ``index`` (build_join_index) to skip re-sorting the
+    build side per probe page."""
+    if index is None:
+        index = build_join_index(
+            build_cols, build_nulls, build_valid,
+            null_equals_null=null_equals_null,
+        )
+    bcols, bvalid, sorted_hash, perm = index
+    build_cap = bvalid.shape[0]
     probe_cap = probe_valid.shape[0]
 
-    bcols, b_null_out = _fold_nulls(build_cols, build_nulls, null_equals_null)
     pcols, p_null_out = _fold_nulls(probe_cols, probe_nulls, null_equals_null)
-    bvalid = build_valid & ~b_null_out
     pvalid = probe_valid & ~p_null_out
-
-    none_nulls = [None] * len(bcols)
-    bhash = H.hash_columns(bcols, none_nulls)
-    phash = H.hash_columns(pcols, none_nulls)
-
-    # sort build rows by hash with invalid rows poisoned to the max hash —
-    # ONE sort operand, not two: every extra u64 sort operand roughly doubles
-    # XLA:TPU's sort compile time, and validity checks below already reject
-    # any real-hash collisions with the poison value
-    poisoned = jnp.where(bvalid, bhash, jnp.uint64(0xFFFFFFFFFFFFFFFF))
-    perm = jnp.argsort(poisoned)
-    sorted_hash = poisoned[perm]
+    phash = H.hash_columns(pcols, [None] * len(pcols))
 
     lo = jnp.searchsorted(sorted_hash, phash, side="left")
     hi = jnp.searchsorted(sorted_hash, phash, side="right")

@@ -1,0 +1,205 @@
+"""Pallas TPU hash-join probe kernel (north-star: "hash join as a Pallas
+radix-partitioned join", SURVEY §8.2.2).
+
+Scope (v1, deliberately narrow): single 64-bit key, UNIQUE build keys —
+the primary-key joins that dominate TPC-H (lineitem->orders on orderkey,
+orders->customer on custkey). The general path (duplicate keys, multi-key,
+nulls) stays on the sort+searchsorted join in ops/join.py; this kernel is
+the VMEM-resident fast path for the common shape.
+
+Design:
+  build (XLA, once per join): vectorized open-addressing insert — every
+    build row claims slots by scatter-min of its row id, lockstep linear
+    probing (same deterministic scheme as ops/agg.compute_groups_hashed).
+    Table = (key lo32, key hi32, row id) arrays, capacity 2x rows, pow2.
+  probe (Pallas): grid over probe-row blocks; each block loads its keys
+    into VMEM, computes the initial slot from the mixed key, then runs K
+    bounded probe rounds entirely on the VPU — gather table entries,
+    compare lo/hi words, advance unresolved lanes to the next slot.
+    Returns the matching build row id or -1 per probe row.
+
+u64 handling: TPU lanes are 32-bit, so keys travel as (lo32, hi32) int32
+pairs and the table is int32 throughout — no 64-bit emulation inside the
+kernel. The table must fit VMEM (~16 MB: up to ~1M build rows); larger
+builds stay on the sort join (the caller checks).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EMPTY = jnp.int32(-1)
+
+
+def _split64(keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    u = keys.astype(jnp.uint64)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32).astype(jnp.int32)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32)
+    return lo, hi
+
+
+def _mix32(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """32-bit finalizer (murmur3 fmix32 over both words) for slot
+    addressing; equality is verified on the full (lo, hi) pair."""
+    h = lo.astype(jnp.uint32) ^ (hi.astype(jnp.uint32) *
+                                 jnp.uint32(0x85EBCA6B))
+    h ^= h >> jnp.uint32(16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h ^= h >> jnp.uint32(13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h ^= h >> jnp.uint32(16)
+    return h
+
+
+def build_table(
+    keys: jnp.ndarray, valid: jnp.ndarray, table_cap: int,
+    max_iters: int = 64,
+):
+    """Open-addressing insert of (unique) build keys, fully vectorized.
+
+    Returns (tab_lo, tab_hi, tab_row) int32[table_cap] plus an overflow
+    flag (unresolved rows after max_iters — callers fall back to the
+    sort join)."""
+    n = keys.shape[0]
+    lo, hi = _split64(keys)
+    h = _mix32(lo, hi)
+    mask = jnp.uint32(table_cap - 1)
+    slot0 = (h & mask).astype(jnp.int32)
+    row_idx = jnp.arange(n, dtype=jnp.int32)
+    BIG = jnp.int32(n)
+
+    def settled(owner, slot):
+        win = owner[slot]
+        return valid & (win == row_idx)
+
+    def cond(state):
+        owner, slot, it = state
+        return jnp.any(valid & ~settled(owner, slot)) & (it < max_iters)
+
+    def body(state):
+        owner, slot, it = state
+        done = settled(owner, slot)
+        claim = jnp.where(done | ~valid, BIG, row_idx)
+        owner = owner.at[slot].min(claim)
+        done2 = settled(owner, slot)
+        nxt = (slot.astype(jnp.uint32) + jnp.uint32(1)) & mask
+        slot = jnp.where(done2 | ~valid, slot, nxt.astype(jnp.int32))
+        return owner, slot, it + 1
+
+    owner0 = jnp.full((table_cap,), BIG, dtype=jnp.int32)
+    owner, slot, _ = jax.lax.while_loop(
+        cond, body, (owner0, slot0, jnp.int32(0))
+    )
+    ok = settled(owner, slot)
+    overflow = jnp.any(valid & ~ok)
+    tab_row = jnp.full((table_cap,), _EMPTY, dtype=jnp.int32)
+    tab_row = tab_row.at[jnp.where(ok, slot, table_cap)].set(
+        row_idx, mode="drop"
+    )
+    tab_lo = jnp.zeros((table_cap,), dtype=jnp.int32).at[
+        jnp.where(ok, slot, table_cap)
+    ].set(lo, mode="drop")
+    tab_hi = jnp.zeros((table_cap,), dtype=jnp.int32).at[
+        jnp.where(ok, slot, table_cap)
+    ].set(hi, mode="drop")
+    return (tab_lo, tab_hi, tab_row), overflow
+
+
+def _probe_kernel(plo_ref, phi_ref, tlo_ref, thi_ref, trow_ref, out_ref,
+                  *, table_cap: int, max_probes: int):
+    plo = plo_ref[:]
+    phi = phi_ref[:]
+    h = _mix32(plo, phi)
+    mask = jnp.uint32(table_cap - 1)
+    slot = (h & mask).astype(jnp.int32)
+    result = jnp.full(plo.shape, -1, dtype=jnp.int32)
+    live = jnp.ones(plo.shape, dtype=jnp.bool_)
+
+    def body(_i, carry):
+        slot, result, live = carry
+        tlo = tlo_ref[slot]
+        thi = thi_ref[slot]
+        trow = trow_ref[slot]
+        hit = live & (trow != -1) & (tlo == plo) & (thi == phi)
+        result = jnp.where(hit, trow, result)
+        # stop on hit or empty slot; otherwise advance
+        live = live & ~hit & (trow != -1)
+        nxt = ((slot.astype(jnp.uint32) + jnp.uint32(1)) & mask)
+        slot = jnp.where(live, nxt.astype(jnp.int32), slot)
+        return slot, result, live
+
+    slot, result, live = jax.lax.fori_loop(
+        0, max_probes, body, (slot, result, live)
+    )
+    out_ref[:] = result
+
+
+def probe(
+    probe_keys: jnp.ndarray,
+    table,
+    *,
+    block_rows: int = 2048,
+    max_probes: int = 64,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas probe: per probe key, the matching build row id or -1.
+
+    probe_keys length must be a multiple of block_rows (pad with any
+    value; unmatched padding returns -1 naturally unless it collides —
+    callers mask by validity anyway)."""
+    from jax.experimental import pallas as pl
+
+    tab_lo, tab_hi, tab_row = table
+    table_cap = tab_lo.shape[0]
+    n = probe_keys.shape[0]
+    assert n % block_rows == 0, (n, block_rows)
+    plo, phi = _split64(probe_keys)
+
+    grid = (n // block_rows,)
+    blk = pl.BlockSpec((block_rows,), lambda i: (i,))
+    whole = pl.BlockSpec((table_cap,), lambda i: (0,))
+    kernel = functools.partial(
+        _probe_kernel, table_cap=table_cap, max_probes=max_probes
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        grid=grid,
+        in_specs=[blk, blk, whole, whole, whole],
+        out_specs=blk,
+        interpret=interpret,
+    )(plo, phi, tab_lo, tab_hi, tab_row)
+
+
+def join_unique(
+    build_keys: jnp.ndarray,
+    build_valid: jnp.ndarray,
+    probe_keys: jnp.ndarray,
+    probe_valid: jnp.ndarray,
+    *,
+    interpret: bool = False,
+):
+    """End-to-end unique-key inner-join mapping: for each probe row the
+    matching build row id or -1. Returns (row_ids, overflow)."""
+    nb = int(build_keys.shape[0])
+    cap = max(16, 1 << (2 * nb - 1).bit_length())
+    table, overflow = build_table(build_keys, build_valid, cap)
+    n = int(probe_keys.shape[0])
+    block = 2048 if n % 2048 == 0 else _largest_block(n)
+    rid = probe(probe_keys, table, block_rows=block, interpret=interpret)
+    rid = jnp.where(probe_valid, rid, -1)
+    # reject matches onto invalid build rows (valid rows never share slots
+    # with them because invalid rows never settle)
+    return rid, overflow
+
+
+def _largest_block(n: int) -> int:
+    for b in (1024, 512, 256, 128, 64, 32, 16, 8, 1):
+        if n % b == 0:
+            return b
+    return 1

@@ -115,11 +115,10 @@ def window_page(
                 nulls_first=sk.resolved_nulls_first(),
             )
         )
+    from presto_tpu.ops.sort import packed_argsort
+
     words = K.pack_sort_keys(parts)
-    sorted_out = jax.lax.sort(
-        tuple(words) + (iota,), num_keys=len(words), is_stable=True
-    )
-    perm = sorted_out[-1]
+    perm = packed_argsort(words, n)
     inv = jnp.zeros((n,), dtype=jnp.int64).at[perm].set(iota)
     svalid = page.valid[perm]
 

@@ -127,6 +127,8 @@ class LocalRunner:
         self.executor.use_jit = bool(
             self.session.get("tpu_offload_enabled")
         )
+        limit = int(self.session.get("query_max_memory_bytes"))
+        self.executor.max_memory_bytes = limit or None
         if isinstance(stmt, N.SetSession):
             self.session.set(stmt.name, stmt.value)
             return QueryResult([], [], update_type="SET SESSION")

@@ -80,6 +80,12 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1 << 18,
         ),
         PropertyMetadata(
+            "query_max_memory_bytes",
+            "fail queries whose largest page footprint exceeds this many "
+            "bytes (0 = unlimited; reference: query.max-memory)",
+            int, 0,
+        ),
+        PropertyMetadata(
             "hash_partition_count",
             "devices used for repartitioned stages (0 = whole mesh)",
             int, 0,

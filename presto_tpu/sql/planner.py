@@ -243,6 +243,21 @@ def outer_to_input(e: ir.RowExpression, offset_outer: int, offset_inner: int):
     return e
 
 
+def _agg_capacity(node: P.PhysicalNode, catalogs) -> int:
+    """Static group-capacity estimate for an aggregation input (reference:
+    the pre-CBO source-size heuristics): distinct groups <= input rows,
+    clamped to a sane ceiling. Avoids overflow-retry re-runs on
+    high-cardinality keys (GROUP BY orderkey) while keeping small
+    aggregations small."""
+    from presto_tpu.dist.fragmenter import est_rows
+
+    try:
+        est = est_rows(node, catalogs)
+    except Exception:
+        est = 1 << 16
+    return max(4096, min(int(est), 1 << 22))
+
+
 def find_windows(e: N.Node) -> List[N.FunctionCall]:
     """Windowed function calls (fn(...) OVER ...) in an expression, not
     crossing subquery boundaries."""
@@ -440,8 +455,10 @@ class Planner:
             rp = RelationPlan(node, left.fields)
             if s.op == "union":
                 rp = RelationPlan(
-                    P.Aggregation(rp.node, tuple(range(rp.channels)), (),
-                                  capacity=1 << 16),
+                    P.Aggregation(
+                        rp.node, tuple(range(rp.channels)), (),
+                        capacity=_agg_capacity(rp.node, self.catalogs),
+                    ),
                     rp.fields,
                 )
             return rp
@@ -862,9 +879,10 @@ class Planner:
                 outer_to_input(e, 0, n_outer) for e in corr_residual
             ]
             filt = P.Filter(join, _and_ir(preds))
+            dedup_src = P.Project(filt, (ir.InputRef(id_ch, T.BIGINT),))
             matched_ids = P.Aggregation(
-                P.Project(filt, (ir.InputRef(id_ch, T.BIGINT),)),
-                (0,), (), capacity=1 << 16,
+                dedup_src, (0,), (),
+                capacity=_agg_capacity(dedup_src, self.catalogs),
             )
             plan = RelationPlan(
                 P.HashJoin(with_id.node, matched_ids, (id_ch,), (0,),
@@ -1089,8 +1107,10 @@ class Planner:
 
         if spec.distinct:
             plan = RelationPlan(
-                P.Aggregation(plan.node, tuple(range(plan.channels)), (),
-                              capacity=1 << 16),
+                P.Aggregation(
+                    plan.node, tuple(range(plan.channels)), (),
+                    capacity=_agg_capacity(plan.node, self.catalogs),
+                ),
                 plan.fields,
             )
 
@@ -1258,14 +1278,17 @@ class Planner:
         if distinct_aggs:
             # two-level: dedupe (keys + args), then count/sum over dedup
             dedup_channels = tuple(range(len(pre_exprs)))
-            dedup = P.Aggregation(pre.node, dedup_channels, (),
-                                  capacity=1 << 16)
+            dedup = P.Aggregation(
+                pre.node, dedup_channels, (),
+                capacity=_agg_capacity(pre.node, self.catalogs),
+            )
             specs = []
             for a, ch in zip(uniq_aggs, agg_arg_ch):
                 fn = "count" if a.name == "count" else a.name
                 specs.append(P.AggSpec(fn, ch))
             agg_node = P.Aggregation(
-                dedup, tuple(range(nkeys)), tuple(specs), capacity=1 << 16
+                dedup, tuple(range(nkeys)), tuple(specs),
+                capacity=_agg_capacity(dedup, self.catalogs),
             )
         else:
             specs = []
@@ -1277,7 +1300,7 @@ class Planner:
                     specs.append(P.AggSpec(fn, ch))
             agg_node = P.Aggregation(
                 pre.node, tuple(range(nkeys)), tuple(specs),
-                capacity=1 << 16,
+                capacity=_agg_capacity(pre.node, self.catalogs),
             )
 
         # aggregate output fields: keys then one per agg

@@ -173,3 +173,20 @@ class TestAdviceRound1Regressions:
                 "select count(*) from customer where c_custkey = "
                 "(select max(no_such_col) from orders)"
             )
+
+
+def test_memory_budget_enforced(runner):
+    from presto_tpu.exec.executor import MemoryBudgetExceeded
+
+    runner.execute("set session query_max_memory_bytes = 1024")
+    try:
+        import pytest
+
+        with pytest.raises(MemoryBudgetExceeded):
+            runner.execute("select count(*) from lineitem")
+        r = runner.execute("set session query_max_memory_bytes = 0")
+        assert runner.execute(
+            "select count(*) from region"
+        ).rows == [(5,)]
+    finally:
+        runner.execute("set session query_max_memory_bytes = 0")
