@@ -28,6 +28,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: drives the real TPU chip in a subprocess (opt-in via "
+        "RUN_TPU_SMOKE=1)",
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(20260729)
