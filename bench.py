@@ -43,23 +43,32 @@ try:
 except Exception:
     pass
 
+from presto_tpu.connectors.tpcds import TpcdsConnector  # noqa: E402
 from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
 from presto_tpu.runner import LocalRunner  # noqa: E402
 from tests.tpch_queries import QUERIES  # noqa: E402
+from tests.tpcds_queries import QUERIES as DS_QUERIES  # noqa: E402
 
-# (rung name, query id, scale factor). BASELINE.md ramp order; Q3 joins
-# the ladder once the high-cardinality group-by path lands.
+# (rung name, suite, query id, scale factor). BASELINE.md ramp order; Q3
+# joins the ladder once the high-cardinality group-by path lands.
 RUNGS = [
-    ("q1_sf1", 1, 1.0),
-    ("q6_sf1", 6, 1.0),
-    # q3 runs at SF0.1: an axon/XLA:TPU runtime bug silently faults
-    # kernels touching >= ~4M-row buffers (see SKILL.md "Known perf
-    # issues"); Q3's final aggregation at SF1 crosses that line. The
-    # sorted fallback is wired but the fault persists in composition —
-    # tracked for next round.
-    ("q3_sf01", 3, 0.1),
-    ("q1_sf10", 1, 10.0),
-    ("q6_sf10", 6, 10.0),
+    ("q1_sf1", "tpch", 1, 1.0),
+    ("q6_sf1", "tpch", 6, 1.0),
+    ("q3_sf01", "tpch", 3, 0.1),
+    ("q1_sf10", "tpch", 1, 10.0),
+    ("q6_sf10", "tpch", 6, 10.0),
+    # q3 at SF1 became runnable once join-output capacities stopped
+    # compounding (oc clamp) and partial-agg pages fold incrementally —
+    # both keep every buffer under the axon >=4M-row fault line. SF10
+    # still needs host-side re-streamable intermediates (next round).
+    ("q3_sf1", "tpch", 3, 1.0),
+    # BASELINE rung 5 (TPC-DS). SF0.25: the binding constraint is the
+    # JOIN BUILD materialization, which compacts to next_pow2(slots) —
+    # store_returns at SF0.5 (2.64M slots) rounds to 4.19M and trips the
+    # >=4M-row axon kernel fault (observed: silently-fast q17 steady,
+    # then every decode in the process raising UNAVAILABLE). SF0.25
+    # keeps the largest build at 2.1M.
+    ("q17_sf025", "tpcds", 17, 0.25),
 ]
 HEADLINE = "q1_sf1"
 ORACLE_SF = 0.01  # small-SF correctness cross-check (fast)
@@ -68,16 +77,35 @@ REPS = 5
 
 # columns each query touches (for the fast sqlite loader)
 QUERY_COLS = {
-    1: {"lineitem": ["l_returnflag", "l_linestatus", "l_quantity",
+    ("tpch", 1): {
+        "lineitem": ["l_returnflag", "l_linestatus", "l_quantity",
                      "l_extendedprice", "l_discount", "l_tax",
                      "l_shipdate"]},
-    6: {"lineitem": ["l_shipdate", "l_discount", "l_quantity",
+    ("tpch", 6): {
+        "lineitem": ["l_shipdate", "l_discount", "l_quantity",
                      "l_extendedprice"]},
-    3: {"customer": ["c_custkey", "c_mktsegment"],
+    ("tpch", 3): {
+        "customer": ["c_custkey", "c_mktsegment"],
         "orders": ["o_orderkey", "o_custkey", "o_orderdate",
                    "o_shippriority"],
         "lineitem": ["l_orderkey", "l_extendedprice", "l_discount",
                      "l_shipdate"]},
+    ("tpcds", 17): {
+        "store_sales": ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+                        "ss_store_sk", "ss_ticket_number", "ss_quantity"],
+        "store_returns": ["sr_returned_date_sk", "sr_item_sk",
+                          "sr_customer_sk", "sr_ticket_number",
+                          "sr_return_quantity"],
+        "catalog_sales": ["cs_sold_date_sk", "cs_bill_customer_sk",
+                          "cs_item_sk", "cs_quantity"],
+        "date_dim": ["d_date_sk", "d_quarter_name"],
+        "store": ["s_store_sk", "s_state"],
+        "item": ["i_item_sk", "i_item_id", "i_item_desc"]},
+}
+
+SUITES = {
+    "tpch": (TpchConnector, QUERIES),
+    "tpcds": (TpcdsConnector, DS_QUERIES),
 }
 
 
@@ -93,16 +121,23 @@ def main() -> int:
                "device": str(jax.devices()[0])}
     runners = {}
 
-    def runner_for(sf):
-        if sf not in runners:
-            runners[sf] = LocalRunner({"tpch": TpchConnector(scale=sf)})
-        return runners[sf]
+    def runner_for(suite, sf):
+        if (suite, sf) not in runners:
+            cls, _q = SUITES[suite]
+            runners[(suite, sf)] = LocalRunner(
+                {suite: cls(scale=sf)}, default_catalog=suite
+            )
+        return runners[(suite, sf)]
+
+    def fact_slots(runner, suite):
+        table = "lineitem" if suite == "tpch" else "store_sales"
+        return runner.catalogs[suite].row_count(table)
 
     # ---- phase 1: compile + timed device runs (NO host reads) ----
     rung_state = {}
-    for name, qid, sf in RUNGS:
-        runner = runner_for(sf)
-        plan = runner.plan(QUERIES[qid])
+    for name, suite, qid, sf in RUNGS:
+        runner = runner_for(suite, sf)
+        plan = runner.plan(SUITES[suite][1][qid])
         t0 = time.time()
         run_device(runner.executor, plan)
         compile_s = time.time() - t0
@@ -113,15 +148,17 @@ def main() -> int:
             pages, flags = run_device(runner.executor, plan)
             times.append(time.time() - t0)
         steady = statistics.median(times)
-        # slot space (orders x 7 padded); true rows are ~4/7 of slots
-        slots_in = runner.catalogs["tpch"].row_count("lineitem")
+        # slot space of the driving fact table (padded capacity; true
+        # rows arrive via validity masks)
+        slots_in = fact_slots(runner, suite)
         details["rungs"][name] = {
+            "suite": suite,
             "query": qid,
             "sf": sf,
             "compile_s": round(compile_s, 3),
             "steady_s": round(steady, 5),
             "times_s": [round(t, 5) for t in times],
-            "lineitem_slots": slots_in,
+            "fact_slots": slots_in,
             "slots_per_s": round(slots_in / steady),
         }
         rung_state[name] = (pages, flags)
@@ -151,7 +188,7 @@ def main() -> int:
     details["oracle_sf"] = ORACLE_SF
     try:
         details["oracle_ok"] = _small_sf_check(
-            sorted({q for _, q, _ in RUNGS})
+            sorted({(s, q) for _, s, q, _ in RUNGS})
         )
     except Exception as e:  # pragma: no cover
         details["oracle_ok"] = {"error": repr(e)[:200]}
@@ -162,14 +199,17 @@ def main() -> int:
     if os.path.exists(cache_path):
         with open(cache_path) as f:
             cache = json.load(f)
-    for name, qid, sf in RUNGS:
-        key = f"q{qid}_sf{sf}"
+    for name, suite, qid, sf in RUNGS:
+        prefix = "" if suite == "tpch" else f"{suite}_"
+        key = f"{prefix}q{qid}_sf{sf}"
         if cache.get(key) is None:
             # None never sticks: a transient sqlite failure must retry on
             # the next bench run instead of poisoning the cache file
             if sf <= MAX_SQLITE_SF:
                 try:
-                    cache[key] = _sqlite_time(runner_for(sf), qid)
+                    cache[key] = _sqlite_time(
+                        runner_for(suite, sf), suite, qid
+                    )
                 except Exception:  # pragma: no cover
                     cache[key] = None
             else:
@@ -199,9 +239,9 @@ def _write_details(details) -> None:
         json.dump(details, f, indent=1, sort_keys=True)
 
 
-def _small_sf_check(qids):
-    """Engine-vs-sqlite correctness at ORACLE_SF using the test suite's
-    adapted oracle queries (tests/test_sql_tpch.py)."""
+def _small_sf_check(suite_qids):
+    """Engine-vs-sqlite correctness at ORACLE_SF using the test suites'
+    adapted oracle queries (tests/test_sql_tpch.py, test_sql_tpcds.py)."""
     out = {}
     try:
         from tests.oracle import load_sqlite
@@ -210,7 +250,9 @@ def _small_sf_check(qids):
         conn = TpchConnector(scale=ORACLE_SF)
         runner = LocalRunner({"tpch": conn})
         db = load_sqlite(conn, conn.tables())
-        for qid in qids:
+        for suite, qid in suite_qids:
+            if suite != "tpch":
+                continue
             try:
                 got = runner.execute(ENGINE_SQL[qid]).rows
                 want = db.execute(ORACLE[qid][0]).fetchall()
@@ -218,6 +260,29 @@ def _small_sf_check(qids):
                 out[str(qid)] = True
             except AssertionError as e:
                 out[str(qid)] = f"MISMATCH: {str(e)[:200]}"
+        if any(s == "tpcds" for s, _ in suite_qids):
+            from tests.test_sql_tpcds import (
+                _compare,
+                _StddevSamp,
+                ds_oracle,
+            )
+
+            dsconn = TpcdsConnector(scale=ORACLE_SF)
+            dsrunner = LocalRunner({"tpcds": dsconn},
+                                   default_catalog="tpcds")
+            dsdb = load_sqlite(dsconn, dsconn.tables())
+            dsdb.create_aggregate("stddev_samp", 1, _StddevSamp)
+            for suite, qid in suite_qids:
+                if suite != "tpcds":
+                    continue
+                try:
+                    oracle_sql, float_cols = ds_oracle(qid)
+                    got = dsrunner.execute(DS_QUERIES[qid]).rows
+                    want = dsdb.execute(oracle_sql).fetchall()
+                    _compare(got, want, float_cols, f"Q{qid}")
+                    out[f"tpcds_{qid}"] = True
+                except AssertionError as e:
+                    out[f"tpcds_{qid}"] = f"MISMATCH: {str(e)[:200]}"
     except Exception as e:  # pragma: no cover
         out["error"] = repr(e)[:300]
     return out
@@ -265,20 +330,33 @@ def _fast_load_sqlite(connector, needed):
     return db
 
 
-def _sqlite_time(runner, qid: int) -> float:
+def _sqlite_time(runner, suite: str, qid: int) -> float:
     """Wall-clock of the adapted oracle query in sqlite3 over the same
     generated rows (single-node CPU SQL engine baseline)."""
-    from tests.test_sql_tpch import ORACLE
+    if suite == "tpch":
+        from tests.test_sql_tpch import ORACLE
 
+        sql = ORACLE[qid][0]
+    else:
+        from tests.test_sql_tpcds import ds_oracle
+
+        sql = ds_oracle(qid)[0]
     t0 = time.time()
-    db = _fast_load_sqlite(runner.catalogs["tpch"], QUERY_COLS[qid])
+    db = _fast_load_sqlite(
+        runner.catalogs[suite], QUERY_COLS[(suite, qid)]
+    )
+    if suite == "tpcds":
+        from tests.test_sql_tpcds import _StddevSamp
+
+        db.create_aggregate("stddev_samp", 1, _StddevSamp)
     load_s = time.time() - t0
-    print(f"# sqlite load for q{qid}: {load_s:.0f}s", file=sys.stderr)
+    print(f"# sqlite load for {suite} q{qid}: {load_s:.0f}s",
+          file=sys.stderr)
     t0 = time.time()
-    db.execute(ORACLE[qid][0]).fetchall()
+    db.execute(sql).fetchall()
     first = time.time() - t0
     t0 = time.time()
-    db.execute(ORACLE[qid][0]).fetchall()
+    db.execute(sql).fetchall()
     return min(first, time.time() - t0)
 
 

@@ -60,12 +60,23 @@ class StatementClient:
         req = urllib.request.Request(
             url, data=data, headers=self._headers(), method=method
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            body = json.loads(resp.read().decode())
-            set_sess = resp.headers.get("X-Presto-Set-Session")
-            if set_sess and "=" in set_sess:
-                k, v = set_sess.split("=", 1)
-                self.session_properties[k] = v
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.loads(resp.read().decode())
+                set_sess = resp.headers.get("X-Presto-Set-Session")
+                if set_sess and "=" in set_sess:
+                    k, v = set_sess.split("=", 1)
+                    self.session_properties[k] = v
+        except urllib.error.HTTPError as e:
+            # error statuses (e.g. 429 QUERY_QUEUE_FULL) still carry the
+            # protocol's error body — surface it instead of raising
+            # (reference: StatementClient parses QueryResults.error)
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                raise e
+            if "error" not in body:
+                raise e
         return body
 
     def execute(self, sql: str) -> ClientResult:

@@ -51,6 +51,94 @@ class Split:
     row_count: int
 
 
+class GeneratorConnector:
+    """Mixin for on-device deterministic generators (tpch/tpcds): column-
+    pruned, jit-compiled chunk generation from the global row index.
+    Subclasses provide ``_schemas`` (name -> TableSchema), ``_dicts``
+    (table -> column -> Dictionary), a ``_gen_cache`` dict, and one
+    ``_gen_<table>(start, n) -> _Lazy`` method per table."""
+
+    def page_for_split(self, split: "Split",
+                       columns: Optional[Sequence[str]] = None) -> Page:
+        schema = self.table_schema(split.table)
+        names = tuple(columns) if columns is not None else tuple(
+            schema.column_names()
+        )
+        fn = self._compiled_gen(split.table, split.row_count, names)
+        import jax.numpy as jnp
+
+        datas, valid = fn(jnp.int64(split.start_row))
+        dicts = self._dicts.get(split.table, {})
+        blocks = []
+        from presto_tpu.page import Block
+
+        for nm, data in zip(names, datas):
+            blocks.append(
+                Block(
+                    data=data,
+                    type=schema.column_type(nm),
+                    nulls=None,
+                    dictionary=dicts.get(nm),
+                )
+            )
+        return Page(blocks=tuple(blocks), valid=valid)
+
+    def _compiled_gen(self, table: str, n: int, names: tuple):
+        """jit-compiled, column-pruned chunk generator; start_row is traced
+        so one compilation serves every chunk of the table."""
+        import jax
+
+        key = (table, n, names)
+        if key not in self._gen_cache:
+            self._gen_cache[key] = jax.jit(self.gen_body(table, n, names))
+        return self._gen_cache[key]
+
+    def gen_body(self, table: str, n: int, names: tuple):
+        """Traceable chunk generator (Connector.gen_body): pure function of
+        the traced start row, safe inside jit or shard_map."""
+        gen = getattr(self, f"_gen_{table}")
+
+        def fn(start):
+            lazy = gen(start, n)
+            return (
+                tuple(lazy.get(nm) for nm in names),
+                lazy.get("__valid__"),
+            )
+
+        return fn
+
+    def host_rows(self, table: str, target_rows: int = 1 << 20):
+        """Materialize a table as Python row tuples (oracle loading)."""
+        out = []
+        for page in self.pages(table, target_rows=target_rows):
+            out.extend(page.to_pylist())
+        return out
+
+    # ------------------------------------------------- predicate pushdown
+    def monotonic_row_bound(self, table: str, column: str):
+        """For a column that is non-decreasing in the row index, return
+        f(v) = smallest row index whose value >= v (clamped to >= 0);
+        None if the column is not monotonic. Lets prune_splits invert a
+        value range into a row range — generator tables get TupleDomain
+        pushdown for free on their key columns."""
+        return None
+
+    def prune_splits(self, table, splits, constraint):
+        out = splits
+        for col, lo, hi in constraint:
+            f = self.monotonic_row_bound(table, col)
+            if f is None:
+                continue
+            row_lo = max(f(lo), 0) if lo is not None else 0
+            row_hi = max(f(hi + 1), 0) if hi is not None else None
+            out = [
+                s for s in out
+                if s.start_row + s.row_count > row_lo
+                and (row_hi is None or s.start_row < row_hi)
+            ]
+        return out
+
+
 class Connector:
     """Reference: spi/connector/Connector + ConnectorMetadata."""
 
@@ -81,6 +169,15 @@ class Connector:
     ) -> Page:
         raise NotImplementedError
 
+    def prune_splits(
+        self, table: str, splits: List[Split], constraint
+    ) -> List[Split]:
+        """Drop splits that provably contain no row satisfying the pushed
+        constraint ((column, lo, hi) closed integer ranges — the
+        TupleDomain analog, see exec/pushdown.py). Advisory: the engine
+        re-applies the full predicate to surviving pages."""
+        return splits
+
     def gen_body(self, table: str, n: int, names: Tuple[str, ...]):
         """Optional traceable chunk generator for SPMD scans: a pure
         function ``start_row -> (tuple of column arrays, valid mask)`` the
@@ -95,7 +192,11 @@ class Connector:
         table: str,
         columns: Optional[Sequence[str]] = None,
         target_rows: int = 1 << 20,
+        constraint=None,
     ) -> Iterator[Page]:
-        for split in self.splits(table, target_rows):
+        splits = self.splits(table, target_rows)
+        if constraint:
+            splits = self.prune_splits(table, splits, constraint)
+        for split in splits:
             if split.row_count:
                 yield self.page_for_split(split, columns)

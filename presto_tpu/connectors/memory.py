@@ -41,6 +41,23 @@ class _StoredTable:
                 self.dictionaries[cs.name] = Dictionary(distinct)
             else:
                 self.dictionaries[cs.name] = None
+        # integer column arrays for split min/max pruning (the memory
+        # connector's TupleDomain stats; reference: per-page stats kept
+        # by storage connectors for predicate pushdown)
+        self.int_cols: Dict[str, tuple] = {}
+        for col, cs in zip(self.columns, schema.columns):
+            t = cs.type
+            if T.is_string(t) or T.is_floating(t):
+                continue
+            try:
+                vals = np.array(
+                    [0 if v is None else int(v) for v in col],
+                    dtype=np.int64,
+                )
+            except (TypeError, ValueError, OverflowError):
+                continue
+            nulls = np.array([v is None for v in col], dtype=bool)
+            self.int_cols[cs.name] = (vals, nulls)
 
     @property
     def row_count(self) -> int:
@@ -99,6 +116,36 @@ class MemoryConnector(Connector):
 
     def row_count(self, table: str) -> int:
         return self._tables[table].row_count
+
+    def prune_splits(self, table, splits, constraint):
+        """Per-split min/max pruning over the stored integer columns
+        (TupleDomain pushdown, exec/pushdown.py; a split that is all-null
+        in a constrained column can never match either)."""
+        t = self._tables.get(table)
+        if t is None:
+            return splits
+        out = []
+        for s in splits:
+            keep = True
+            for col, lo, hi in constraint:
+                stats = t.int_cols.get(col)
+                if stats is None:
+                    continue
+                vals, nulls = stats
+                seg = vals[s.start_row:s.start_row + s.row_count]
+                ok = ~nulls[s.start_row:s.start_row + s.row_count]
+                if not ok.any():
+                    keep = False  # all-null: no comparison can pass
+                    break
+                smin, smax = seg[ok].min(), seg[ok].max()
+                if (lo is not None and smax < lo) or (
+                    hi is not None and smin > hi
+                ):
+                    keep = False
+                    break
+            if keep:
+                out.append(s)
+        return out
 
     def page_for_split(
         self, split: Split, columns: Optional[Sequence[str]] = None

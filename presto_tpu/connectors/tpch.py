@@ -43,7 +43,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu import types as T
-from presto_tpu.connectors.base import ColumnSchema, Connector, Split, TableSchema
+from presto_tpu.connectors.base import (
+    ColumnSchema,
+    Connector,
+    GeneratorConnector,
+    Split,
+    TableSchema,
+)
 from presto_tpu.ops.hashing import xxhash64_u64
 from presto_tpu.page import Block, Dictionary, Page
 
@@ -340,7 +346,7 @@ class _Lazy:
 # ------------------------------------------------------------- connector
 
 
-class TpchConnector(Connector):
+class TpchConnector(GeneratorConnector, Connector):
     """Reference: presto-tpch TpchConnectorFactory — schema name carries the
     scale factor (catalog.sf1.lineitem)."""
 
@@ -442,51 +448,31 @@ class TpchConnector(Connector):
         return super().splits(table, target_rows)
 
     # ----------------------------------------------------------- generation
-    def page_for_split(
-        self, split: Split, columns: Optional[Sequence[str]] = None
-    ) -> Page:
-        schema = self.table_schema(split.table)
-        names = tuple(columns) if columns is not None else tuple(
-            schema.column_names()
-        )
-        fn = self._compiled_gen(split.table, split.row_count, names)
-        datas, valid = fn(jnp.int64(split.start_row))
-        dicts = self._dicts.get(split.table, {})
-        blocks = []
-        for nm, data in zip(names, datas):
-            blocks.append(
-                Block(
-                    data=data,
-                    type=schema.column_type(nm),
-                    nulls=None,
-                    dictionary=dicts.get(nm),
-                )
-            )
-        return Page(blocks=tuple(blocks), valid=valid)
+    # page_for_split/_compiled_gen/gen_body come from GeneratorConnector.
 
-    def _compiled_gen(self, table: str, n: int, names: tuple):
-        """jit-compiled, column-pruned chunk generator. start_row is a
-        traced argument so one compilation serves every chunk of the table
-        (reference analog: TpchRecordSet cursors parameterized by split)."""
-        key = (table, n, names)
-        if key not in self._gen_cache:
-            self._gen_cache[key] = jax.jit(self.gen_body(table, n, names))
-        return self._gen_cache[key]
+    def monotonic_row_bound(self, table: str, column: str):
+        """Key columns are monotonic in the row index (spec layout), so
+        pushed key ranges prune whole generator splits (TupleDomain
+        pushdown, exec/pushdown.py)."""
 
-    def gen_body(self, table: str, n: int, names: tuple):
-        """Traceable chunk generator (Connector.gen_body): pure function of
-        the traced start row, safe to call inside jit or shard_map — the
-        SPMD scan path generates each device's shard on-device."""
-        gen = getattr(self, f"_gen_{table}")
+        def okey_row(v: int) -> int:
+            # smallest order idx with sparse orderkey >= v
+            # (okey(i) = (i//8)*32 + i%8 + 1, dbgen mk_sparse)
+            v0 = max(v - 1, 0)
+            block, w = divmod(v0, 32)
+            return block * 8 + min(w, 8)
 
-        def fn(start):
-            lazy = gen(start, n)
-            return (
-                tuple(lazy.get(nm) for nm in names),
-                lazy.get("__valid__"),
-            )
-
-        return fn
+        return {
+            ("orders", "o_orderkey"): okey_row,
+            ("lineitem", "l_orderkey"):
+                lambda v: okey_row(v) * MAX_LINES_PER_ORDER,
+            ("customer", "c_custkey"): lambda v: v - 1,
+            ("part", "p_partkey"): lambda v: v - 1,
+            ("supplier", "s_suppkey"): lambda v: v - 1,
+            ("partsupp", "ps_partkey"): lambda v: (v - 1) * 4,
+            ("nation", "n_nationkey"): lambda v: v,
+            ("region", "r_regionkey"): lambda v: v,
+        }.get((table, column))
 
     # ---- per-table generators: return a _Lazy of column thunks over
     # traced global row keys. All values are pure functions of row keys.
@@ -741,15 +727,6 @@ class TpchConnector(Connector):
             lv()["key"], "lineitem", "comment", 0, 8191).astype(jnp.int32))
         lz.put("__valid__", lambda: line <= self._lines_per_order(okey))
         return lz
-
-    # ------------------------------------------------------------ host IO
-    def host_rows(self, table: str, target_rows: int = 1 << 20):
-        """Materialize a table as Python row tuples (oracle loading)."""
-        out = []
-        for page in self.pages(table, target_rows=target_rows):
-            out.extend(page.to_pylist())
-        return out
-
 
 def _build_schemas() -> Dict[str, TableSchema]:
     V = T.VARCHAR

@@ -56,7 +56,7 @@ def est_rows(node: P.PhysicalNode, catalogs) -> int:
         )
     if isinstance(node, P.Union):
         return sum(est_rows(s, catalogs) for s in node.sources)
-    if isinstance(node, (P.Sort, P.Output, P.Window)):
+    if isinstance(node, (P.Sort, P.Output, P.Window, P.MarkDistinct)):
         return est_rows(node.source, catalogs)
     if isinstance(node, P.TopN):
         return min(est_rows(node.source, catalogs), node.limit)
@@ -162,7 +162,12 @@ def add_exchanges(
             if dr == SHARDED:
                 right = _gather(right)
             return P.CrossJoin(left, right), dl
-        if isinstance(n, (P.Sort, P.TopN, P.Limit, P.Output, P.Window)):
+        if isinstance(n, (P.Sort, P.TopN, P.Limit, P.Output, P.Window,
+                          P.MarkDistinct)):
+            # MarkDistinct needs a global view of each key set (first-
+            # occurrence marks are meaningless per shard) — conservative
+            # gather, like Sort/Window (reference: MarkDistinctNode
+            # forces its own exchange too)
             src, d = rewrite(n.source)
             if d == SHARDED:
                 src = _gather(src)

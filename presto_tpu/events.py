@@ -1,0 +1,58 @@
+"""Event listener SPI.
+
+Reference: presto-spi spi/eventlistener/EventListener.java — plugins
+receive QueryCreatedEvent / QueryCompletedEvent built by
+presto-main event/QueryMonitor.java; the hook for warehouse-side query
+logging (SURVEY §6.5). The TPU engine keeps the same shape: listeners
+are registered on the server (or QueryManager) and receive immutable
+event records; listener failures are swallowed so they can never fail a
+query (reference behavior).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    user: str
+    create_time: float  # unix seconds
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    user: str
+    state: str  # FINISHED | FAILED | CANCELED
+    create_time: float
+    end_time: float
+    wall_ms: int
+    row_count: int
+    error_name: Optional[str] = None
+    error_message: Optional[str] = None
+
+
+class EventListener:
+    """Subclass and override; register via PrestoTpuServer(
+    event_listeners=[...]) or QueryManager(listeners=[...])."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+
+def dispatch(listeners, method: str, event) -> None:
+    """Deliver an event to every listener, swallowing listener errors
+    (a misbehaving listener must never fail the query)."""
+    for lst in listeners:
+        try:
+            getattr(lst, method)(event)
+        except Exception:  # noqa: BLE001 - reference behavior
+            pass

@@ -69,7 +69,23 @@ def state_layout(function: str, in_type: Optional[T.SqlType]) -> List[StateCol]:
         return [StateCol("sum", A.SUM, A.SUM, T.BIGINT)]
     if function == "avg":
         return state_layout("sum", in_type) + state_layout("count", in_type)
+    if function in VARIANCE_FNS:
+        # (count, sum, sum-of-squares) double state; the planner casts the
+        # input to DOUBLE first. Reference: operator/aggregation/
+        # VarianceAggregation uses (count, mean, m2) Welford state — the
+        # TPU translation uses moment sums because they are plain segmented
+        # reductions (merge = add); m2 is recovered at finalize.
+        return [
+            StateCol("count", A.COUNT, A.SUM, T.BIGINT),
+            StateCol("sum", A.SUM, A.SUM, T.DOUBLE),
+            StateCol("sumsq", A.SUM, A.SUM, T.DOUBLE, pre="sq"),
+        ]
     raise ValueError(f"unknown aggregate function: {function}")
+
+
+VARIANCE_FNS = frozenset(
+    {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
+)
 
 
 def result_type(function: str, in_type: Optional[T.SqlType]) -> T.SqlType:
@@ -92,6 +108,8 @@ def result_type(function: str, in_type: Optional[T.SqlType]) -> T.SqlType:
         if isinstance(in_type, T.DecimalType):
             return in_type
         return T.DOUBLE
+    if function in VARIANCE_FNS:
+        return T.DOUBLE
     raise ValueError(f"unknown aggregate function: {function}")
 
 
@@ -102,6 +120,9 @@ def pre_transform(pre: Optional[str], data: jnp.ndarray) -> jnp.ndarray:
         return data >> jnp.int64(32)  # arithmetic: floor(v / 2^32)
     if pre == "lo32":
         return data & _MASK32
+    if pre == "sq":
+        d = data.astype(jnp.float64)
+        return d * d
     raise ValueError(pre)
 
 
@@ -160,4 +181,21 @@ def finalize(
         cnt = xp.maximum(count, jnp.int64(1)).astype(jnp.float64)
         data = s.astype(jnp.float64) / cnt
         return Block(data=data, type=T.DOUBLE, nulls=sn)
+    if function in VARIANCE_FNS:
+        (count, _), (s, _), (sq, _) = states
+        n = count.astype(jnp.float64)
+        safe_n = xp.maximum(n, 1.0)
+        s = s.astype(jnp.float64)
+        # m2 = sum((x - mean)^2) = sumsq - sum^2/n; clamp the cancellation
+        # residue so rounding never yields a negative variance / NaN sqrt
+        m2 = xp.maximum(sq.astype(jnp.float64) - s * s / safe_n, 0.0)
+        if function.endswith("_pop"):
+            var = m2 / safe_n
+            nulls = count == 0
+        else:
+            var = m2 / xp.maximum(n - 1.0, 1.0)
+            nulls = count < 2
+        if function.startswith("stddev"):
+            var = xp.sqrt(var)
+        return Block(data=var, type=T.DOUBLE, nulls=nulls)
     raise ValueError(f"unknown aggregate function: {function}")

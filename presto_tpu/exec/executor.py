@@ -31,6 +31,7 @@ from presto_tpu.exec import agg_states as S
 from presto_tpu.exec import plan as P
 from presto_tpu.expr.eval import evaluate, evaluate_filter
 from presto_tpu.ops import agg as A
+from presto_tpu.ops import hashing as H
 from presto_tpu.ops import join as J
 from presto_tpu.ops import keys as K
 from presto_tpu.ops.compact import compact_page, concat_all, gather_rows
@@ -41,6 +42,22 @@ from presto_tpu.page import Block, Dictionary, Page
 def _next_pow2(n: int) -> int:
     n = max(int(n), 8)
     return 1 << (n - 1).bit_length()
+
+
+def _row_bytes(types) -> int:
+    """Static per-row footprint of a channel list (spill estimates)."""
+    total = 2  # valid bit + null mask, bytewise
+    for t in types:
+        if isinstance(t, T.DecimalType) and not t.is_short:
+            total += 16
+        elif T.is_string(t):
+            total += 4  # dictionary codes
+        else:
+            try:
+                total += np.dtype(t.numpy_dtype).itemsize
+            except Exception:
+                total += 8
+    return total
 
 
 def _canonical_join_cols(
@@ -158,6 +175,16 @@ class Executor:
         self.max_memory_bytes: Optional[int] = None
         self.peak_memory_bytes = 0
         self._live_bytes = 0
+        # Partitioned (grace-style) execution — the spill analog (SURVEY
+        # §6.4, reference: spiller/* + revocable memory): when a join
+        # build or aggregation state estimate exceeds this many bytes, the
+        # operator runs in hash-partition passes over its inputs instead
+        # of one materialization. Re-scanning per pass is cheap because
+        # generator connectors compute pages from row indices ("scan" =
+        # "generate", SURVEY §8.2.6); host-page connectors restage from
+        # host RAM — which IS the HBM->host-RAM spill.  None = disabled.
+        self.spill_bytes: Optional[int] = None
+        self.spill_partitions_used = 0  # observability / tests
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
@@ -211,6 +238,10 @@ class Executor:
             return out
         if isinstance(node, P.Exchange):
             return self.output_types(node.source)
+        if isinstance(node, P.MarkDistinct):
+            return self.output_types(node.source) + [
+                T.BOOLEAN for _ in node.mark_channel_sets
+            ]
         if isinstance(node, P.Window):
             from presto_tpu.ops import window as W
 
@@ -270,7 +301,8 @@ class Executor:
         if isinstance(node, P.TableScan):
             conn = self.catalogs[node.catalog]
             yield from conn.pages(
-                node.table, node.columns, target_rows=self.page_rows
+                node.table, node.columns, target_rows=self.page_rows,
+                constraint=node.constraint,
             )
             return
         if isinstance(node, P.Values):
@@ -338,6 +370,29 @@ class Executor:
         if isinstance(node, P.Union):
             for src in node.sources:
                 yield from self.pages(src)
+            return
+        if isinstance(node, P.MarkDistinct):
+            pages = list(self.pages(node.source))
+            if not pages:
+                return
+            merged = concat_all(pages) if len(pages) > 1 else pages[0]
+            self._account_page(merged)
+            fn = self._jit(
+                ("markdistinct", node),
+                functools.partial(
+                    _mark_distinct_page, node.mark_channel_sets
+                ),
+                static_argnums=(1, 2),
+            )
+            # boost rides as a static arg so the retry ladder actually
+            # deepens probing (a boost baked into the partial would be
+            # invisible to the jit cache key)
+            out, overflow = fn(
+                merged, _next_pow2(merged.capacity),
+                64 * self._capacity_boost,
+            )
+            self._pending_overflow.append(overflow)
+            yield out
             return
         if isinstance(node, P.Window):
             from presto_tpu.ops import window as W
@@ -433,6 +488,7 @@ class Executor:
         )
         self._capacity_boost = 1  # per-query; grows only across retries
         self.peak_memory_bytes = 0
+        self.spill_partitions_used = 0
         for _attempt in range(6):
             self._pending_overflow = []
             if self._collect_stats is not None:
@@ -596,6 +652,32 @@ class Executor:
             yield self._exec_global_agg(node, in_types, layouts)
             return
 
+        parts = 1
+        src_types = (
+            self.output_types(node.source)
+            if self.spill_bytes is not None else None
+        )
+        if src_types is not None and self._keys_partitionable(
+            src_types, node.group_channels
+        ):
+            est_rows = self.estimate_rows(node.source)
+            cap_est = _next_pow2(max(node.capacity, 8))
+            n_pages = max(-(-est_rows // max(self.page_rows, 1)), 1)
+            state_types = [src_types[c] for c in node.group_channels]
+            for spec, in_t in zip(node.aggregates, in_types):
+                state_types.extend(
+                    st.type for st in S.state_layout(spec.function, in_t)
+                )
+            merged_slots = min(est_rows, n_pages * cap_est)
+            parts = self._spill_partitions(
+                merged_slots * _row_bytes(state_types)
+            )
+        if parts > 1:
+            yield from self._exec_agg_partitioned(
+                node, parts, in_types, layouts
+            )
+            return
+
         # no global clamp: boosted retries must be able to grow past
         # page_rows (join-output pages can exceed it); the per-page
         # min(..., page.capacity) below bounds each launch
@@ -613,18 +695,52 @@ class Executor:
         # remaining overflow source is unresolved probing after max_iters
         # lockstep rounds, which more capacity alone cannot fix
         max_iters = 64 * self._capacity_boost
-        partials: List[Page] = []
+        # Incremental fold: buffered partial pages merge into one
+        # bounded state page instead of one giant concat — a 6-page
+        # pipeline with a 2M capacity estimate otherwise concats 6M+
+        # slots and crosses the >=4M-row axon fault line (and wastes
+        # memory even where it doesn't fault). fold_cap deliberately
+        # undersizes vs the planner estimate; true high-cardinality
+        # group-bys overflow onto the boosted-retry ladder (and, when
+        # spill is on, onto partitioned passes).
+        fold_cap = min(cap, _next_pow2((1 << 20) * self._capacity_boost))
+        merge_fn = self._jit(
+            ("agg_merge", node),
+            functools.partial(
+                _merge_partials_page, node.aggregates,
+                tuple(tuple(l) for l in layouts),
+                len(node.group_channels)
+            ),
+            static_argnums=(1, 2),
+        )
+        acc: Optional[Page] = None
+        buf: List[Page] = []
+        buf_slots = 0
+        saw_input = False
         for page in self.pages(node.source):
+            saw_input = True
             # distinct groups <= rows, so clip the capacity to the page
             out, overflow = partial_fn(
                 page, min(cap, _next_pow2(page.capacity)), max_iters
             )
             self._pending_overflow.append(overflow)
-            partials.append(out)
-        if not partials:
+            buf.append(out)
+            buf_slots += out.capacity
+            if buf_slots >= 2 * fold_cap:
+                pages_ = ([acc] if acc is not None else []) + buf
+                merged = (
+                    concat_all(pages_) if len(pages_) > 1 else pages_[0]
+                )
+                self._account_page(merged)
+                acc, overflow = merge_fn(merged, fold_cap, max_iters)
+                self._pending_overflow.append(overflow)
+                buf, buf_slots = [], 0
+        if not saw_input:
             return
 
-        merged = concat_all(partials) if len(partials) > 1 else partials[0]
+        pages_ = ([acc] if acc is not None else []) + buf
+        merged = concat_all(pages_) if len(pages_) > 1 else pages_[0]
+        self._account_page(merged)
         final_fn = self._jit(
             ("agg_final", node),
             functools.partial(
@@ -640,6 +756,86 @@ class Executor:
         out, overflow = final_fn(merged, fcap, max_iters)
         self._pending_overflow.append(overflow)
         yield out
+
+    def _exec_agg_partitioned(
+        self, node: P.Aggregation, parts: int, in_types, layouts
+    ) -> Iterator[Page]:
+        """Partition-wise grouped aggregation (spill analog): P passes
+        over the input, each aggregating only the groups whose key hash
+        lands in the pass's partition — state stays ~1/P of the one-shot
+        size and group partitions are disjoint, so the union of pass
+        outputs is the exact result. Reference: SpillableHash-
+        AggregationBuilder's partition-and-merge, re-expressed as
+        recomputation because generator scans are free (SURVEY §8.2.6)."""
+        self.spill_partitions_used = max(self.spill_partitions_used, parts)
+        pfilter = self._partition_filter(node.group_channels, parts)
+        cap = _next_pow2(node.capacity * self._capacity_boost)
+        pcap = _next_pow2(max(cap // parts * 2, 1024))
+        max_iters = 64 * self._capacity_boost
+        partial_fn = self._jit(
+            ("agg_partial", node),
+            functools.partial(
+                _partial_agg_page, node.group_channels, node.aggregates,
+                tuple(tuple(l) for l in layouts)
+            ),
+            static_argnums=(1, 2),
+        )
+        final_fn = self._jit(
+            ("agg_final", node),
+            functools.partial(
+                _final_agg_page, node.group_channels, node.aggregates,
+                tuple(tuple(l) for l in layouts), tuple(in_types)
+            ),
+            static_argnums=(1, 2),
+        )
+        nkeys = len(node.group_channels)
+        merge_fn = self._jit(
+            ("agg_merge", node),
+            functools.partial(
+                _merge_partials_page, node.aggregates,
+                tuple(tuple(l) for l in layouts), nkeys
+            ),
+            static_argnums=(1, 2),
+        )
+        for p in range(parts):
+            pj = jnp.uint64(p)
+            # incremental fold: buffered partial pages merge into one
+            # pcap-sized state page whenever they pile up, so per-pass
+            # memory is O(pcap), not O(pages x pcap)
+            acc: Optional[Page] = None
+            buf: List[Page] = []
+            buf_slots = 0
+            saw_input = False
+
+            def fold(acc, buf):
+                pages = ([acc] if acc is not None else []) + buf
+                merged = concat_all(pages) if len(pages) > 1 else pages[0]
+                self._account_page(merged)
+                out, overflow = merge_fn(merged, pcap, max_iters)
+                self._pending_overflow.append(overflow)
+                return out
+
+            for page in self.pages(node.source):
+                saw_input = True
+                f = pfilter(page, pj)
+                out, overflow = partial_fn(
+                    f, min(pcap, _next_pow2(page.capacity)), max_iters
+                )
+                self._pending_overflow.append(overflow)
+                buf.append(out)
+                buf_slots += out.capacity
+                if buf_slots >= 4 * pcap:
+                    acc = fold(acc, buf)
+                    buf, buf_slots = [], 0
+            if not saw_input:
+                return
+            pages = ([acc] if acc is not None else []) + buf
+            merged = concat_all(pages) if len(pages) > 1 else pages[0]
+            self._account_page(merged)
+            fcap = min(pcap, _next_pow2(merged.capacity))
+            out, overflow = final_fn(merged, fcap, max_iters)
+            self._pending_overflow.append(overflow)
+            yield out
 
     def _exec_global_agg(self, node, in_types, layouts) -> Page:
         partial_fn = self._jit(
@@ -664,11 +860,100 @@ class Executor:
         )
         return final_fn(merged)
 
+    # ------------------------------------------------- spill / partitions
+    def estimate_rows(self, node: P.PhysicalNode) -> int:
+        """Static (host-only) row-count upper estimate for spill planning
+        (reference analog: the stats AddExchanges consults; ours derives
+        from connector row counts — no selectivity model, conservative)."""
+        if isinstance(node, P.TableScan):
+            return self.catalogs[node.catalog].row_count(node.table)
+        if isinstance(node, P.Values):
+            return len(node.rows)
+        if isinstance(node, P.Limit):
+            return min(node.count + node.offset,
+                       self.estimate_rows(node.source))
+        if isinstance(node, P.TopN):
+            return min(node.limit, self.estimate_rows(node.source))
+        if isinstance(node, P.Aggregation):
+            if not node.group_channels:
+                return 1
+            return min(node.capacity, self.estimate_rows(node.source))
+        if isinstance(node, P.HashJoin):
+            left = self.estimate_rows(node.left)
+            if node.join_type in ("semi", "anti"):
+                return left
+            return max(left, self.estimate_rows(node.right))
+        if isinstance(node, P.CrossJoin):
+            return self.estimate_rows(node.left) * max(
+                self.estimate_rows(node.right), 1
+            )
+        if isinstance(node, P.Union):
+            return sum(self.estimate_rows(s) for s in node.sources)
+        kids = node.children()
+        return self.estimate_rows(kids[0]) if kids else 1
+
+    def _partition_filter(self, keys: Tuple[int, ...], parts: int,
+                          keep_nulls: bool = False):
+        """Jitted page transform keeping only rows whose key hash lands in
+        partition p (p is traced: one compile serves every pass).
+
+        Partitioning uses the HIGH hash bits: the group-by/join hash
+        tables bucket on the low bits (h & (cap-1), ops/agg.py), and
+        parts is a power of two — low-bit partitioning would fix those
+        bits and cluster every pass's keys into cap/parts slots,
+        inflating probe chains ~parts-fold.
+
+        keep_nulls=True routes null-key rows into EVERY pass: semi/anti
+        joins need the global "build side contains NULL" fact per pass
+        for NOT IN three-valued logic (a null build row otherwise lands
+        in exactly one partition and the other passes wrongly emit
+        unmatched probe rows as definite non-matches)."""
+
+        def fn(page: Page, p):
+            blocks = [page.block(c) for c in keys]
+            cols, nulls = K.block_key_columns(blocks)
+            h = H.hash_columns(cols, nulls)
+            keep = ((h >> jnp.uint64(32)) % jnp.uint64(parts)) == p
+            if keep_nulls:
+                any_null = jnp.zeros(page.valid.shape, dtype=jnp.bool_)
+                for b in blocks:
+                    if b.nulls is not None:
+                        any_null = any_null | b.nulls
+                keep = keep | any_null
+            return Page(blocks=page.blocks, valid=page.valid & keep)
+
+        return self._jit(("partfilter", keys, parts, keep_nulls), fn)
+
+    def _spill_partitions(self, est_bytes: int) -> int:
+        if self.spill_bytes is None or est_bytes <= self.spill_bytes:
+            return 1
+        return min(_next_pow2(-(-est_bytes // self.spill_bytes)), 256)
+
+    def _keys_partitionable(self, types, keys) -> bool:
+        """Partition hashing is value-consistent only for non-dictionary
+        columns (dictionary codes are page-local); string keys disable
+        partitioned mode for the operator."""
+        return not any(T.is_string(types[c]) for c in keys)
+
     # --------------------------------------------------------------- join
     def _exec_join(self, node: P.HashJoin) -> Iterator[Page]:
-        build_pages = list(self.pages(node.right))
         left_types = self.output_types(node.left)
         right_types = self.output_types(node.right)
+        parts = 1
+        if (
+            self.spill_bytes is not None  # skip estimation when disabled
+            and self._keys_partitionable(right_types, node.right_keys)
+            and self._keys_partitionable(left_types, node.left_keys)
+        ):
+            parts = self._spill_partitions(
+                self.estimate_rows(node.right) * _row_bytes(right_types)
+            )
+        if parts > 1:
+            yield from self._exec_join_partitioned(
+                node, parts, left_types, right_types
+            )
+            return
+        build_pages = list(self.pages(node.right))
         if not build_pages:
             build_pages = [_empty_page(right_types)]
         build_all = concat_all(build_pages)
@@ -677,14 +962,61 @@ class Executor:
         # __init__); capacity is a static upper bound on rows
         build = compact_page(build_all, _next_pow2(build_all.capacity))
         self._account_page(build)  # the query's largest materialization
+        yield from self._join_pass(
+            node, build, self.pages(node.left), left_types
+        )
 
+    def _exec_join_partitioned(
+        self, node: P.HashJoin, parts: int, left_types, right_types
+    ) -> Iterator[Page]:
+        """Grace-style partition-wise join: P passes, each streaming both
+        sides filtered to hash(key) % P == p, so the build materialization
+        is ~1/P of the single-pass size. Skewed partitions raise the
+        deferred overflow flag and the query retries on the boosted
+        capacity ladder (same escape as every capacity decision here)."""
+        self.spill_partitions_used = max(self.spill_partitions_used, parts)
+        semi = node.join_type in ("semi", "anti")
+        bfilter = self._partition_filter(node.right_keys, parts,
+                                         keep_nulls=semi)
+        pfilter = self._partition_filter(node.left_keys, parts)
+        for p in range(parts):
+            pj = jnp.uint64(p)
+            build_pages = []
+            for pg in self.pages(node.right):
+                f = bfilter(pg, pj)
+                # compact each filtered build page to ~pg/parts before the
+                # concat — this is where the memory actually shrinks
+                pc = min(
+                    _next_pow2(
+                        max(pg.capacity // parts * 2, 1024)
+                        * self._capacity_boost
+                    ),
+                    _next_pow2(pg.capacity),
+                )
+                self._pending_overflow.append(f.num_rows() > pc)
+                build_pages.append(compact_page(f, pc))
+            if not build_pages:
+                build_pages = [_empty_page(right_types)]
+            build_all = concat_all(build_pages)
+            build = compact_page(build_all, _next_pow2(build_all.capacity))
+            self._account_page(build)
+            probe_pages = (
+                pfilter(pg, pj) for pg in self.pages(node.left)
+            )
+            yield from self._join_pass(node, build, probe_pages,
+                                       left_types)
+
+    def _join_pass(
+        self, node: P.HashJoin, build: Page, probe_pages, left_types
+    ) -> Iterator[Page]:
+        """One build+probe pass (the whole join unless partitioned)."""
         if node.join_type in ("semi", "anti"):
             fn = self._jit(
                 ("semi", node, build.capacity),
                 functools.partial(_semi_join_page, node.left_keys,
                                   node.right_keys),
             )
-            for page in self.pages(node.left):
+            for page in probe_pages:
                 yield fn(page, build)
             return
 
@@ -703,7 +1035,7 @@ class Executor:
         # dictionary signature, built once each (HashBuilderOperator
         # analog; one signature in the common case)
         indexes: Dict = {}
-        for page in self.pages(node.left):
+        for page in probe_pages:
             sig = tuple(
                 page.block(c).dictionary for c in node.left_keys
             )
@@ -717,12 +1049,16 @@ class Executor:
                 )(page, build)
             index = indexes[sig]
             # probe-relative sizing (many-to-one joins dominate), with a
-            # bounded build term for small-probe fan-out joins; anything
-            # beyond overflows the deferred flag and re-runs on the
-            # boosted ladder (up to 4^5 x)
+            # build term for small-probe fan-out joins, clamped so the 2x
+            # term cannot COMPOUND down a join chain (each join's output
+            # page is the next probe's input; Q17's 7-join pipeline would
+            # double 262k -> 4.2M and cross the >=4M-row axon kernel
+            # fault line). Real fan-out beyond the clamp lands on the
+            # overflow-retry ladder (up to 4^5 x).
             oc = page.capacity * 2
             if page.capacity <= 1 << 16:
-                oc = max(oc, min(build.capacity, 1 << 22))
+                oc = max(oc, build.capacity)
+            oc = min(oc, max(4 * self.page_rows, 1 << 19))
             oc = _next_pow2(max(oc, 8192) * self._capacity_boost)
             out, matched, overflow = probe_fn(page, build, index, oc)
             self._pending_overflow.append(overflow)
@@ -853,6 +1189,38 @@ def _attach_dictionary(block: Block, dic) -> Block:
     )
 
 
+def _mark_distinct_page(mark_channel_sets, page: Page, cap, max_iters):
+    """Append first-occurrence marks per key set (MarkDistinctOperator):
+    group ids over the key set, then scatter True at each group's
+    representative row."""
+    blocks: List[Block] = []
+    overflow = jnp.zeros((), dtype=jnp.bool_)
+    for chans in mark_channel_sets:
+        groups = _group_ids(chans, page, cap, max_iters)
+        idx = jnp.where(
+            groups.group_valid, groups.rep_index, page.capacity
+        )
+        mark = jnp.zeros((page.capacity,), dtype=jnp.bool_)
+        mark = mark.at[idx].set(True, mode="drop")
+        blocks.append(Block(data=mark, type=T.BOOLEAN, nulls=None))
+        overflow = overflow | groups.overflow
+    return (
+        Page(blocks=page.blocks + tuple(blocks), valid=page.valid),
+        overflow,
+    )
+
+
+def _apply_agg_mask(spec, page: Page, blk: Optional[Block]):
+    """Per-aggregate mask (AggSpec.mask): unmarked rows contribute
+    nothing — expressed as null inputs, which every accumulator skips."""
+    if spec.mask is None or blk is None:
+        return blk
+    inv = ~page.block(spec.mask).data
+    nulls = inv if blk.nulls is None else (blk.nulls | inv)
+    return Block(data=blk.data, type=blk.type, nulls=nulls,
+                 dictionary=blk.dictionary)
+
+
 def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
                       cap: int, max_iters: int = 64):
     groups = _group_ids(group_channels, page, cap, max_iters)
@@ -866,6 +1234,7 @@ def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
     state_blocks: List[Block] = []
     for spec, layout in zip(aggregates, layouts):
         blk = None if spec.channel is None else page.block(spec.channel)
+        blk = _apply_agg_mask(spec, page, blk)
         for st in layout:
             vals, out_nulls, dic = _state_reduce(
                 st, blk, st.input_kind, True,
@@ -879,6 +1248,43 @@ def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
             )
     out = Page(
         blocks=keys_page.blocks + tuple(state_blocks),
+        valid=groups.group_valid,
+    )
+    return out, groups.overflow
+
+
+def _merge_partials_page(aggregates, layouts, nkeys, merged: Page,
+                         cap: int, max_iters: int = 64):
+    """Merge partial-state pages into one partial-state page (group by
+    keys, merge_kind reductions, NO finalize) — the incremental fold that
+    keeps aggregation memory bounded (reference: InMemoryHashAggregation-
+    Builder flushing partial results under memory pressure)."""
+    key_channels = tuple(range(nkeys))
+    groups = _group_ids(key_channels, merged, cap, max_iters)
+    out_cap = groups.group_valid.shape[0]
+    keys_page = gather_rows(
+        merged.select_channels(key_channels),
+        groups.rep_index,
+        groups.group_valid,
+    )
+    out_blocks: List[Block] = []
+    ch = nkeys
+    for spec, layout in zip(aggregates, layouts):
+        for st in layout:
+            blk = merged.block(ch)
+            ch += 1
+            vals, out_nulls, dic = _state_reduce(
+                st, blk, st.merge_kind, False,
+                lambda data, nulls, k=st.merge_kind: A.aggregate(
+                    groups, k, out_cap, data, nulls
+                ),
+            )
+            out_blocks.append(
+                Block(data=vals, type=st.type, nulls=out_nulls,
+                      dictionary=dic)
+            )
+    out = Page(
+        blocks=keys_page.blocks + tuple(out_blocks),
         valid=groups.group_valid,
     )
     return out, groups.overflow
@@ -928,6 +1334,7 @@ def _partial_global_agg(aggregates, layouts, page: Page) -> Page:
     blocks = []
     for spec, layout in zip(aggregates, layouts):
         blk = None if spec.channel is None else page.block(spec.channel)
+        blk = _apply_agg_mask(spec, page, blk)
         for st in layout:
             vals, is_null, dic = _state_reduce(
                 st, blk, st.input_kind, True,

@@ -27,11 +27,19 @@ class PhysicalNode:
 @dataclasses.dataclass(frozen=True)
 class TableScan(PhysicalNode):
     """Leaf: stream pages of selected columns from a connector table
-    (reference: operator/TableScanOperator.java + ConnectorPageSource)."""
+    (reference: operator/TableScanOperator.java + ConnectorPageSource).
+
+    constraint is the pushed-down TupleDomain analog: conjunctive closed
+    integer ranges ((column, lo, hi), ...) with None for an open bound —
+    advisory split pruning only, the residual Filter above still applies
+    (reference: spi/predicate/TupleDomain + ConnectorSplitManager
+    pushdown)."""
 
     catalog: str
     table: str
     columns: Tuple[str, ...]
+    constraint: Optional[Tuple[Tuple[str, Optional[int], Optional[int]],
+                               ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,11 +76,31 @@ class AggSpec:
     """One aggregate call (reference: AggregationNode.Aggregation).
 
     function: sum | count | count_star | min | max | avg | any | bool_or |
-    bool_and. channel: input channel (None for count_star).
-    """
+    bool_and | the variance family. channel: input channel (None for
+    count_star). mask: optional boolean channel — rows where the mask is
+    false contribute nothing to THIS aggregate (reference:
+    AggregationNode's per-aggregate mask symbol fed by MarkDistinct; the
+    mechanism behind mixed DISTINCT aggregates)."""
 
     function: str
     channel: Optional[int] = None
+    mask: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MarkDistinct(PhysicalNode):
+    """Append one boolean channel per key-set marking the first occurrence
+    of each distinct key combination (reference:
+    operator/MarkDistinctOperator + plan/MarkDistinctNode). Output
+    channels: all source channels, then one mark per entry of
+    mark_channel_sets. The TPU shape: group-id computation over the key
+    set, then a scatter of True at each group's representative row."""
+
+    source: PhysicalNode
+    mark_channel_sets: Tuple[Tuple[int, ...], ...]
+
+    def children(self):
+        return (self.source,)
 
 
 @dataclasses.dataclass(frozen=True)

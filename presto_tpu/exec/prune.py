@@ -63,6 +63,9 @@ def _channel_count(node: P.PhysicalNode, counts: Dict) -> int:
         n = _channel_count(node.sources[0], counts)
     elif isinstance(node, P.Window):
         n = _channel_count(node.source, counts) + len(node.functions)
+    elif isinstance(node, P.MarkDistinct):
+        n = _channel_count(node.source, counts) + len(
+            node.mark_channel_sets)
     elif isinstance(node, (P.Filter, P.Sort, P.TopN, P.Limit, P.Output)):
         n = _channel_count(node.children()[0], counts)
     else:
@@ -111,6 +114,10 @@ def output_types(node: P.PhysicalNode, catalogs: Dict) -> List[T.SqlType]:
             in_t = None if fn.arg_channel is None else src[fn.arg_channel]
             out.append(W.result_type(fn, in_t))
         return out
+    if isinstance(node, P.MarkDistinct):
+        return output_types(node.source, catalogs) + [
+            T.BOOLEAN for _ in node.mark_channel_sets
+        ]
     if isinstance(node, (P.Filter, P.Sort, P.TopN, P.Limit, P.Output)):
         return output_types(node.children()[0], catalogs)
     raise TypeError(f"unknown node: {node!r}")
@@ -171,6 +178,8 @@ def _prune(node: P.PhysicalNode, needed: Set[int], ctx: Dict):
             ch = node.aggregates[i].channel
             if ch is not None:
                 child_needed.add(ch)
+            if node.aggregates[i].mask is not None:
+                child_needed.add(node.aggregates[i].mask)
         src, m = _prune(node.source, child_needed, ctx)
         groups = tuple(m[c] for c in node.group_channels)
         aggs = tuple(
@@ -178,6 +187,8 @@ def _prune(node: P.PhysicalNode, needed: Set[int], ctx: Dict):
                 node.aggregates[i].function,
                 None if node.aggregates[i].channel is None
                 else m[node.aggregates[i].channel],
+                None if node.aggregates[i].mask is None
+                else m[node.aggregates[i].mask],
             )
             for i in keep_aggs
         )
@@ -317,6 +328,28 @@ def _prune(node: P.PhysicalNode, needed: Set[int], ctx: Dict):
         new_nsrc = len(m)
         mapping = dict(m)
         for out_pos, i in enumerate(keep_fns):
+            mapping[nsrc + i] = new_nsrc + out_pos
+        return new_node, mapping
+    if isinstance(node, P.MarkDistinct):
+        nsrc = _channel_count(node.source, counts)
+        keep_marks = sorted(
+            i for i in range(len(node.mark_channel_sets))
+            if (nsrc + i) in needed
+        )
+        child_needed = {c for c in needed if c < nsrc}
+        for i in keep_marks:
+            child_needed.update(node.mark_channel_sets[i])
+        src, m = _prune(node.source, child_needed, ctx)
+        new_node = P.MarkDistinct(
+            src,
+            tuple(
+                tuple(m[c] for c in node.mark_channel_sets[i])
+                for i in keep_marks
+            ),
+        )
+        new_nsrc = len(m)
+        mapping = dict(m)
+        for out_pos, i in enumerate(keep_marks):
             mapping[nsrc + i] = new_nsrc + out_pos
         return new_node, mapping
     raise TypeError(f"unknown node: {node!r}")

@@ -1,0 +1,148 @@
+"""Plugin SPI: connectors + user-defined scalar functions.
+
+Reference: presto-spi spi/Plugin.java — a plugin contributes
+ConnectorFactories, functions (@ScalarFunction classes), types, event
+listeners; presto-main's PluginManager installs them into the engine
+registries at startup (with classloader isolation, which Python does not
+need). The TPU translation: a Plugin contributes Connector instances,
+EventListeners, and scalar functions that register into the expression
+registry (presto_tpu/expr/functions.py) — from there they resolve, type-
+check, and jit-compile exactly like builtins (the @ScalarFunction ->
+FunctionRegistry -> compiled-call path, SURVEY §4.4).
+
+UDF authoring surface: `scalar_function` wraps an elementwise array
+function (operating on the `xp` namespace — numpy or jax.numpy, so the
+same UDF runs in both the compiled and oracle evaluators) with a fixed
+signature; generic NULL propagation is applied by the evaluator like any
+default-null-convention scalar.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import Connector
+from presto_tpu.events import EventListener
+from presto_tpu.expr import functions as F
+from presto_tpu.expr.values import Val
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarFunctionSpec:
+    """One UDF: fixed argument types, result type, elementwise impl
+    fn(xp, *data_arrays) -> data_array (reference: one @ScalarFunction
+    method signature)."""
+
+    name: str
+    arg_types: Sequence[T.SqlType]
+    result_type: T.SqlType
+    fn: Callable
+    propagate_nulls: bool = True
+
+
+def scalar_function(
+    name: str,
+    arg_types: Sequence[T.SqlType],
+    result_type: T.SqlType,
+    propagate_nulls: bool = True,
+):
+    """Decorator form:
+
+        @scalar_function("clamp01", [T.DOUBLE], T.DOUBLE)
+        def clamp01(xp, x):
+            return xp.clip(x, 0.0, 1.0)
+    """
+
+    def deco(fn):
+        spec = ScalarFunctionSpec(
+            name, tuple(arg_types), result_type, fn, propagate_nulls
+        )
+        fn.__presto_tpu_spec__ = spec
+        return fn
+
+    return deco
+
+
+class Plugin:
+    """Reference: spi/Plugin.java. Override any subset."""
+
+    name: str = "plugin"
+
+    def connectors(self) -> Dict[str, Connector]:
+        """catalog name -> Connector instance (reference:
+        getConnectorFactories; ours are instances, config-free)."""
+        return {}
+
+    def scalar_functions(self) -> List[ScalarFunctionSpec]:
+        """UDFs to install (reference: getFunctions). Entries may be
+        ScalarFunctionSpec or functions decorated with
+        @scalar_function."""
+        return []
+
+    def event_listeners(self) -> List[EventListener]:
+        """Reference: getEventListenerFactories."""
+        return []
+
+
+def _as_spec(item) -> ScalarFunctionSpec:
+    if isinstance(item, ScalarFunctionSpec):
+        return item
+    spec = getattr(item, "__presto_tpu_spec__", None)
+    if spec is None:
+        raise TypeError(
+            f"not a scalar function spec: {item!r} (use "
+            f"@scalar_function or ScalarFunctionSpec)"
+        )
+    return spec
+
+
+def _install_function(spec: ScalarFunctionSpec) -> None:
+    want = tuple(spec.arg_types)
+
+    def resolve(args: List[T.SqlType]) -> T.SqlType:
+        if len(args) != len(want):
+            raise TypeError(
+                f"{spec.name}: expected {len(want)} args, got {len(args)}"
+            )
+        for got, exp in zip(args, want):
+            if T.common_super_type(got, exp) is None:
+                raise TypeError(
+                    f"{spec.name}: argument {got} not coercible to {exp}"
+                )
+        return spec.result_type
+
+    def impl(ctx, rt, vals: List[Val]) -> Val:
+        from presto_tpu.expr.values import cast_data
+
+        # coerce arguments to the declared signature (the registry's
+        # resolve proved coercibility; e.g. a decimal literal passed to a
+        # DOUBLE parameter arrives as unscaled ints and must be scaled)
+        datas = []
+        for v, exp in zip(vals, want):
+            if v.type == exp:
+                datas.append(v.data)
+            else:
+                d, _ = cast_data(ctx.xp, v, exp, ctx.capacity)
+                datas.append(d)
+        data = spec.fn(ctx.xp, *datas)
+        return Val(data, None, rt)
+
+    F.register(spec.name, resolve, impl,
+               propagate_nulls=spec.propagate_nulls)
+
+
+def install(plugin: Plugin, catalogs: Optional[Dict] = None) -> Plugin:
+    """Install a plugin into the process-wide registries; when a catalogs
+    dict is passed (LocalRunner/PrestoTpuServer wiring), the plugin's
+    connectors are added to it (reference: PluginManager.installPlugin +
+    ConnectorManager.createConnection)."""
+    for item in plugin.scalar_functions():
+        _install_function(_as_spec(item))
+    if catalogs is not None:
+        for name, conn in plugin.connectors().items():
+            if name in catalogs:
+                raise ValueError(f"catalog already exists: {name}")
+            catalogs[name] = conn
+    return plugin

@@ -40,8 +40,15 @@ class LocalRunner:
         mesh=None,
         dist_options: Optional[Dict] = None,
         session=None,
+        plugins=(),
     ):
-        self.catalogs = catalogs
+        self.catalogs = dict(catalogs)
+        if plugins:
+            from presto_tpu.plugin import install
+
+            for p in plugins:
+                install(p, self.catalogs)
+        catalogs = self.catalogs
         self.default_catalog = default_catalog
         self.mesh = mesh
         self.dist_options = dist_options or {}
@@ -129,6 +136,8 @@ class LocalRunner:
         )
         limit = int(self.session.get("query_max_memory_bytes"))
         self.executor.max_memory_bytes = limit or None
+        spill = int(self.session.get("spill_threshold_bytes"))
+        self.executor.spill_bytes = spill or None
         if isinstance(stmt, N.SetSession):
             self.session.set(stmt.name, stmt.value)
             return QueryResult([], [], update_type="SET SESSION")
@@ -180,8 +189,11 @@ class LocalRunner:
         return QueryResult(list(names or []), rows, column_types=types)
 
     def _plan_statement_query(self, query: N.Query) -> P.Output:
+        from presto_tpu.exec.pushdown import push_scan_constraints
+
         out = self._planner().plan_statement(query)
         out = prune_plan(out, self.catalogs)
+        out = push_scan_constraints(out)
         if self.mesh is not None:
             from presto_tpu.dist.fragmenter import add_exchanges
 
@@ -226,6 +238,9 @@ def explain_text(node: P.PhysicalNode, indent: int = 0, stats=None) -> str:
                 f"build={list(node.right_keys)}]")
     elif isinstance(node, P.CrossJoin):
         line = f"{pad}CrossJoin"
+    elif isinstance(node, P.MarkDistinct):
+        line = (f"{pad}MarkDistinct"
+                f"[{[list(s) for s in node.mark_channel_sets]}]")
     elif isinstance(node, P.TopN):
         line = f"{pad}TopN[{node.limit} by {list(node.keys)}]"
     elif isinstance(node, P.Sort):

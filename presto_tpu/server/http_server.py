@@ -64,20 +64,42 @@ class QueryManager:
     """Reference: execution/SqlQueryManager.java — registry + lifecycle
     (QUEUED -> RUNNING -> FINISHED/FAILED/CANCELED)."""
 
-    def __init__(self, runner_factory):
+    def __init__(self, runner_factory, listeners=(),
+                 resource_groups=None):
         self._runner_factory = runner_factory
         self._queries: Dict[str, _Query] = {}
         self._seq = 0
         self._lock = threading.Lock()
         self._exec_lock = threading.Lock()  # one query on the device
+        self.listeners = list(listeners)
+        # admission control (reference: resourceGroups/*; None = admit
+        # everything, the pre-RG behavior)
+        self.resource_groups = resource_groups
+        # /metrics counters (reference: airlift stats -> JMX; ours is a
+        # Prometheus text endpoint, SURVEY §6.5 build mapping)
+        self.completed_by_state: Dict[str, int] = {}
+        self.rows_returned_total = 0
+        self.query_wall_ms_total = 0
 
     def submit(self, sql: str, session: Session) -> _Query:
+        from presto_tpu import events as E
+
+        group = None
+        if self.resource_groups is not None:
+            # raises QueryQueueFullError before the query exists
+            # (reference: admission happens ahead of planning)
+            group = self.resource_groups.admit(session.user)
         with self._lock:
             self._seq += 1
             qid = time.strftime("%Y%m%d_%H%M%S") + \
                 f"_{self._seq:05d}_{uuid.uuid4().hex[:5]}"
             q = _Query(qid, sql, session)
+            q.resource_group = group
             self._queries[qid] = q
+        E.dispatch(self.listeners, "query_created", E.QueryCreatedEvent(
+            query_id=q.id, sql=sql, user=session.user,
+            create_time=q.created,
+        ))
         threading.Thread(
             target=self._run, args=(q,), daemon=True
         ).start()
@@ -98,8 +120,30 @@ class QueryManager:
         return True
 
     def _run(self, q: _Query) -> None:
+        group = getattr(q, "resource_group", None)
+        if group is not None:
+            if q.cancelled:
+                self.resource_groups.cancel_queued(group)
+                self._record_completion(q)
+                return
+            if not self.resource_groups.acquire(
+                group, should_abort=lambda: q.cancelled
+            ):
+                # canceled while queued: acquire released the queue slot
+                self._record_completion(q)
+                return
+        try:
+            self._run_locked(q)
+        finally:
+            if group is not None:
+                self.resource_groups.release(group)
+
+    def _run_locked(self, q: _Query) -> None:
         with self._exec_lock:
             if q.cancelled:
+                # canceled while queued: still record completion so event
+                # listeners and /metrics see every created query finish
+                self._record_completion(q)
                 return
             q.state = "RUNNING"
             try:
@@ -136,6 +180,56 @@ class QueryManager:
                 if q.finished_at is None:
                     q.finished_at = time.time()
                 q.done.set()
+                self._record_completion(q)
+
+    def _record_completion(self, q: _Query) -> None:
+        from presto_tpu import events as E
+
+        with self._lock:
+            self.completed_by_state[q.state] = (
+                self.completed_by_state.get(q.state, 0) + 1
+            )
+            self.rows_returned_total += len(q.rows)
+            self.query_wall_ms_total += q.info()["elapsedTimeMillis"]
+        E.dispatch(
+            self.listeners, "query_completed", E.QueryCompletedEvent(
+                query_id=q.id, sql=q.sql, user=q.session.user,
+                state=q.state, create_time=q.created,
+                end_time=q.finished_at or time.time(),
+                wall_ms=q.info()["elapsedTimeMillis"],
+                row_count=len(q.rows),
+                error_name=(q.error or {}).get("errorName"),
+                error_message=(q.error or {}).get("message"),
+            )
+        )
+
+    def metrics_text(self, uptime: float) -> str:
+        """Prometheus text exposition (reference role: JMX beans +
+        presto-jmx; a /metrics scrape replaces the MBean server)."""
+        lines = [
+            "# TYPE presto_tpu_uptime_seconds gauge",
+            f"presto_tpu_uptime_seconds {uptime:.3f}",
+            "# TYPE presto_tpu_queries_total counter",
+        ]
+        with self._lock:
+            for state, n in sorted(self.completed_by_state.items()):
+                lines.append(
+                    f'presto_tpu_queries_total{{state="{state}"}} {n}'
+                )
+            running = sum(
+                1 for q in self._queries.values() if not q.done.is_set()
+            )
+            lines += [
+                "# TYPE presto_tpu_queries_running gauge",
+                f"presto_tpu_queries_running {running}",
+                "# TYPE presto_tpu_rows_returned_total counter",
+                f"presto_tpu_rows_returned_total "
+                f"{self.rows_returned_total}",
+                "# TYPE presto_tpu_query_wall_ms_total counter",
+                f"presto_tpu_query_wall_ms_total "
+                f"{self.query_wall_ms_total}",
+            ]
+        return "\n".join(lines) + "\n"
 
 
 def _json_row(row: tuple) -> list:
@@ -193,7 +287,19 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", 0))
         sql = self.rfile.read(length).decode()
-        q = self.app.manager.submit(sql, self._session_from_headers())
+        from presto_tpu.server.resource_groups import QueryQueueFullError
+
+        try:
+            q = self.app.manager.submit(
+                sql, self._session_from_headers()
+            )
+        except QueryQueueFullError as e:
+            self._send_json({
+                "error": {"message": str(e),
+                          "errorName": "QUERY_QUEUE_FULL"},
+                "stats": {"state": "FAILED"},
+            }, 429)
+            return
         # brief wait so fast statements (SET SESSION, DDL) answer in one
         # round trip with their headers (reference: ~100ms initial wait)
         q.done.wait(timeout=0.5)
@@ -232,6 +338,27 @@ class _Handler(BaseHTTPRequestHandler):
                 "uptime": time.time() - self.app.started,
                 "backend": self.app.backend_name,
             })
+            return
+        if parts == ["v1", "resourceGroup"]:
+            rg = self.app.manager.resource_groups
+            self._send_json(rg.snapshot() if rg else [])
+            return
+        if parts == ["v1", "node"]:
+            # reference: /v1/node lists cluster members with health
+            # (DiscoveryNodeManager + HeartbeatFailureDetector view)
+            det = self.app.failure_detector
+            self._send_json(det.snapshot() if det else [])
+            return
+        if parts == ["metrics"]:
+            body = self.app.manager.metrics_text(
+                time.time() - self.app.started
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         self._send_json({"error": "not found"}, 404)
 
@@ -286,12 +413,30 @@ class PrestoTpuServer:
         port: int = 8080,
         mesh=None,
         page_rows: int = 1 << 18,
+        event_listeners=(),
+        peer_uris=(),
+        plugins=(),
+        resource_groups=None,
     ):
         from presto_tpu.runner import LocalRunner
 
+        event_listeners = list(event_listeners)
+        for p in plugins:
+            event_listeners.extend(p.event_listeners())
         self.catalogs = catalogs
         self.port = port
         self.started = time.time()
+        # peer health monitoring (reference: HeartbeatFailureDetector
+        # over discovered nodes; ours watches configured peer slices)
+        self.failure_detector = None
+        if peer_uris:
+            from presto_tpu.server.heartbeat import (
+                HeartbeatFailureDetector,
+            )
+
+            self.failure_detector = HeartbeatFailureDetector(
+                list(peer_uris)
+            )
         try:
             import jax
 
@@ -302,14 +447,16 @@ class PrestoTpuServer:
         # one engine, re-sessioned per query (plans/jit caches persist)
         self._runner = LocalRunner(
             catalogs, default_catalog=default_catalog,
-            page_rows=page_rows, mesh=mesh,
+            page_rows=page_rows, mesh=mesh, plugins=plugins,
         )
 
         def runner_factory(session: Session):
             self._runner.session = session
             return self._runner
 
-        self.manager = QueryManager(runner_factory)
+        self.manager = QueryManager(runner_factory,
+                                    listeners=event_listeners,
+                                    resource_groups=resource_groups)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -321,9 +468,13 @@ class PrestoTpuServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        if self.failure_detector:
+            self.failure_detector.start()
         return self.port
 
     def stop(self) -> None:
+        if self.failure_detector:
+            self.failure_detector.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

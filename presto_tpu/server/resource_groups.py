@@ -1,0 +1,109 @@
+"""Resource groups: admission control for inter-query concurrency.
+
+Reference: presto-main resourceGroups/* (InternalResourceGroupManager,
+ResourceGroupSpec) — hierarchical groups with hard_concurrency_limit and
+max_queued per group, selected per query by user/source; queries beyond
+the queue limit are rejected with QUERY_QUEUE_FULL. The TPU engine keeps
+the flat version (SURVEY §3.3: "simple admission queue first; full RG
+later"): named groups with concurrency + queue limits and user-pattern
+selectors. The device itself serializes execution (one query on the
+chip), so hard_concurrency here bounds how many queries may be
+in-flight (RUNNING or waiting on the device lock) rather than how many
+execute simultaneously.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceGroupSpec:
+    """One group (reference: ResourceGroupSpec in resource-group JSON
+    config): selector is a regex over the session user."""
+
+    name: str
+    user_pattern: str = ".*"
+    hard_concurrency: int = 1
+    max_queued: int = 100
+
+
+class QueryQueueFullError(RuntimeError):
+    """Reference: QUERY_QUEUE_FULL error code."""
+
+
+class ResourceGroupManager:
+    """Admission: pick the first matching group; reject when its queue is
+    full; callers acquire before running and release after."""
+
+    def __init__(self, groups: Optional[List[ResourceGroupSpec]] = None):
+        self.groups = list(groups or [ResourceGroupSpec("global")])
+        self._lock = threading.Lock()
+        self._running = {g.name: 0 for g in self.groups}
+        self._queued = {g.name: 0 for g in self.groups}
+        self._cv = threading.Condition(self._lock)
+
+    def select(self, user: str) -> ResourceGroupSpec:
+        for g in self.groups:
+            if re.fullmatch(g.user_pattern, user or ""):
+                return g
+        raise QueryQueueFullError(
+            f"no resource group matches user {user!r}"
+        )
+
+    def admit(self, user: str) -> ResourceGroupSpec:
+        """Admission check at submit time: raises QueryQueueFullError when
+        the group's queue is at capacity (reference: the coordinator
+        rejects before planning)."""
+        g = self.select(user)
+        with self._lock:
+            if self._queued[g.name] >= g.max_queued:
+                raise QueryQueueFullError(
+                    f"resource group {g.name!r} queue is full "
+                    f"({g.max_queued})"
+                )
+            self._queued[g.name] += 1
+        return g
+
+    def acquire(self, group: ResourceGroupSpec, should_abort=None) -> bool:
+        """Block until the group has a concurrency slot (QUEUED ->
+        RUNNING transition). should_abort() is polled so a query
+        canceled while queued releases its queue slot instead of
+        blocking forever and then consuming a run slot; returns False
+        when aborted (queue slot already released)."""
+        with self._cv:
+            while self._running[group.name] >= group.hard_concurrency:
+                if should_abort is not None and should_abort():
+                    self._queued[group.name] -= 1
+                    return False
+                self._cv.wait(timeout=0.05)
+            self._queued[group.name] -= 1
+            self._running[group.name] += 1
+            return True
+
+    def release(self, group: ResourceGroupSpec) -> None:
+        with self._cv:
+            self._running[group.name] -= 1
+            self._cv.notify_all()
+
+    def cancel_queued(self, group: ResourceGroupSpec) -> None:
+        """A query canceled before acquire gives its queue slot back."""
+        with self._lock:
+            self._queued[group.name] -= 1
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {
+                    "name": g.name,
+                    "userPattern": g.user_pattern,
+                    "hardConcurrency": g.hard_concurrency,
+                    "maxQueued": g.max_queued,
+                    "running": self._running[g.name],
+                    "queued": self._queued[g.name],
+                }
+                for g in self.groups
+            ]

@@ -90,6 +90,13 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "devices used for repartitioned stages (0 = whole mesh)",
             int, 0,
         ),
+        PropertyMetadata(
+            "spill_threshold_bytes",
+            "joins/aggregations whose state estimate exceeds this many "
+            "bytes run in hash-partition passes (grace-style spill; 0 = "
+            "disabled; reference: spill-enabled + revocable memory)",
+            int, 0,
+        ),
     ]
 }
 

@@ -37,6 +37,7 @@ import datetime
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from presto_tpu import types as T
+from presto_tpu.exec import agg_states as AS
 from presto_tpu.exec import plan as P
 from presto_tpu.expr import ir
 from presto_tpu.expr import functions as F
@@ -44,7 +45,18 @@ from presto_tpu.ops.sort import SortKey
 from presto_tpu.sql import ast_nodes as N
 
 AGG_FUNCTIONS = {"sum", "count", "avg", "min", "max", "any_value",
-                 "bool_or", "bool_and"}
+                 "bool_or", "bool_and",
+                 "stddev", "stddev_samp", "stddev_pop",
+                 "variance", "var_samp", "var_pop"}
+
+# SQL-surface aliases -> agg_states layout names (reference:
+# FunctionRegistry registers stddev as an alias of stddev_samp)
+_AGG_CANON = {"stddev": "stddev_samp", "variance": "var_samp",
+              "any_value": "any"}
+
+
+def _canon_agg(name: str) -> str:
+    return _AGG_CANON.get(name, name)
 
 _EPOCH = datetime.date(1970, 1, 1)
 
@@ -1249,10 +1261,7 @@ class Planner:
                 uniq_aggs.append(a)
 
         distinct_aggs = [a for a in uniq_aggs if a.distinct]
-        if distinct_aggs and len(uniq_aggs) != len(distinct_aggs):
-            raise PlanningError(
-                "mixing DISTINCT and plain aggregates is not supported yet"
-            )
+        plain_aggs = [a for a in uniq_aggs if not a.distinct]
 
         # pre-projection: group keys then agg arguments
         pre_exprs: List[ir.RowExpression] = list(group_irs)
@@ -1264,6 +1273,9 @@ class Planner:
                 agg_arg_ir.append(None)
                 continue
             e = _decimal_safe(tr.translate(a.args[0]))
+            if (_canon_agg(a.name) in AS.VARIANCE_FNS
+                    and e.type != T.DOUBLE):
+                e = ir.cast(e, T.DOUBLE)
             if e in pre_exprs:
                 agg_arg_ch.append(pre_exprs.index(e))
             else:
@@ -1275,8 +1287,12 @@ class Planner:
                            pre_fields)
 
         nkeys = len(group_irs)
-        if distinct_aggs:
-            # two-level: dedupe (keys + args), then count/sum over dedup
+        d_channels = sorted({
+            ch for a, ch in zip(uniq_aggs, agg_arg_ch) if a.distinct
+        })
+        if distinct_aggs and not plain_aggs and len(d_channels) == 1:
+            # two-level: dedupe (keys + the one arg), then aggregate over
+            # the dedup — exchange-friendly, stays fully sharded
             dedup_channels = tuple(range(len(pre_exprs)))
             dedup = P.Aggregation(
                 pre.node, dedup_channels, (),
@@ -1284,16 +1300,42 @@ class Planner:
             )
             specs = []
             for a, ch in zip(uniq_aggs, agg_arg_ch):
-                fn = "count" if a.name == "count" else a.name
+                fn = "count" if a.name == "count" else _canon_agg(a.name)
                 specs.append(P.AggSpec(fn, ch))
             agg_node = P.Aggregation(
                 dedup, tuple(range(nkeys)), tuple(specs),
                 capacity=_agg_capacity(dedup, self.catalogs),
             )
+        elif distinct_aggs:
+            # general case — mixed DISTINCT/plain or several distinct
+            # argument columns: MarkDistinct appends a first-occurrence
+            # mark per (group keys, arg) set, and each distinct aggregate
+            # reads its input through its mask (reference:
+            # plan/MarkDistinctNode + AggregationNode mask symbols)
+            mark_sets = tuple(
+                tuple(range(nkeys)) + (c,) for c in d_channels
+            )
+            mark_of = {
+                c: len(pre_exprs) + i for i, c in enumerate(d_channels)
+            }
+            md = P.MarkDistinct(pre.node, mark_sets)
+            specs = []
+            for a, ch in zip(uniq_aggs, agg_arg_ch):
+                fn = _canon_agg(a.name)
+                if a.is_star or (fn == "count" and ch is None):
+                    specs.append(P.AggSpec("count_star", None))
+                elif a.distinct:
+                    specs.append(P.AggSpec(fn, ch, mask=mark_of[ch]))
+                else:
+                    specs.append(P.AggSpec(fn, ch))
+            agg_node = P.Aggregation(
+                md, tuple(range(nkeys)), tuple(specs),
+                capacity=_agg_capacity(pre.node, self.catalogs),
+            )
         else:
             specs = []
             for a, ch in zip(uniq_aggs, agg_arg_ch):
-                fn = a.name
+                fn = _canon_agg(a.name)
                 if a.is_star or (fn == "count" and ch is None):
                     specs.append(P.AggSpec("count_star", None))
                 else:
@@ -1304,8 +1346,6 @@ class Planner:
             )
 
         # aggregate output fields: keys then one per agg
-        from presto_tpu.exec import agg_states as AS
-
         out_fields: List[Field] = []
         for i, g in enumerate(group_irs):
             nm = None
@@ -1316,7 +1356,7 @@ class Planner:
             elif a.distinct and a.name == "count":
                 out_t = T.BIGINT
             else:
-                out_t = AS.result_type(a.name, e.type)
+                out_t = AS.result_type(_canon_agg(a.name), e.type)
             out_fields.append(Field(None, out_t))
         agg_plan = RelationPlan(agg_node, out_fields)
 

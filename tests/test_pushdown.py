@@ -1,0 +1,201 @@
+"""Predicate pushdown (TupleDomain analog): range extraction from
+filters, generator split pruning via monotonic key inversion, memory
+connector min/max stats pruning. Reference: spi/predicate/TupleDomain +
+ConnectorSplitManager pushdown.
+"""
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.exec import plan as P
+from presto_tpu.exec.pushdown import extract_ranges, push_scan_constraints
+from presto_tpu.expr import ir
+from presto_tpu.runner import LocalRunner
+
+
+def _scan_constraints(plan):
+    out = {}
+
+    def walk(n):
+        if isinstance(n, P.TableScan) and n.constraint:
+            out[n.table] = dict(
+                (c, (lo, hi)) for c, lo, hi in n.constraint
+            )
+        for k in n.children():
+            walk(k)
+
+    walk(plan)
+    return out
+
+
+class TestExtraction:
+    def ref(self, ch=0):
+        return ir.InputRef(ch, T.BIGINT)
+
+    def lit(self, v):
+        return ir.Constant(v, T.BIGINT)
+
+    def test_comparisons_and_flips(self):
+        pred = ir.and_(
+            ir.call("ge", self.ref(), self.lit(10)),
+            ir.call("lt", self.ref(), self.lit(20)),
+            ir.call("gt", self.lit(100), self.ref(1)),  # flipped: #1 < 100
+        )
+        got = extract_ranges(pred, 2)
+        assert got[0] == (10, 19)
+        assert got[1] == (None, 99)
+
+    def test_between_eq_in(self):
+        pred = ir.and_(
+            ir.between(self.ref(), self.lit(5), self.lit(9)),
+            ir.SpecialForm(ir.IN, (
+                self.ref(1), self.lit(3), self.lit(7), self.lit(5),
+            ), T.BOOLEAN),
+            ir.call("eq", self.ref(2), self.lit(42)),
+        )
+        got = extract_ranges(pred, 3)
+        assert got[0] == (5, 9)
+        assert got[1] == (3, 7)
+        assert got[2] == (42, 42)
+
+    def test_non_integer_and_unprovable_ignored(self):
+        pred = ir.and_(
+            ir.call("ge", ir.InputRef(0, T.DOUBLE),
+                    ir.Constant(1.5, T.DOUBLE)),
+            ir.call("eq", self.ref(1), self.ref(0)),  # col-col: no range
+        )
+        assert extract_ranges(pred, 2) == {}
+
+
+class TestGeneratorPruning:
+    @pytest.fixture(scope="class")
+    def conn(self):
+        return TpchConnector(0.01)
+
+    @pytest.fixture(scope="class")
+    def runner(self, conn):
+        return LocalRunner({"tpch": conn}, page_rows=1 << 10)
+
+    def test_plan_carries_constraint(self, runner):
+        plan = runner.plan(
+            "select count(*) from orders where o_orderkey between "
+            "1000 and 2000"
+        )
+        cons = _scan_constraints(plan)
+        assert cons["orders"]["o_orderkey"] == (1000, 2000)
+
+    def test_split_pruning_correct_and_effective(self, conn, runner):
+        # pruned scan must return exactly the unpruned result
+        sql = ("select count(*), sum(o_orderkey) from orders "
+               "where o_orderkey between 1000 and 2000")
+        got = runner.execute(sql).rows
+        # oracle: full scan in python
+        rows = conn.host_rows("orders")
+        keys = [r[0] for r in rows if 1000 <= r[0] <= 2000]
+        assert got == [(len(keys), sum(keys))]
+        # and the connector must actually drop splits
+        all_splits = conn.splits("orders", 1 << 10)
+        pruned = conn.prune_splits(
+            "orders", all_splits, (("o_orderkey", 1000, 2000),)
+        )
+        assert 0 < len(pruned) < len(all_splits)
+
+    def test_lineitem_aligned_pruning(self, conn, runner):
+        sql = ("select count(*) from lineitem "
+               "where l_orderkey <= 512")
+        got = runner.execute(sql).rows[0][0]
+        rows = conn.host_rows("lineitem", target_rows=1 << 16)
+        want = sum(1 for r in rows if r[0] <= 512)
+        assert got == want
+        pruned = conn.prune_splits(
+            "lineitem", conn.splits("lineitem", 1 << 10),
+            (("l_orderkey", None, 512),),
+        )
+        assert len(pruned) < len(conn.splits("lineitem", 1 << 10))
+
+    def test_date_dim_quarter_scan(self):
+        from presto_tpu.connectors.tpcds import TpcdsConnector
+
+        conn = TpcdsConnector(0.005)
+        r = LocalRunner({"tpcds": conn}, default_catalog="tpcds",
+                        page_rows=1 << 10)
+        sql = ("select count(*) from date_dim "
+               "where d_date_sk between 2451911 and 2452000")
+        assert r.execute(sql).rows[0][0] == 90
+        pruned = conn.prune_splits(
+            "date_dim", conn.splits("date_dim", 1 << 10),
+            (("d_date_sk", 2451911, 2452000),),
+        )
+        assert len(pruned) == 1
+
+
+class TestMemoryStatsPruning:
+    def test_min_max_split_pruning(self):
+        mem = MemoryConnector()
+        runner = LocalRunner({"memory": mem}, default_catalog="memory",
+                             page_rows=1 << 8)
+        # sorted values: later splits are prunable for small ranges
+        mem.create_table(
+            "t", ["k", "v"], [T.BIGINT, T.BIGINT],
+            [(i, i * 2) for i in range(4096)],
+        )
+        got = runner.execute(
+            "select count(*), sum(v) from t where k < 100"
+        ).rows
+        assert got == [(100, sum(i * 2 for i in range(100)))]
+        splits = mem.splits("t", 1 << 8)
+        pruned = mem.prune_splits("t", splits, (("k", None, 99),))
+        assert len(pruned) == 1 and len(splits) == 16
+
+    def test_all_null_split_dropped(self):
+        mem = MemoryConnector()
+        mem.create_table(
+            "n", ["k"], [T.BIGINT],
+            [(None,)] * 256 + [(5,)] * 256,
+        )
+        splits = mem.splits("n", 256)
+        pruned = mem.prune_splits("n", splits, (("k", 0, 10),))
+        assert len(pruned) == 1
+        assert pruned[0].start_row == 256
+
+
+class TestUnitSafety:
+    def test_decimal_column_with_integer_literal_not_pruned_wrongly(self):
+        """A bigint literal is in different units than a decimal(p,2)
+        column's unscaled storage; the runtime rescales but split stats
+        cannot — such predicates must extract NO range (pruning skipped)
+        rather than a wrong one."""
+        mem = MemoryConnector()
+        runner = LocalRunner({"memory": mem}, default_catalog="memory",
+                             page_rows=1 << 8)
+        dec = T.DecimalType(10, 2)
+        # values 0.00 .. 40.95 stored as unscaled cents 0..4095
+        mem.create_table(
+            "d", ["x"], [dec], [(i,) for i in range(4096)],
+        )
+        rows = runner.execute(
+            "select count(*) from d where x < 5"
+        ).rows
+        assert rows == [(500,)]  # 0.00..4.99 — nothing wrongly pruned
+        plan = runner.plan("select count(*) from d where x < 5")
+        assert _scan_constraints(plan) == {}  # mixed units: no pushdown
+
+    def test_same_scale_decimal_literal_still_prunes(self):
+        mem = MemoryConnector()
+        runner = LocalRunner({"memory": mem}, default_catalog="memory",
+                             page_rows=1 << 8)
+        dec = T.DecimalType(10, 2)
+        mem.create_table(
+            "d2", ["x"], [dec], [(i,) for i in range(4096)],
+        )
+        # 5.00 parses as decimal(_, 2): same scale, prunable
+        rows = runner.execute(
+            "select count(*) from d2 where x < 5.00"
+        ).rows
+        assert rows == [(500,)]
+        cons = _scan_constraints(
+            runner.plan("select count(*) from d2 where x < 5.00")
+        )
+        assert cons.get("d2", {}).get("x") == (None, 499)

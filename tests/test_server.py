@@ -148,3 +148,162 @@ def test_cli_execute(server, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "r_name" in out and "(2 rows)" in out
+
+
+def test_metrics_endpoint(server, client):
+    # run one query so counters are non-zero, then scrape
+    client.execute("select 1")
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics"
+    ) as resp:
+        assert resp.status == 200
+        assert "text/plain" in resp.headers["Content-Type"]
+        body = resp.read().decode()
+    assert "presto_tpu_uptime_seconds" in body
+    assert 'presto_tpu_queries_total{state="FINISHED"}' in body
+    assert "presto_tpu_rows_returned_total" in body
+
+
+def test_event_listener_spi():
+    """Reference: spi/eventlistener — created/completed events fire with
+    final state; a throwing listener never fails the query."""
+    from presto_tpu.events import EventListener
+
+    seen = {"created": [], "completed": []}
+
+    class Recorder(EventListener):
+        def query_created(self, e):
+            seen["created"].append(e)
+
+        def query_completed(self, e):
+            seen["completed"].append(e)
+
+    class Thrower(EventListener):
+        def query_created(self, e):
+            raise RuntimeError("listener bug")
+
+    srv = PrestoTpuServer(
+        {"tpch": TpchConnector(scale=0.001)}, port=0,
+        event_listeners=[Thrower(), Recorder()],
+    )
+    srv.start()
+    try:
+        c = StatementClient(server=f"http://127.0.0.1:{srv.port}")
+        res = c.execute("select count(*) from nation")
+        assert res.error is None
+        bad = c.execute("select nope from nowhere")
+        assert bad.error is not None
+    finally:
+        srv.stop()
+    assert len(seen["created"]) == 2
+    states = sorted(e.state for e in seen["completed"])
+    assert states == ["FAILED", "FINISHED"]
+    done = [e for e in seen["completed"] if e.state == "FINISHED"][0]
+    assert done.row_count == 1 and done.wall_ms >= 0
+    failed = [e for e in seen["completed"] if e.state == "FAILED"][0]
+    assert failed.error_name
+
+
+def test_heartbeat_failure_detector():
+    """Reference: failureDetector/HeartbeatFailureDetector — a peer goes
+    FAILED after consecutive missed pings and recovers on success."""
+    from presto_tpu.server.heartbeat import HeartbeatFailureDetector
+
+    peer = PrestoTpuServer({"tpch": TpchConnector(scale=0.001)}, port=0)
+    peer.start()
+    uri = f"http://127.0.0.1:{peer.port}"
+    det = HeartbeatFailureDetector([uri], fail_after=2, timeout_s=0.5)
+    det.check_once()
+    assert det.is_alive(uri)
+    assert det.snapshot()[0]["state"] == "ALIVE"
+    peer.stop()
+    det.check_once()
+    assert det.is_alive(uri)  # one miss is not failure
+    det.check_once()
+    assert not det.is_alive(uri)
+    assert det.snapshot()[0]["state"] == "FAILED"
+    # node comes back: first success revives it (reference: rejoin
+    # between queries)
+    peer2 = PrestoTpuServer(
+        {"tpch": TpchConnector(scale=0.001)}, port=peer.port
+    )
+    try:
+        peer2.start()
+        det.check_once()
+        assert det.is_alive(uri)
+    finally:
+        peer2.stop()
+
+
+def test_monitored_server_exposes_node_view():
+    peer = PrestoTpuServer({"tpch": TpchConnector(scale=0.001)}, port=0)
+    peer.start()
+    mon = PrestoTpuServer(
+        {"tpch": TpchConnector(scale=0.001)}, port=0,
+        peer_uris=[f"http://127.0.0.1:{peer.port}"],
+    )
+    mon.start()
+    try:
+        mon.failure_detector.check_once()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{mon.port}/v1/node"
+        ) as resp:
+            nodes = json.loads(resp.read())
+        assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    finally:
+        mon.stop()
+        peer.stop()
+
+
+def test_resource_group_admission():
+    """Reference: resourceGroups/* — queue-full rejection (429 /
+    QUERY_QUEUE_FULL) and per-group running/queued accounting."""
+    import json as _json
+    import threading
+    import urllib.error
+
+    from presto_tpu.server.resource_groups import (
+        ResourceGroupManager,
+        ResourceGroupSpec,
+    )
+
+    rg = ResourceGroupManager([
+        ResourceGroupSpec("tiny", ".*", hard_concurrency=1, max_queued=1),
+    ])
+    srv = PrestoTpuServer(
+        {"tpch": TpchConnector(scale=0.001)}, port=0, resource_groups=rg,
+    )
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        # hold the device with a slowish query, then flood the queue
+        slow_sql = ("select count(*) from lineitem l1, lineitem l2 "
+                    "where l1.l_orderkey = l2.l_orderkey")
+        results = []
+
+        def run_slow():
+            c = StatementClient(server=base)
+            results.append(c.execute(slow_sql))
+
+        threads = [threading.Thread(target=run_slow) for _ in range(3)]
+        for t in threads:
+            t.start()
+        # with concurrency 1 + queue 1, at least one of three concurrent
+        # submissions must be rejected with 429
+        rejected = 0
+        for t in threads:
+            t.join()
+        rejected = sum(
+            1 for r in results
+            if r.error and r.error.get("errorName") == "QUERY_QUEUE_FULL"
+        )
+        finished = sum(1 for r in results if r.error is None)
+        assert finished >= 1 and rejected >= 1, [
+            (r.state, r.error) for r in results
+        ]
+        with urllib.request.urlopen(base + "/v1/resourceGroup") as resp:
+            snap = _json.loads(resp.read())
+        assert snap[0]["name"] == "tiny"
+        assert snap[0]["running"] == 0 and snap[0]["queued"] == 0
+    finally:
+        srv.stop()

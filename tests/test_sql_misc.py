@@ -190,3 +190,108 @@ def test_memory_budget_enforced(runner):
         ).rows == [(5,)]
     finally:
         runner.execute("set session query_max_memory_bytes = 0")
+
+
+class TestVarianceFamily:
+    """stddev/variance aggregates (reference: operator/aggregation/
+    VarianceAggregation — Welford state; ours is moment sums, see
+    exec/agg_states.py)."""
+
+    def test_grouped_vs_numpy(self, runner):
+        import collections
+
+        import numpy as np
+
+        rows = runner.execute(
+            "select l_returnflag, l_quantity, l_extendedprice "
+            "from lineitem"
+        ).rows
+        by = collections.defaultdict(list)
+        for f, q, e in rows:
+            by[f].append((q / 100.0, e / 100.0))
+        got = runner.execute(
+            "select l_returnflag, stddev(l_quantity), "
+            "var_samp(l_quantity), stddev_pop(l_extendedprice), "
+            "var_pop(l_extendedprice), variance(l_orderkey) "
+            "from lineitem group by l_returnflag"
+        ).rows
+        assert len(got) == 3
+        for f, sd, vs, sp, vp, vk in got:
+            a = np.array(by[f])
+            np.testing.assert_allclose(sd, np.std(a[:, 0], ddof=1),
+                                       rtol=1e-9)
+            np.testing.assert_allclose(vs, np.var(a[:, 0], ddof=1),
+                                       rtol=1e-9)
+            np.testing.assert_allclose(sp, np.std(a[:, 1], ddof=0),
+                                       rtol=1e-9)
+            np.testing.assert_allclose(vp, np.var(a[:, 1], ddof=0),
+                                       rtol=1e-9)
+
+    def test_global_and_edge_counts(self, runner):
+        # global (ungrouped) path + n<2 null semantics
+        r = runner.execute(
+            "select stddev(l_quantity), var_pop(l_quantity) "
+            "from lineitem where l_orderkey < 0"
+        ).rows
+        assert r[0][0] is None and r[0][1] is None
+        one = runner.execute(
+            "select var_samp(x), var_pop(x), stddev_pop(x) from "
+            "(select 5 as x) t"
+        ).rows[0]
+        assert one[0] is None and one[1] == 0.0 and one[2] == 0.0
+
+
+class TestDistinctAggregates:
+    """MarkDistinct-backed DISTINCT aggregates (reference:
+    MarkDistinctOperator + AggregationNode mask symbols): mixed
+    DISTINCT/plain and multiple distinct argument columns."""
+
+    def test_multiple_distinct_columns(self, runner):
+        # regression: this returned (25, 25) when the dedup ran over the
+        # combined (a, b) space instead of per-argument marks
+        got = runner.execute(
+            "select count(distinct n_regionkey), count(distinct n_name) "
+            "from nation"
+        ).rows
+        assert got == [(5, 25)]
+
+    def test_mixed_distinct_and_plain(self, runner):
+        got = runner.execute(
+            "select count(distinct o_custkey), count(*), "
+            "sum(o_totalprice) from orders"
+        ).rows[0]
+        plain = runner.execute(
+            "select count(*), sum(o_totalprice) from orders"
+        ).rows[0]
+        dcust = runner.execute(
+            "select count(*) from "
+            "(select distinct o_custkey from orders) t"
+        ).rows[0]
+        assert got == (dcust[0], plain[0], plain[1])
+
+    def test_grouped_mixed_vs_manual(self, runner):
+        got = runner.execute(
+            "select l_returnflag, count(distinct l_suppkey), "
+            "count(distinct l_partkey), sum(l_quantity) "
+            "from lineitem group by l_returnflag order by 1"
+        ).rows
+        for flag, dsupp, dpart, qty in got:
+            m = runner.execute(
+                f"select count(distinct l_suppkey) from lineitem "
+                f"where l_returnflag = '{flag}'"
+            ).rows[0][0]
+            m2 = runner.execute(
+                f"select count(distinct l_partkey) from lineitem "
+                f"where l_returnflag = '{flag}'"
+            ).rows[0][0]
+            m3 = runner.execute(
+                f"select sum(l_quantity) from lineitem "
+                f"where l_returnflag = '{flag}'"
+            ).rows[0][0]
+            assert (dsupp, dpart, qty) == (m, m2, m3)
+
+    def test_sum_distinct(self, runner):
+        got = runner.execute(
+            "select sum(distinct n_regionkey), count(*) from nation"
+        ).rows
+        assert got == [(0 + 1 + 2 + 3 + 4, 25)]

@@ -1,0 +1,191 @@
+"""SQL-level TPC-DS correctness (BASELINE rung 5): Q17 and Q64 run
+through parse → plan → execute and are checked against sqlite3 running an
+encoding-adapted oracle over the same generated rows (same pattern as
+test_sql_tpch.py; reference analog: presto-tpcds + AbstractTestQueries).
+
+Oracle adaptations: decimals are unscaled cents ints (64 -> 6400);
+stddev_samp is registered as a Python aggregate UDF (sqlite has none).
+"""
+
+import collections
+import math
+
+import pytest
+
+from presto_tpu.connectors.tpcds import TpcdsConnector
+from presto_tpu.runner import LocalRunner
+from tests.oracle import load_sqlite
+from tests.tpcds_queries import QUERIES
+
+SF = 0.01
+# Q64's cross-channel chain (same item returned in consecutive years at
+# the same store, within the qualified color/price band) is empty below
+# SF ~0.025, and the 18-table plan takes many minutes of XLA compile on
+# the 1-core CPU CI — so the Q64 correctness test runs at its own scale,
+# opt-in via RUN_SLOW=1 (same pattern as test_tpu_smoke.py). It is part
+# of the bench ladder on real hardware.
+Q64_SF = 0.025
+
+
+class _StddevSamp:
+    """Welford accumulator registered as a sqlite aggregate UDF."""
+
+    def __init__(self):
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def step(self, v):
+        if v is None:
+            return
+        self.n += 1
+        d = v - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (v - self.mean)
+
+    def finalize(self):
+        if self.n < 2:
+            return None
+        return math.sqrt(self.m2 / (self.n - 1))
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpcdsConnector(SF)
+
+
+@pytest.fixture(scope="module")
+def runner(conn):
+    return LocalRunner({"tpcds": conn}, default_catalog="tpcds",
+                       page_rows=1 << 16)
+
+
+@pytest.fixture(scope="module")
+def db(conn):
+    d = load_sqlite(conn, conn.tables())
+    d.create_aggregate("stddev_samp", 1, _StddevSamp)
+    return d
+
+
+ORACLE_17 = QUERIES[17]  # integer quantities: no encoding adaptation
+
+ORACLE_64 = QUERIES[64].replace(
+    "between 64 and 74", "between 6400 and 7400"
+).replace(
+    "between 65 and 79", "between 6500 and 7900"
+)
+
+# float-tolerance columns of Q17: ave/stdev/cov per channel
+Q17_FLOAT_COLS = {4, 5, 6, 8, 9, 10, 12, 13, 14}
+
+
+def ds_oracle(qid: int):
+    """(oracle sql, float-tolerance column set) per TPC-DS query —
+    consumed by bench.py's oracle cross-check and sqlite baseline."""
+    return {
+        17: (ORACLE_17, Q17_FLOAT_COLS),
+        64: (ORACLE_64, set()),
+    }[qid]
+
+
+def _norm(row, float_cols):
+    out = []
+    for j, v in enumerate(row):
+        if j in float_cols and v is not None:
+            out.append(round(float(v), 6))
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def _compare(engine_rows, oracle_rows, float_cols, label):
+    assert len(engine_rows) == len(oracle_rows), (
+        f"{label}: row count {len(engine_rows)} vs {len(oracle_rows)}\n"
+        f"engine: {engine_rows[:3]}\noracle: {oracle_rows[:3]}"
+    )
+    e_rows = [_norm(r, float_cols) for r in engine_rows]
+    o_rows = [_norm(tuple(r), float_cols) for r in oracle_rows]
+    for i, (er, orow) in enumerate(zip(e_rows, o_rows)):
+        for j, (ev, ov) in enumerate(zip(er, orow)):
+            if j in float_cols and ev is not None and ov is not None:
+                assert abs(ev - ov) <= 1e-6 * max(1.0, abs(ov)), (
+                    f"{label} row {i} col {j}: {ev} != {ov}"
+                )
+            else:
+                assert ev == ov, (
+                    f"{label} row {i} col {j}: {ev!r} != {ov!r}"
+                )
+
+
+def test_q17(runner, db):
+    got = runner.execute(QUERIES[17]).rows
+    want = db.execute(ORACLE_17).fetchall()
+    assert len(want) > 0, "oracle returned no rows — fixture too sparse"
+    _compare(got, want, Q17_FLOAT_COLS, "Q17")
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("RUN_SLOW"),
+    reason="Q64 needs SF 0.025 + ~10 min of 1-core XLA compile; "
+    "set RUN_SLOW=1",
+)
+def test_q64():
+    conn64 = TpcdsConnector(Q64_SF)
+    runner = LocalRunner({"tpcds": conn64}, default_catalog="tpcds",
+                         page_rows=1 << 17)
+    db = load_sqlite(conn64, conn64.tables())
+    db.create_aggregate("stddev_samp", 1, _StddevSamp)
+    got = runner.execute(QUERIES[64]).rows
+    want = db.execute(ORACLE_64).fetchall()
+    assert len(want) > 0, "oracle returned no rows — fixture too sparse"
+    _compare(got, want, set(), "Q64")
+
+
+def test_generator_invariants(conn):
+    """Structural sanity of the generator itself (cheap, no engine)."""
+    import numpy as np
+
+    # date_dim calendar parts agree with python's calendar
+    import datetime
+
+    page = next(conn.pages("date_dim"))
+    rows = page.to_pylist()
+    assert len(rows) == conn.row_count("date_dim")
+    cols = conn.table_schema("date_dim").column_names()
+    i_sk = cols.index("d_date_sk")
+    i_year = cols.index("d_year")
+    i_moy = cols.index("d_moy")
+    i_dom = cols.index("d_dom")
+    i_qn = cols.index("d_quarter_name")
+    base = datetime.date(1900, 1, 1)
+    for probe in (0, 1, 58, 36524, 73048, 40177):
+        r = rows[probe]
+        d = base + datetime.timedelta(days=probe)
+        assert r[i_sk] == 2415022 + probe
+        assert (r[i_year], r[i_moy], r[i_dom]) == (d.year, d.month, d.day)
+        assert r[i_qn] == f"{d.year}Q{(d.month - 1) // 3 + 1}"
+
+    # demographics cross product: sk decodes bijectively on a sample
+    cd = list(conn.pages("customer_demographics"))[0].to_pylist()
+    seen = set(tuple(r[1:]) for r in cd)
+    assert len(seen) == len(cd), "cd decode must be injective"
+
+    # returns reference their sale: same item/ticket multiset subset
+    ss = [r for p in conn.pages("store_sales") for r in p.to_pylist()]
+    sr = [r for p in conn.pages("store_returns") for r in p.to_pylist()]
+    ss_cols = conn.table_schema("store_sales").column_names()
+    sr_cols = conn.table_schema("store_returns").column_names()
+    ss_keys = collections.Counter(
+        (r[ss_cols.index("ss_item_sk")],
+         r[ss_cols.index("ss_ticket_number")]) for r in ss
+    )
+    for r in sr:
+        k = (r[sr_cols.index("sr_item_sk")],
+             r[sr_cols.index("sr_ticket_number")])
+        assert ss_keys[k] >= 1
+    # return ratio near the spec's ~10%
+    assert 0.05 < len(sr) / len(ss) < 0.15
+    # return quantity bounded by sale quantity per matching line is
+    # guaranteed by construction (rqty = u % qty + 1); spot-check ranges
+    qty_i = sr_cols.index("sr_return_quantity")
+    assert all(1 <= r[qty_i] <= 100 for r in sr)
