@@ -1,0 +1,56 @@
+"""Shared setup for the bench tools: one place for the sys.path hack,
+the persistent compile cache, and session-property application (mirrors
+LocalRunner.execute's session->executor wiring so a tool driving the
+executor directly behaves like the engine would)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def configure_jax():
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
+def make_runner(suite: str, sf: float, props=()):
+    """LocalRunner over the named generator suite with k=v session
+    properties applied to both the session and the live executor."""
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runner import LocalRunner
+
+    cls = TpchConnector if suite == "tpch" else TpcdsConnector
+    runner = LocalRunner({suite: cls(scale=sf)}, default_catalog=suite)
+    for kv in props:
+        k, v = kv.split("=", 1)
+        runner.session.set(k, v)
+    # mirror LocalRunner.execute's session application for direct
+    # executor drivers (bisect_rung times ex.pages without execute())
+    ex = runner.executor
+    ex.use_jit = bool(runner.session.get("tpu_offload_enabled"))
+    ex.max_memory_bytes = (
+        int(runner.session.get("query_max_memory_bytes")) or None
+    )
+    ex.spill_bytes = (
+        int(runner.session.get("spill_threshold_bytes")) or None
+    )
+    return runner
+
+
+def queries(suite: str):
+    if suite == "tpch":
+        from tests.tpch_queries import QUERIES
+
+        return QUERIES
+    from tests.tpcds_queries import QUERIES
+
+    return QUERIES
