@@ -124,7 +124,7 @@ class LocalRunner:
             raise ValueError(
                 f"catalog {catalog!r} does not support writes"
             )
-        return conn, table
+        return conn, catalog, table
 
     def execute(self, sql: str) -> QueryResult:
         stmt = parse(sql)
@@ -155,14 +155,16 @@ class LocalRunner:
                 ["table"], [(t,) for t in conn.tables()]
             )
         if isinstance(stmt, N.DropTable):
-            conn, table = self._resolve_write_target(stmt.parts)
+            conn, _cat, table = self._resolve_write_target(stmt.parts)
             conn.drop_table(table)
             return QueryResult([], [], update_type="DROP TABLE")
+        if isinstance(stmt, (N.Delete, N.Update)):
+            return self._execute_dml(stmt)
         if isinstance(stmt, (N.CreateTableAs, N.InsertInto)):
             inner_plan = self._plan_statement_query(stmt.query)
             types = self.executor.output_types(inner_plan)
             names, rows = self.executor.execute(inner_plan)
-            conn, table = self._resolve_write_target(stmt.parts)
+            conn, _cat, table = self._resolve_write_target(stmt.parts)
             if isinstance(stmt, N.CreateTableAs):
                 n = conn.create_table(table, names or [], types, rows)
                 return QueryResult(
@@ -188,6 +190,86 @@ class LocalRunner:
         types = [str(t) for t in self.executor.output_types(out)]
         return QueryResult(list(names or []), rows, column_types=types)
 
+    def _execute_dml(self, stmt) -> QueryResult:
+        """DELETE/UPDATE as rewrite-through-SELECT + table replace
+        (reference: DeleteNode/TableWriter; columnar stores rewrite
+        rather than mutate — ours replaces the memory-connector table
+        with the surviving/updated row set)."""
+
+        def q(ident: str) -> str:
+            # regenerated SQL must survive re-tokenizing: quote every
+            # identifier (unquoted names lowercase on re-parse)
+            return '"' + ident.replace('"', '""') + '"'
+
+        conn, catalog, table = self._resolve_write_target(stmt.parts)
+        try:
+            schema = conn.table_schema(table)
+        except KeyError:
+            raise ValueError(f"table not found: {table}")
+        cols = schema.column_names()
+        w = getattr(stmt, "where_sql", None)
+        if w is not None and _sql_has_subquery(w):
+            # the guarded rewrite buries the predicate where the
+            # planner's subquery decorrelation cannot reach it
+            raise ValueError(
+                "DELETE/UPDATE predicates with subqueries are not "
+                "supported yet; stage keys via CREATE TABLE AS first"
+            )
+        tref = f"{q(catalog)}.{q(table)}"
+        # coalesce((w), false): NULL-predicate rows are NOT matched
+        # (SQL three-valued logic — a NULL WHERE neither deletes nor
+        # updates the row)
+        guarded = f"coalesce(({w}), false)" if w else "true"
+        n_before = conn.row_count(table)
+        if isinstance(stmt, N.Delete):
+            keep_sql = f"select * from {tref} where not {guarded}"
+            plan = self._plan_statement_query(parse(keep_sql))
+            types = self.executor.output_types(plan)
+            _names, rows = self.executor.execute(plan)
+            conn.create_table(table, cols, types, rows, replace=True)
+            return QueryResult(
+                ["rows"], [(n_before - len(rows),)],
+                update_type="DELETE", column_types=["bigint"],
+            )
+        # UPDATE: assigned columns become guarded CASE projections cast
+        # back to the declared column type (schema survives); the guard
+        # itself rides as one extra boolean column so the matched count
+        # comes from the same single scan
+        sets = dict(stmt.assignments)
+        if len(sets) != len(stmt.assignments):
+            raise ValueError(
+                "UPDATE assigns the same column more than once"
+            )
+        unknown = set(sets) - set(cols)
+        if unknown:
+            raise ValueError(
+                f"no such column(s) in {table!r}: {sorted(unknown)}"
+            )
+        sel = []
+        for c in cols:
+            if c in sets:
+                t = schema.column_type(c)
+                sel.append(
+                    f"case when {guarded} then "
+                    f"cast(({sets[c]}) as {t}) else {q(c)} end as {q(c)}"
+                )
+            else:
+                sel.append(q(c))
+        sel.append(f'{guarded} as "__upd_matched__"')
+        upd_sql = f"select {', '.join(sel)} from {tref}"
+        plan = self._plan_statement_query(parse(upd_sql))
+        _names, rows = self.executor.execute(plan)
+        matched = sum(1 for r in rows if r[-1])
+        rows = [r[:-1] for r in rows]
+        conn.create_table(
+            table, cols, [schema.column_type(c) for c in cols], rows,
+            replace=True,
+        )
+        return QueryResult(
+            ["rows"], [(matched,)],
+            update_type="UPDATE", column_types=["bigint"],
+        )
+
     def _plan_statement_query(self, query: N.Query) -> P.Output:
         from presto_tpu.exec.pushdown import push_scan_constraints
 
@@ -201,6 +283,30 @@ class LocalRunner:
                 out, self.catalogs, **self._session_dist_options()
             )
         return out
+
+
+def _sql_has_subquery(expr_sql: str) -> bool:
+    """True when a raw expression fragment contains a subquery (walks
+    the parsed AST for nested Query nodes)."""
+    import dataclasses as _dc
+
+    from presto_tpu.sql.parser import Parser, tokenize
+
+    node = Parser(tokenize(expr_sql), source=expr_sql).parse_expr()
+
+    def walk(x) -> bool:
+        if isinstance(x, N.Query):
+            return True
+        if _dc.is_dataclass(x):
+            for f in _dc.fields(x):
+                v = getattr(x, f.name)
+                items = v if isinstance(v, (list, tuple)) else (v,)
+                for i in items:
+                    if isinstance(i, N.Node) and walk(i):
+                        return True
+        return False
+
+    return walk(node)
 
 
 def explain_text(node: P.PhysicalNode, indent: int = 0, stats=None) -> str:

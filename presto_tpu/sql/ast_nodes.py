@@ -263,6 +263,27 @@ class DropTable(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Delete(Node):
+    """DELETE FROM [catalog.]name [WHERE pred] (reference:
+    sql/tree/Delete). The predicate rides as raw SQL — the engine
+    rewrites DML into a SELECT of the surviving rows + table replace
+    (columnar stores rewrite, they don't mutate in place)."""
+
+    parts: Tuple[str, ...]
+    where_sql: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Update(Node):
+    """UPDATE [catalog.]name SET col = expr, ... [WHERE pred]
+    (reference: sql/tree/Update); same rewrite-through-SELECT model."""
+
+    parts: Tuple[str, ...]
+    assignments: Tuple[Tuple[str, str], ...]  # (column, raw expr sql)
+    where_sql: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
 class SetSession(Node):
     """SET SESSION name = value (reference: sql/tree/SetSession)."""
 

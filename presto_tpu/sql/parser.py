@@ -38,6 +38,7 @@ KEYWORDS = {
     "timestamp", "interval", "true", "false", "explain", "analyze",
     "substring", "for", "create", "table", "drop", "insert", "into",
     "set", "session", "show", "tables", "over", "partition",
+    "delete", "update",
 }
 
 
@@ -103,9 +104,10 @@ _PRECEDENCE = {
 
 
 class Parser:
-    def __init__(self, tokens: List[Token]):
+    def __init__(self, tokens: List[Token], source: Optional[str] = None):
         self.toks = tokens
         self.i = 0
+        self.source = source  # raw SQL (DML expression slicing)
 
     # ------------------------------------------------------------ cursor
     def peek(self, k: int = 0) -> Token:
@@ -178,6 +180,31 @@ class Parser:
             parts = self._qualified_name()
             self._finish()
             return N.DropTable(parts)
+        if self.accept_keyword("delete"):
+            # DML rewrites re-plan through SELECT (runner), so the
+            # predicate/assignment expressions ride as raw SQL slices
+            self.expect_keyword("from")
+            parts = self._qualified_name()
+            where_sql = None
+            if self.accept_keyword("where"):
+                where_sql = self._expr_text()
+            self._finish()
+            return N.Delete(parts, where_sql)
+        if self.accept_keyword("update"):
+            parts = self._qualified_name()
+            self.expect_keyword("set")
+            assignments = []
+            while True:
+                col = self.expect_name()
+                self.expect_op("=")
+                assignments.append((col, self._expr_text()))
+                if not self.accept_op(","):
+                    break
+            where_sql = None
+            if self.accept_keyword("where"):
+                where_sql = self._expr_text()
+            self._finish()
+            return N.Update(parts, tuple(assignments), where_sql)
         if self.accept_keyword("set"):
             self.expect_keyword("session")
             name = self.expect_name()
@@ -209,6 +236,17 @@ class Parser:
         q = self.parse_query()
         self._finish()
         return q
+
+    def _expr_text(self) -> str:
+        """Parse an expression, returning its raw source slice (needs
+        the source attached by parse()); used by DML statements whose
+        expressions are re-planned inside generated SELECTs."""
+        start = self.peek().pos
+        self.parse_expr()
+        end = self.peek().pos
+        if self.source is None:  # pragma: no cover - direct Parser use
+            raise SqlSyntaxError("DML parsing requires source text")
+        return self.source[start:end].strip()
 
     def _qualified_name(self) -> Tuple[str, ...]:
         parts = [self.expect_name()]
@@ -664,4 +702,4 @@ class Parser:
 
 def parse(sql: str) -> N.Node:
     """Parse one statement (reference: SqlParser.createStatement)."""
-    return Parser(tokenize(sql)).parse_statement()
+    return Parser(tokenize(sql), source=sql).parse_statement()
