@@ -109,6 +109,53 @@ def _canonical_join_cols(
     return lcols, lnulls, rcols, rnulls
 
 
+class _FoldBuffer:
+    """Bounded incremental merge of partial-state pages: buffered pages
+    flush into a single pcap-sized accumulator through a merge-only
+    group-by whenever flush_slots accumulate. One implementation shared
+    by the single-pass aggregation, the multi-pass partitioned
+    aggregation, and the per-partition fold accumulators (reference:
+    InMemoryHashAggregationBuilder flushing under memory pressure)."""
+
+    def __init__(self, ex, merge_fn, pcap, max_iters, flush_slots):
+        self.ex = ex
+        self.merge_fn = merge_fn
+        self.pcap = pcap
+        self.max_iters = max_iters
+        self.flush_slots = flush_slots
+        self.acc = None
+        self.buf: list = []
+        self.slots = 0
+        self.saw_input = False
+
+    def add(self, page) -> None:
+        self.saw_input = True
+        self.buf.append(page)
+        self.slots += page.capacity
+        if self.slots >= self.flush_slots:
+            self.flush()
+
+    def _merged(self):
+        pages = ([self.acc] if self.acc is not None else []) + self.buf
+        if not pages:
+            return None
+        merged = concat_all(pages) if len(pages) > 1 else pages[0]
+        self.ex._account_page(merged)
+        return merged
+
+    def flush(self) -> None:
+        merged = self._merged()
+        if merged is None:
+            return
+        out, overflow = self.merge_fn(merged, self.pcap, self.max_iters)
+        self.ex._pending_overflow.append(overflow)
+        self.acc, self.buf, self.slots = out, [], 0
+
+    def final_merged(self):
+        """All remaining state as one page (None if nothing was added)."""
+        return self._merged()
+
+
 class MemoryBudgetExceeded(RuntimeError):
     """Reference: ExceededMemoryLimitException — the query fails rather
     than thrash (SURVEY §6.4: kill-don't-spill is the v1 policy; spill to
@@ -713,34 +760,18 @@ class Executor:
             ),
             static_argnums=(1, 2),
         )
-        acc: Optional[Page] = None
-        buf: List[Page] = []
-        buf_slots = 0
-        saw_input = False
+        fold = _FoldBuffer(self, merge_fn, fold_cap, max_iters,
+                           2 * fold_cap)
         for page in self.pages(node.source):
-            saw_input = True
             # distinct groups <= rows, so clip the capacity to the page
             out, overflow = partial_fn(
                 page, min(cap, _next_pow2(page.capacity)), max_iters
             )
             self._pending_overflow.append(overflow)
-            buf.append(out)
-            buf_slots += out.capacity
-            if buf_slots >= 2 * fold_cap:
-                pages_ = ([acc] if acc is not None else []) + buf
-                merged = (
-                    concat_all(pages_) if len(pages_) > 1 else pages_[0]
-                )
-                self._account_page(merged)
-                acc, overflow = merge_fn(merged, fold_cap, max_iters)
-                self._pending_overflow.append(overflow)
-                buf, buf_slots = [], 0
-        if not saw_input:
+            fold.add(out)
+        merged = fold.final_merged()
+        if merged is None:
             return
-
-        pages_ = ([acc] if acc is not None else []) + buf
-        merged = concat_all(pages_) if len(pages_) > 1 else pages_[0]
-        self._account_page(merged)
         final_fn = self._jit(
             ("agg_final", node),
             functools.partial(
@@ -760,13 +791,37 @@ class Executor:
     def _exec_agg_partitioned(
         self, node: P.Aggregation, parts: int, in_types, layouts
     ) -> Iterator[Page]:
-        """Partition-wise grouped aggregation (spill analog): P passes
-        over the input, each aggregating only the groups whose key hash
-        lands in the pass's partition — state stays ~1/P of the one-shot
-        size and group partitions are disjoint, so the union of pass
-        outputs is the exact result. Reference: SpillableHash-
-        AggregationBuilder's partition-and-merge, re-expressed as
-        recomputation because generator scans are free (SURVEY §8.2.6)."""
+        """Partition-wise grouped aggregation (spill analog): group-key
+        hash partitions keep per-partition state ~1/P of the one-shot
+        size; partitions are disjoint so the union of outputs is exact.
+        Two strategies (reference: SpillableHashAggregationBuilder's
+        partition-and-merge):
+          - parts <= 32: SINGLE source pass, P device-resident
+            accumulators folded incrementally — the source (often an
+            expensive join) executes once and every buffer stays small;
+          - larger P: one pass per partition re-streaming the source
+            (recomputation instead of spill files — generator scans are
+            free, SURVEY §8.2.6) with O(1/P) working set."""
+        if parts <= 32:
+            # budget check: the fold path keeps ~3 buffers per partition
+            # resident (~6x the capacity estimate in state rows); under
+            # an explicit query memory budget that exceeds the point of
+            # spilling — fall through to the O(1/P) multi-pass instead
+            cap_est = _next_pow2(node.capacity * self._capacity_boost)
+            pcap_est = _next_pow2(max(cap_est // parts * 2, 1024))
+            src_types = self.output_types(node.source)
+            state_types = [src_types[c] for c in node.group_channels]
+            for layout in layouts:
+                state_types.extend(st.type for st in layout)
+            resident = 3 * parts * pcap_est * _row_bytes(state_types)
+            if (
+                self.max_memory_bytes is None
+                or resident <= self.max_memory_bytes
+            ):
+                yield from self._exec_agg_partition_fold(
+                    node, parts, in_types, layouts
+                )
+                return
         self.spill_partitions_used = max(self.spill_partitions_used, parts)
         pfilter = self._partition_filter(node.group_channels, parts)
         cap = _next_pow2(node.capacity * self._capacity_boost)
@@ -802,36 +857,89 @@ class Executor:
             # incremental fold: buffered partial pages merge into one
             # pcap-sized state page whenever they pile up, so per-pass
             # memory is O(pcap), not O(pages x pcap)
-            acc: Optional[Page] = None
-            buf: List[Page] = []
-            buf_slots = 0
-            saw_input = False
-
-            def fold(acc, buf):
-                pages = ([acc] if acc is not None else []) + buf
-                merged = concat_all(pages) if len(pages) > 1 else pages[0]
-                self._account_page(merged)
-                out, overflow = merge_fn(merged, pcap, max_iters)
-                self._pending_overflow.append(overflow)
-                return out
-
+            fold = _FoldBuffer(self, merge_fn, pcap, max_iters, 4 * pcap)
             for page in self.pages(node.source):
-                saw_input = True
                 f = pfilter(page, pj)
                 out, overflow = partial_fn(
                     f, min(pcap, _next_pow2(page.capacity)), max_iters
                 )
                 self._pending_overflow.append(overflow)
-                buf.append(out)
-                buf_slots += out.capacity
-                if buf_slots >= 4 * pcap:
-                    acc = fold(acc, buf)
-                    buf, buf_slots = [], 0
-            if not saw_input:
+                fold.add(out)
+            if not fold.saw_input:
                 return
-            pages = ([acc] if acc is not None else []) + buf
-            merged = concat_all(pages) if len(pages) > 1 else pages[0]
-            self._account_page(merged)
+            merged = fold.final_merged()
+            fcap = min(pcap, _next_pow2(merged.capacity))
+            out, overflow = final_fn(merged, fcap, max_iters)
+            self._pending_overflow.append(overflow)
+            yield out
+
+    def _exec_agg_partition_fold(
+        self, node: P.Aggregation, parts: int, in_types, layouts
+    ) -> Iterator[Page]:
+        """Single-pass partitioned aggregation: every source page is
+        partial-aggregated, split into P partitions by group-key hash
+        over the PARTIAL page's key channels, compacted, and folded into
+        per-partition accumulators. Memory is O(P * pcap) and every
+        individual buffer stays ~3*pcap — small enough for the axon
+        >=4M-row fault line — while the source streams exactly once
+        (crucial when it is a join pipeline, not a free generator
+        re-scan)."""
+        self.spill_partitions_used = max(self.spill_partitions_used, parts)
+        nkeys = len(node.group_channels)
+        # partial output pages carry the keys at channels 0..nkeys-1
+        pfilter = self._partition_filter(tuple(range(nkeys)), parts)
+        cap = _next_pow2(node.capacity * self._capacity_boost)
+        pcap = _next_pow2(max(cap // parts * 2, 1024))
+        max_iters = 64 * self._capacity_boost
+        partial_fn = self._jit(
+            ("agg_partial", node),
+            functools.partial(
+                _partial_agg_page, node.group_channels, node.aggregates,
+                tuple(tuple(l) for l in layouts)
+            ),
+            static_argnums=(1, 2),
+        )
+        merge_fn = self._jit(
+            ("agg_merge", node),
+            functools.partial(
+                _merge_partials_page, node.aggregates,
+                tuple(tuple(l) for l in layouts), nkeys
+            ),
+            static_argnums=(1, 2),
+        )
+        final_fn = self._jit(
+            ("agg_final", node),
+            functools.partial(
+                _final_agg_page, node.group_channels, node.aggregates,
+                tuple(tuple(l) for l in layouts), tuple(in_types)
+            ),
+            static_argnums=(1, 2),
+        )
+
+        folds = [
+            _FoldBuffer(self, merge_fn, pcap, max_iters, 2 * pcap)
+            for _ in range(parts)
+        ]
+        for page in self.pages(node.source):
+            out, overflow = partial_fn(
+                page, min(cap, _next_pow2(page.capacity)), max_iters
+            )
+            self._pending_overflow.append(overflow)
+            piece_cap = min(
+                _next_pow2(
+                    max(out.capacity // parts * 2, 256)
+                    * self._capacity_boost
+                ),
+                _next_pow2(out.capacity),
+            )
+            for p in range(parts):
+                f = pfilter(out, jnp.uint64(p))
+                self._pending_overflow.append(f.num_rows() > piece_cap)
+                folds[p].add(compact_page(f, piece_cap))
+        for fold in folds:
+            merged = fold.final_merged()
+            if merged is None:
+                continue
             fcap = min(pcap, _next_pow2(merged.capacity))
             out, overflow = final_fn(merged, fcap, max_iters)
             self._pending_overflow.append(overflow)

@@ -218,8 +218,9 @@ class LocalRunner:
         tref = f"{q(catalog)}.{q(table)}"
         # coalesce((w), false): NULL-predicate rows are NOT matched
         # (SQL three-valued logic — a NULL WHERE neither deletes nor
-        # updates the row)
-        guarded = f"coalesce(({w}), false)" if w else "true"
+        # updates the row). The newline terminates any trailing line
+        # comment riding in the raw source slice.
+        guarded = f"coalesce(({w}\n), false)" if w else "true"
         n_before = conn.row_count(table)
         if isinstance(stmt, N.Delete):
             keep_sql = f"select * from {tref} where not {guarded}"
@@ -251,7 +252,8 @@ class LocalRunner:
                 t = schema.column_type(c)
                 sel.append(
                     f"case when {guarded} then "
-                    f"cast(({sets[c]}) as {t}) else {q(c)} end as {q(c)}"
+                    f"cast(({sets[c]}\n) as {t}) else {q(c)} end "
+                    f"as {q(c)}"
                 )
             else:
                 sel.append(q(c))
@@ -297,13 +299,12 @@ def _sql_has_subquery(expr_sql: str) -> bool:
     def walk(x) -> bool:
         if isinstance(x, N.Query):
             return True
-        if _dc.is_dataclass(x):
-            for f in _dc.fields(x):
-                v = getattr(x, f.name)
-                items = v if isinstance(v, (list, tuple)) else (v,)
-                for i in items:
-                    if isinstance(i, N.Node) and walk(i):
-                        return True
+        if isinstance(x, (list, tuple)):
+            return any(walk(i) for i in x)  # nested tuples (CASE whens)
+        if _dc.is_dataclass(x) and isinstance(x, N.Node):
+            return any(
+                walk(getattr(x, f.name)) for f in _dc.fields(x)
+            )
         return False
 
     return walk(node)

@@ -119,3 +119,27 @@ def test_exchange_noop_single_device(single, conn):
     names, rows = single.executor.execute(frag)
     base = single.execute(ENGINE_SQL[6]).rows
     assert rows_equal(rows, base)
+
+
+ROUND2_QUERIES = [
+    # variance family through partial/final state merge across shards
+    "select l_returnflag, stddev(l_quantity), var_samp(l_extendedprice),"
+    " count(*) from lineitem group by l_returnflag",
+    # global variance (gather of moment sums)
+    "select stddev_pop(o_totalprice), variance(o_totalprice) from orders",
+    # MarkDistinct: mixed DISTINCT/plain and multiple distinct columns
+    "select count(distinct n_regionkey), count(distinct n_name), "
+    "count(*) from nation",
+    "select o_orderpriority, count(distinct o_custkey), sum(o_totalprice)"
+    " from orders group by o_orderpriority",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(ROUND2_QUERIES)))
+def test_dist_round2_aggregates(qi, single, dist, dist_repart):
+    """Round-2 aggregate features must hold on the mesh in both exchange
+    configurations (broadcast/gather and forced all_to_all)."""
+    q = ROUND2_QUERIES[qi]
+    want = single.execute(q).rows
+    assert rows_equal(dist.execute(q).rows, want)
+    assert rows_equal(dist_repart.execute(q).rows, want)

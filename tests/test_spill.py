@@ -143,3 +143,41 @@ def test_not_in_null_build_partitioned(conn):
         "(select y from memory.u2)"
     ).rows
     assert rows2[0][0] > 0
+
+
+def test_partition_fold_single_source_pass():
+    """parts <= 32 takes the single-pass fold: the (potentially
+    expensive) source must stream exactly once, not once per
+    partition."""
+    conn2 = TpchConnector(0.01)
+    r = LocalRunner({"tpch": conn2}, page_rows=1 << 13)
+    r.session.set("spill_threshold_bytes", 1 << 17)
+    calls = {"n": 0}
+    orig = conn2.pages
+
+    def counting(table, *a, **k):
+        if table == "lineitem":
+            calls["n"] += 1
+        return orig(table, *a, **k)
+
+    conn2.pages = counting
+    rows = r.execute(
+        "select l_orderkey, count(*) from lineitem group by l_orderkey "
+        "order by 2 desc, 1 limit 3"
+    ).rows
+    assert 1 < r.executor.spill_partitions_used <= 32
+    assert calls["n"] == 1
+    assert len(rows) == 3
+
+
+def test_multipass_beyond_32_partitions(base):
+    """parts > 32 falls back to re-streaming passes; results must still
+    match single-pass execution exactly."""
+    conn3 = TpchConnector(0.01)
+    r = LocalRunner({"tpch": conn3}, page_rows=1 << 13)
+    r.session.set("spill_threshold_bytes", 1 << 15)
+    q = ("select l_orderkey, count(*), sum(l_extendedprice) "
+         "from lineitem group by l_orderkey order by 3 desc, 1 limit 5")
+    got = r.execute(q).rows
+    assert r.executor.spill_partitions_used > 32
+    assert _rows_equal(got, base.execute(q).rows)
