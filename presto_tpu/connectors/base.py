@@ -153,6 +153,13 @@ class Connector:
     def row_count(self, table: str) -> int:
         raise NotImplementedError
 
+    def unique_columns(self, table: str) -> frozenset:
+        """Columns whose values are unique across the table (primary
+        keys). Metadata the engine may exploit — e.g. the Pallas
+        unique-key join fast path (reference analog: connector-provided
+        table layouts/constraints consulted by the planner)."""
+        return frozenset()
+
     def splits(self, table: str, target_rows: int) -> List[Split]:
         """Chop the table into row-range splits of ~target_rows each."""
         total = self.row_count(table)

@@ -316,6 +316,19 @@ class TpcdsConnector(GeneratorConnector, Connector):
             )
         return super().splits(table, target_rows)
 
+    def unique_columns(self, table: str) -> frozenset:
+        return {
+            "date_dim": frozenset({"d_date_sk"}),
+            "item": frozenset({"i_item_sk"}),
+            "store": frozenset({"s_store_sk"}),
+            "customer": frozenset({"c_customer_sk"}),
+            "customer_address": frozenset({"ca_address_sk"}),
+            "customer_demographics": frozenset({"cd_demo_sk"}),
+            "household_demographics": frozenset({"hd_demo_sk"}),
+            "income_band": frozenset({"ib_income_band_sk"}),
+            "promotion": frozenset({"p_promo_sk"}),
+        }.get(table, frozenset())
+
     def monotonic_row_bound(self, table: str, column: str):
         """Surrogate keys are monotonic in the row index, so pushed sk
         ranges prune generator splits (e.g. date_dim filtered to a

@@ -450,6 +450,16 @@ class TpchConnector(GeneratorConnector, Connector):
     # ----------------------------------------------------------- generation
     # page_for_split/_compiled_gen/gen_body come from GeneratorConnector.
 
+    def unique_columns(self, table: str) -> frozenset:
+        return {
+            "region": frozenset({"r_regionkey"}),
+            "nation": frozenset({"n_nationkey"}),
+            "part": frozenset({"p_partkey"}),
+            "supplier": frozenset({"s_suppkey"}),
+            "customer": frozenset({"c_custkey"}),
+            "orders": frozenset({"o_orderkey"}),
+        }.get(table, frozenset())
+
     def monotonic_row_bound(self, table: str, column: str):
         """Key columns are monotonic in the row index (spec layout), so
         pushed key ranges prune whole generator splits (TupleDomain

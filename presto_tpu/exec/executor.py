@@ -232,6 +232,10 @@ class Executor:
         # host RAM — which IS the HBM->host-RAM spill.  None = disabled.
         self.spill_bytes: Optional[int] = None
         self.spill_partitions_used = 0  # observability / tests
+        # Pallas unique-key join fast path (pallas_join_enabled session
+        # property); pallas_joins_used is observability for tests
+        self.pallas_join = False
+        self.pallas_joins_used = 0
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
@@ -1070,9 +1074,85 @@ class Executor:
         # __init__); capacity is a static upper bound on rows
         build = compact_page(build_all, _next_pow2(build_all.capacity))
         self._account_page(build)  # the query's largest materialization
+        if self._pallas_join_eligible(node, build, left_types,
+                                      right_types):
+            yield from self._pallas_join_pass(node, build, left_types)
+            return
         yield from self._join_pass(
             node, build, self.pages(node.left), left_types
         )
+
+    # ------------------------------------------------ Pallas fast path
+    def _pallas_join_eligible(self, node, build: Page, left_types,
+                              right_types) -> bool:
+        """The VMEM-resident open-addressing probe applies to inner/left
+        joins on ONE non-string key whose build side scans a connector-
+        declared UNIQUE column (<=1 match per probe row, so no output
+        expansion) and fits the table in VMEM. Boosted retries fall back
+        to the general join (the overflow flag may have come from the
+        Pallas build)."""
+        if not self.pallas_join or self._capacity_boost > 1:
+            return False
+        if node.join_type not in ("inner", "left"):
+            return False
+        if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+            return False
+        for t in (left_types[node.left_keys[0]],
+                  right_types[node.right_keys[0]]):
+            if T.is_string(t):
+                return False
+            if isinstance(t, T.DecimalType) and not t.is_short:
+                # long decimals encode as (hi, lo) limb pairs — one u64
+                # key cannot carry them
+                return False
+        if build.capacity > (1 << 19):
+            # table = 2x capacity x 3 int32 arrays, loaded whole into
+            # VMEM per grid step; 1<<19 keeps it ~12 MB (<16 MB budget)
+            return False
+        return self._scan_column_unique(node.right, node.right_keys[0])
+
+    def _scan_column_unique(self, n: P.PhysicalNode, ch: int) -> bool:
+        """Whether channel ch of node n provably carries a unique table
+        column (walk identity projections/filters/exchanges to the
+        scan; reference analog: table-layout constraint propagation)."""
+        if isinstance(n, (P.Filter, P.Exchange)):
+            return self._scan_column_unique(n.source, ch)
+        if isinstance(n, P.Project):
+            e = n.exprs[ch]
+            from presto_tpu.expr import ir as _ir
+
+            if isinstance(e, _ir.InputRef):
+                return self._scan_column_unique(n.source, e.channel)
+            return False
+        if isinstance(n, P.TableScan):
+            conn = self.catalogs[n.catalog]
+            return n.columns[ch] in conn.unique_columns(n.table)
+        return False
+
+    def _pallas_join_pass(self, node, build: Page,
+                          left_types) -> Iterator[Page]:
+        from presto_tpu.ops import pallas_join as PJ
+
+        self.pallas_joins_used += 1
+        interpret = jax.default_backend() != "tpu"
+        bblk = build.block(node.right_keys[0])
+        bkeys = K.equality_encoding(bblk)[0]
+        bvalid = build.valid
+        if bblk.nulls is not None:
+            bvalid = bvalid & ~bblk.nulls
+        table, build_ovf = PJ.build_table(
+            bkeys, bvalid, PJ.table_capacity(build.capacity)
+        )
+        self._pending_overflow.append(build_ovf)
+        fn = self._jit(
+            ("pallas_probe", node, build.capacity, interpret),
+            functools.partial(
+                _pallas_probe_page, node.left_keys[0], node.join_type,
+                interpret,
+            ),
+        )
+        for page in self.pages(node.left):
+            yield fn(page, build, table)
 
     def _exec_join_partitioned(
         self, node: P.HashJoin, parts: int, left_types, right_types
@@ -1186,6 +1266,42 @@ class Executor:
 # ---------------------------------------------------------------- kernels
 # Module-level pure functions so functools.partial(...) stays hashable and
 # jit caches hit across pages.
+
+
+def _pallas_probe_page(key_ch, join_type, interpret, page: Page,
+                       build: Page, table) -> Page:
+    """Probe one page through the Pallas open-addressing kernel: unique
+    build keys mean <=1 match per probe row, so the output page is the
+    probe page extended with gathered build columns (no expansion)."""
+    from presto_tpu.ops import pallas_join as PJ
+
+    blk = page.block(key_ch)
+    pkeys = K.equality_encoding(blk)[0]
+    rid = PJ.probe_any(pkeys, table, interpret=interpret)
+    valid_key = page.valid
+    if blk.nulls is not None:
+        valid_key = valid_key & ~blk.nulls
+    rid = jnp.where(valid_key, rid, jnp.int32(-1))
+    matched = rid >= 0
+    safe = jnp.clip(rid, 0, build.capacity - 1).astype(jnp.int64)
+    right_blocks = []
+    for b in build.blocks:
+        if isinstance(b.data, tuple):
+            data = tuple(d[safe] for d in b.data)
+        else:
+            data = b.data[safe]
+        nulls = b.nulls[safe] if b.nulls is not None else None
+        if join_type == "left":
+            nulls = ~matched if nulls is None else (nulls | ~matched)
+        right_blocks.append(
+            Block(data=data, type=b.type, nulls=nulls,
+                  dictionary=b.dictionary)
+        )
+    out_valid = (
+        page.valid & matched if join_type == "inner" else page.valid
+    )
+    return Page(blocks=page.blocks + tuple(right_blocks),
+                valid=out_valid)
 
 
 def _project_page(exprs, page: Page) -> Page:

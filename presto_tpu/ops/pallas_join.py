@@ -176,6 +176,28 @@ def probe(
     )(plo, phi, tab_lo, tab_hi, tab_row)
 
 
+def table_capacity(build_rows: int) -> int:
+    """2x-rows open-addressing capacity, pow2 (load factor <= 0.5)."""
+    return max(16, 1 << (2 * build_rows - 1).bit_length())
+
+
+def probe_any(
+    probe_keys: jnp.ndarray, table, *, interpret: bool = False
+) -> jnp.ndarray:
+    """probe() for ANY input length: Pallas rank-1 blocks must evenly
+    tile the array (multiples of 128 in practice), so inputs are padded
+    to a 2048 multiple and the pad lanes sliced off. Pad keys are zeros;
+    callers mask results by probe validity regardless."""
+    n = probe_keys.shape[0]
+    pad = (-n) % 2048
+    if pad:
+        probe_keys = jnp.concatenate(
+            [probe_keys, jnp.zeros((pad,), probe_keys.dtype)]
+        )
+    rid = probe(probe_keys, table, block_rows=2048, interpret=interpret)
+    return rid[:n]
+
+
 def join_unique(
     build_keys: jnp.ndarray,
     build_valid: jnp.ndarray,
@@ -187,19 +209,10 @@ def join_unique(
     """End-to-end unique-key inner-join mapping: for each probe row the
     matching build row id or -1. Returns (row_ids, overflow)."""
     nb = int(build_keys.shape[0])
-    cap = max(16, 1 << (2 * nb - 1).bit_length())
-    table, overflow = build_table(build_keys, build_valid, cap)
-    n = int(probe_keys.shape[0])
-    block = 2048 if n % 2048 == 0 else _largest_block(n)
-    rid = probe(probe_keys, table, block_rows=block, interpret=interpret)
+    table, overflow = build_table(build_keys, build_valid,
+                                  table_capacity(nb))
+    rid = probe_any(probe_keys, table, interpret=interpret)
     rid = jnp.where(probe_valid, rid, -1)
     # reject matches onto invalid build rows (valid rows never share slots
     # with them because invalid rows never settle)
     return rid, overflow
-
-
-def _largest_block(n: int) -> int:
-    for b in (1024, 512, 256, 128, 64, 32, 16, 8, 1):
-        if n % b == 0:
-            return b
-    return 1

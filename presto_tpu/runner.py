@@ -138,6 +138,9 @@ class LocalRunner:
         self.executor.max_memory_bytes = limit or None
         spill = int(self.session.get("spill_threshold_bytes"))
         self.executor.spill_bytes = spill or None
+        self.executor.pallas_join = bool(
+            self.session.get("pallas_join_enabled")
+        )
         if isinstance(stmt, N.SetSession):
             self.session.set(stmt.name, stmt.value)
             return QueryResult([], [], update_type="SET SESSION")

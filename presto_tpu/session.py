@@ -91,6 +91,13 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0,
         ),
         PropertyMetadata(
+            "pallas_join_enabled",
+            "use the Pallas open-addressing probe kernel for eligible "
+            "joins (single non-string key, build side a scan of a "
+            "connector-declared unique column that fits VMEM)",
+            bool, False,
+        ),
+        PropertyMetadata(
             "spill_threshold_bytes",
             "joins/aggregations whose state estimate exceeds this many "
             "bytes run in hash-partition passes (grace-style spill; 0 = "

@@ -1,0 +1,83 @@
+"""Executor wiring of the Pallas unique-key join fast path
+(pallas_join_enabled session property). Reference: the north-star's
+Pallas radix hash join (SURVEY §8.2.2); the kernel itself is covered by
+test_pallas_join.py — these tests cover eligibility selection and
+end-to-end parity with the general sort join."""
+
+import collections
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runner import LocalRunner
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(0.01)
+
+
+@pytest.fixture(scope="module")
+def base(conn):
+    return LocalRunner({"tpch": conn}, page_rows=1 << 13)
+
+
+@pytest.fixture(scope="module")
+def pallas(conn):
+    r = LocalRunner({"tpch": conn}, page_rows=1 << 13)
+    r.session.set("pallas_join_enabled", "true")
+    return r
+
+
+def _same(a, b):
+    return collections.Counter(map(repr, a)) == collections.Counter(
+        map(repr, b)
+    )
+
+
+def test_inner_join_parity_and_engagement(base, pallas):
+    q = ("select o_orderkey, o_totalprice, l_extendedprice from orders, "
+         "lineitem where o_orderkey = l_orderkey "
+         "order by 1, 3 limit 9")
+    before = pallas.executor.pallas_joins_used
+    assert _same(base.execute(q).rows, pallas.execute(q).rows)
+    assert pallas.executor.pallas_joins_used > before
+
+
+def test_left_join_null_extension(base, pallas):
+    # lineitem pages are 7-aligned (capacity 8190, NOT a Pallas block
+    # multiple — exercises probe padding); every lineitem matches an
+    # order, so also check an artificial no-match band via a filtered
+    # build side (unique o_orderkey survives a Filter)
+    q = ("select count(*), sum(o_totalprice) from lineitem "
+         "left join orders on l_orderkey = o_orderkey")
+    before = pallas.executor.pallas_joins_used
+    assert _same(base.execute(q).rows, pallas.execute(q).rows)
+    assert pallas.executor.pallas_joins_used > before
+    q2 = ("select count(*), count(o_orderkey) from lineitem left join "
+          "(select * from orders where o_orderkey < 1000) t "
+          "on l_orderkey = o_orderkey")
+    before = pallas.executor.pallas_joins_used
+    a, b = base.execute(q2).rows, pallas.execute(q2).rows
+    assert _same(a, b)
+    assert pallas.executor.pallas_joins_used > before
+    # unmatched rows null-extended: count(*) > count(o_orderkey)
+    assert b[0][0] > b[0][1] > 0
+
+
+def test_non_unique_build_falls_back(base, pallas):
+    # build side lineitem: l_orderkey is NOT declared unique — must
+    # take the general join, not the Pallas path
+    before = pallas.executor.pallas_joins_used
+    q = ("select count(*) from orders where o_orderkey in "
+         "(select l_orderkey from lineitem)")
+    assert _same(base.execute(q).rows, pallas.execute(q).rows)
+    # semi joins are ineligible regardless; counter must not move
+    assert pallas.executor.pallas_joins_used == before
+
+
+def test_aggregate_over_pallas_join(base, pallas):
+    q = ("select c_mktsegment, count(*), sum(o_totalprice) from orders, "
+         "customer where o_custkey = c_custkey group by c_mktsegment "
+         "order by 1")
+    assert _same(base.execute(q).rows, pallas.execute(q).rows)
