@@ -80,6 +80,12 @@ def state_layout(function: str, in_type: Optional[T.SqlType]) -> List[StateCol]:
             StateCol("sum", A.SUM, A.SUM, T.DOUBLE),
             StateCol("sumsq", A.SUM, A.SUM, T.DOUBLE, pre="sq"),
         ]
+    if function == "approx_distinct":
+        # one tuple-data state column of packed HLL register words;
+        # insert/merge/estimate are special-cased in the executor
+        # kernels (exec/executor.py) against ops/hll.py. Reference:
+        # operator/aggregation/ApproximateCountDistinctAggregation.
+        return [StateCol("hll", A.HLL_INSERT, A.HLL_MERGE, T.HLL_STATE)]
     raise ValueError(f"unknown aggregate function: {function}")
 
 
@@ -110,6 +116,8 @@ def result_type(function: str, in_type: Optional[T.SqlType]) -> T.SqlType:
         return T.DOUBLE
     if function in VARIANCE_FNS:
         return T.DOUBLE
+    if function == "approx_distinct":
+        return T.BIGINT
     raise ValueError(f"unknown aggregate function: {function}")
 
 

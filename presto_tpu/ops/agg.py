@@ -45,6 +45,10 @@ MAX = "max"
 ANY = "any"  # arbitrary non-null value (used for grouped key passthrough)
 BOOL_OR = "bool_or"
 BOOL_AND = "bool_and"
+# HyperLogLog kinds: tuple-data states, handled by the executor kernels
+# against ops/hll.py (not by aggregate() below)
+HLL_INSERT = "hll_insert"
+HLL_MERGE = "hll_merge"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -136,7 +136,8 @@ def concat_all(pages) -> Page:
                 datas = remapped
         if isinstance(datas[0], tuple):
             data = tuple(
-                jnp.concatenate([d[i] for d in datas]) for i in range(2)
+                jnp.concatenate([d[i] for d in datas])
+                for i in range(len(datas[0]))
             )
         else:
             data = jnp.concatenate(datas)

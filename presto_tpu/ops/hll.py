@@ -1,0 +1,158 @@
+"""HyperLogLog approx_distinct as segmented reductions.
+
+Reference: presto-main operator/aggregation/ApproximateCountDistinct-
+Aggregation.java (airlift-stats HyperLogLog: dense 2048-register HLL,
+~2.3% standard error). The TPU translation:
+
+- registers: M_REGS = 256 byte-wide registers per group (standard error
+  1.04/sqrt(256) ~= 6.5%; the register count trades accuracy against
+  per-group state bytes and is documented in the function registry).
+- insert: one xxhash64 per row; low bits pick the register, the rank =
+  1 + count-leading-zeros of the remaining bits. A SINGLE
+  jax.ops.segment_max over composite segment ids (group * M_REGS +
+  register) computes every (group, register) max in one scatter —
+  the open-addressed per-row HLL update of the reference collapsed
+  into one vectorized primitive.
+- state: the [cap, M_REGS] byte matrix packs into WORDS = 32 i64
+  columns carried as ONE tuple-data Block (same mechanism as the
+  long-decimal (hi, lo) limb blocks), so HLL state pages flow through
+  compaction, gathering, concatenation, and exchanges like any other
+  page.
+- merge: unpack to bytes, segment_max per (group, register), repack —
+  HLL union is register-wise max, exactly mergeable across partials
+  (PARTIAL/FINAL split and mesh repartition both preserved).
+- estimate: alpha_m * m^2 / sum(2^-reg) with the standard small-range
+  linear-counting correction (Flajolet et al. 2007).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+M_REGS = 256  # registers per group (SE ~= 1.04/sqrt(256) ~= 6.5%)
+WORDS = M_REGS // 8  # i64 words per group (8-bit registers)
+# alpha_256 per the HLL paper (m >= 128: 0.7213 / (1 + 1.079/m))
+_ALPHA = 0.7213 / (1.0 + 1.079 / M_REGS)
+
+
+def _reg_rank(h: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(register index, rank) per row from a u64 hash: low log2(m) bits
+    pick the register; rank = 1 + leading zeros of the top 56 bits
+    (max rank 57 fits comfortably in a byte)."""
+    reg = (h & jnp.uint64(M_REGS - 1)).astype(jnp.int64)
+    rest = h >> jnp.uint64(8)  # 56 significant bits
+    # exact integer highest-set-bit via bisection (no float rounding)
+    x = rest
+    pos = jnp.zeros(h.shape, dtype=jnp.int64)
+    for s in (32, 16, 8, 4, 2, 1):
+        y = x >> jnp.uint64(s)
+        take = y != 0
+        pos = pos + jnp.where(take, jnp.int64(s), jnp.int64(0))
+        x = jnp.where(take, y, x)
+    # rest > 0: highest set bit at position pos (0-based within 56
+    # bits) -> leading zeros = 55 - pos -> rank = 56 - pos;
+    # rest == 0 -> rank 57
+    rank = jnp.where(rest == 0, jnp.int64(57), jnp.int64(56) - pos)
+    return reg, rank
+
+
+def _pack(bytes2d: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """[cap, M_REGS] int64 byte values -> WORDS i64 arrays of [cap]."""
+    out = []
+    for w in range(WORDS):
+        word = jnp.zeros(bytes2d.shape[:1], dtype=jnp.int64)
+        for k in range(8):
+            word = word | (bytes2d[:, 8 * w + k] << jnp.int64(8 * k))
+        out.append(word)
+    return tuple(out)
+
+
+def _unpack(words: Tuple[jnp.ndarray, ...]) -> jnp.ndarray:
+    """WORDS i64 arrays of [cap] -> [cap, M_REGS] int64 byte values."""
+    cols = []
+    for w in range(WORDS):
+        for k in range(8):
+            cols.append((words[w] >> jnp.int64(8 * k)) & jnp.int64(0xFF))
+    return jnp.stack(cols, axis=1)
+
+
+def insert(
+    group_ids: jnp.ndarray,
+    contributing: jnp.ndarray,
+    out_capacity: int,
+    hashes: jnp.ndarray,
+) -> Tuple[jnp.ndarray, ...]:
+    """Per-group HLL registers from raw input hashes (the PARTIAL input
+    step). Returns WORDS packed i64 arrays of [out_capacity]."""
+    reg, rank = _reg_rank(hashes)
+    seg = jnp.where(
+        contributing,
+        group_ids * M_REGS + reg,
+        jnp.int64(out_capacity * M_REGS),
+    )
+    flat = jax.ops.segment_max(
+        jnp.where(contributing, rank, jnp.int64(0)),
+        seg,
+        num_segments=out_capacity * M_REGS + 1,
+    )[: out_capacity * M_REGS]
+    flat = jnp.maximum(flat, 0)  # segment_max identity is INT_MIN
+    return _pack(flat.reshape(out_capacity, M_REGS))
+
+
+def merge(
+    group_ids: jnp.ndarray,
+    contributing: jnp.ndarray,
+    out_capacity: int,
+    words: Tuple[jnp.ndarray, ...],
+) -> Tuple[jnp.ndarray, ...]:
+    """Merge partial HLL states by group (register-wise max)."""
+    n = group_ids.shape[0]
+    bytes2d = _unpack(words)  # [n, M_REGS]
+    regs = jnp.broadcast_to(
+        jnp.arange(M_REGS, dtype=jnp.int64)[None, :], (n, M_REGS)
+    )
+    seg = jnp.where(
+        contributing[:, None],
+        group_ids[:, None] * M_REGS + regs,
+        jnp.int64(out_capacity * M_REGS),
+    )
+    flat = jax.ops.segment_max(
+        jnp.where(contributing[:, None], bytes2d, 0).reshape(-1),
+        seg.reshape(-1),
+        num_segments=out_capacity * M_REGS + 1,
+    )[: out_capacity * M_REGS]
+    flat = jnp.maximum(flat, 0)
+    return _pack(flat.reshape(out_capacity, M_REGS))
+
+
+def estimate(words: Tuple[jnp.ndarray, ...]) -> jnp.ndarray:
+    """HLL cardinality estimate per group: [cap] int64."""
+    bytes2d = _unpack(words).astype(jnp.float64)  # [cap, M_REGS]
+    inv_sum = jnp.sum(jnp.exp2(-bytes2d), axis=1)
+    raw = _ALPHA * M_REGS * M_REGS / inv_sum
+    zeros = jnp.sum((bytes2d == 0).astype(jnp.float64), axis=1)
+    # small-range correction: linear counting while any register is
+    # empty and the raw estimate is below 2.5m
+    lc = M_REGS * jnp.log(M_REGS / jnp.maximum(zeros, 1.0))
+    use_lc = (raw <= 2.5 * M_REGS) & (zeros > 0)
+    est = jnp.where(use_lc, lc, raw)
+    return jnp.round(est).astype(jnp.int64)
+
+
+def global_insert(
+    valid: jnp.ndarray, hashes: jnp.ndarray
+) -> Tuple[jnp.ndarray, ...]:
+    """Ungrouped insert: one group's registers as WORDS scalars-of-[1]."""
+    gids = jnp.zeros(valid.shape, dtype=jnp.int64)
+    return insert(gids, valid, 1, hashes)
+
+
+def global_merge(
+    valid: jnp.ndarray, words: Tuple[jnp.ndarray, ...]
+) -> Tuple[jnp.ndarray, ...]:
+    gids = jnp.zeros(valid.shape, dtype=jnp.int64)
+    return merge(gids, valid, 1, words)

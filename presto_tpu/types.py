@@ -308,6 +308,29 @@ class RowType(SqlType):
         return f"row({inner})"
 
 
+@dataclasses.dataclass(frozen=True)
+class HllStateType(SqlType):
+    """Internal HyperLogLog accumulator state: a tuple-data Block of
+    ops/hll.WORDS packed i64 register words per row (reference:
+    spi/type/ HyperLogLogType carrying airlift-stats HLL slices; the
+    TPU translation keeps registers as fixed-width columns so state
+    pages stay pytrees)."""
+
+    name: str = dataclasses.field(init=False, default="hyperloglog")
+
+    @property
+    def device_dtype(self):
+        return jnp.int64  # per word
+
+    @property
+    def is_comparable(self) -> bool:
+        return False
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+
 # --- singletons (reference: static INSTANCE fields on each Type) ---------
 BIGINT = BigintType()
 INTEGER = IntegerType()
@@ -323,6 +346,7 @@ UNKNOWN = UnknownType()
 VARCHAR = VarcharType()
 INTERVAL_DAY_TIME = IntervalDayTimeType()
 INTERVAL_YEAR_MONTH = IntervalYearMonthType()
+HLL_STATE = HllStateType()
 
 _INTEGRAL = (BigintType, IntegerType, SmallintType, TinyintType)
 _FLOATING = (DoubleType, RealType)
