@@ -264,3 +264,24 @@ class Output(PhysicalNode):
 
     def children(self):
         return (self.source,)
+
+
+def scan_column_unique(node: PhysicalNode, ch: int, catalogs) -> bool:
+    """Whether channel ch of node provably carries a connector-declared
+    unique column, walked through filters, limits, exchanges, and
+    identity projections (reference analog: table-layout uniqueness
+    constraints). ONE shared walker so the planner's join ordering and
+    the executor's join output sizing judge uniqueness identically."""
+    from presto_tpu.expr.ir import InputRef
+
+    if isinstance(node, (Filter, Exchange, Limit)):
+        return scan_column_unique(node.source, ch, catalogs)
+    if isinstance(node, Project):
+        e = node.exprs[ch]
+        if isinstance(e, InputRef):
+            return scan_column_unique(node.source, e.channel, catalogs)
+        return False
+    if isinstance(node, TableScan):
+        conn = catalogs[node.catalog]
+        return node.columns[ch] in conn.unique_columns(node.table)
+    return False
