@@ -678,7 +678,7 @@ def _stack_to_mesh(pages: List[Page], cap: int, D: int, spec) -> Page:
                 jax.device_put(
                     _np.concatenate([d[i] for d in datas]), spec
                 )
-                for i in range(2)
+                for i in range(len(blk0.data))
             )
         else:
             data = jax.device_put(_np.concatenate(datas), spec)

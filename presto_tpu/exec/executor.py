@@ -32,6 +32,7 @@ from presto_tpu.exec import plan as P
 from presto_tpu.expr.eval import evaluate, evaluate_filter
 from presto_tpu.ops import agg as A
 from presto_tpu.ops import hashing as H
+from presto_tpu.ops import hll as HLL
 from presto_tpu.ops import join as J
 from presto_tpu.ops import keys as K
 from presto_tpu.ops.compact import compact_page, concat_all, gather_rows
@@ -50,6 +51,8 @@ def _row_bytes(types) -> int:
     for t in types:
         if isinstance(t, T.DecimalType) and not t.is_short:
             total += 16
+        elif isinstance(t, T.HllStateType):
+            total += 8 * HLL.WORDS  # packed register words
         elif T.is_string(t):
             total += 4  # dictionary codes
         else:
@@ -232,6 +235,25 @@ class Executor:
         # host RAM — which IS the HBM->host-RAM spill.  None = disabled.
         self.spill_bytes: Optional[int] = None
         self.spill_partitions_used = 0  # observability / tests
+        # Restreamable intermediates (reference: PagesIndex +
+        # FileSingleStreamSpiller): multi-pass operators consume their
+        # sources through _source_stream, which materializes EXPENSIVE
+        # subtrees (joins/aggs/sorts below) once into a PageStore and
+        # restreams, instead of re-executing the subplan per pass.
+        # Intermediates estimated above host_spill_bytes stage to host
+        # RAM (the HBM->host spill); below it they stay device-resident
+        # as a page list. None = host tier disabled.
+        self.host_spill_bytes: Optional[int] = None
+        self._stream_cache: Dict = {}
+        self.host_spill_pages = 0  # observability / tests
+        self.host_spill_bytes_used = 0
+        # Hard per-pass row cap for join builds (session property
+        # max_join_build_rows): partitions a join whenever the build-side
+        # row estimate exceeds it, independent of the byte threshold.
+        # Exists because the axon XLA:TPU runtime faults kernels touching
+        # >=~4M-row buffers — the byte threshold tunes memory, this tunes
+        # the kernel-size ceiling. None = disabled.
+        self.max_build_rows: Optional[int] = None
         # Pallas unique-key join fast path (pallas_join_enabled session
         # property); pallas_joins_used is observability for tests
         self.pallas_join = False
@@ -540,25 +562,36 @@ class Executor:
         self._capacity_boost = 1  # per-query; grows only across retries
         self.peak_memory_bytes = 0
         self.spill_partitions_used = 0
-        for _attempt in range(6):
-            self._pending_overflow = []
-            if self._collect_stats is not None:
-                self._collect_stats.clear()  # drop failed-attempt stats
-            out_pages = list(self.pages(node))
-            if self._pending_overflow:
-                flag = self._pending_overflow[0]
-                for f in self._pending_overflow[1:]:
-                    flag = flag | f
-                if bool(flag):
-                    self._capacity_boost *= 4
-                    continue
-            rows: List[tuple] = []
-            for page in out_pages:
-                rows.extend(_decode_result_page(page))
-            return names, rows
-        raise RuntimeError(
-            "capacity overflow persisted after 6 boosted retries"
-        )
+        self.host_spill_pages = 0
+        self.host_spill_bytes_used = 0
+        try:
+            for _attempt in range(6):
+                self._pending_overflow = []
+                # boosted retries invalidate materialized intermediates:
+                # cached pages may embed overflow-truncated results
+                self._stream_cache = {}
+                if self._collect_stats is not None:
+                    # drop failed-attempt stats
+                    self._collect_stats.clear()
+                out_pages = list(self.pages(node))
+                if self._pending_overflow:
+                    flag = self._pending_overflow[0]
+                    for f in self._pending_overflow[1:]:
+                        flag = flag | f
+                    if bool(flag):
+                        self._capacity_boost *= 4
+                        continue
+                rows: List[tuple] = []
+                for page in out_pages:
+                    rows.extend(_decode_result_page(page))
+                return names, rows
+            raise RuntimeError(
+                "capacity overflow persisted after 6 boosted retries"
+            )
+        finally:
+            # release materialized intermediates (HBM/host pages) the
+            # moment the query is done
+            self._stream_cache = {}
 
     def _account_page(self, page: Page) -> None:
         size = page_bytes(page)
@@ -856,13 +889,14 @@ class Executor:
             ),
             static_argnums=(1, 2),
         )
+        src_stream = self._source_stream(node.source)
         for p in range(parts):
             pj = jnp.uint64(p)
             # incremental fold: buffered partial pages merge into one
             # pcap-sized state page whenever they pile up, so per-pass
             # memory is O(pcap), not O(pages x pcap)
             fold = _FoldBuffer(self, merge_fn, pcap, max_iters, 4 * pcap)
-            for page in self.pages(node.source):
+            for page in src_stream():
                 f = pfilter(page, pj)
                 out, overflow = partial_fn(
                     f, min(pcap, _next_pow2(page.capacity)), max_iters
@@ -1047,22 +1081,90 @@ class Executor:
         partitioned mode for the operator."""
         return not any(T.is_string(types[c]) for c in keys)
 
+    def _cheap_to_recompute(self, node: P.PhysicalNode) -> bool:
+        """Whether re-executing this subtree per pass is acceptable:
+        pure scan pipelines recompute pages from row indices (generator
+        connectors, SURVEY §8.2.6) or restage from the connector's own
+        host store — no join/agg/sort work is repeated."""
+        if isinstance(node, (P.TableScan, P.Values)):
+            return True
+        if isinstance(
+            node, (P.Filter, P.Project, P.Exchange, P.Limit, P.Output)
+        ):
+            return self._cheap_to_recompute(node.source)
+        if isinstance(node, P.Union):
+            return all(self._cheap_to_recompute(s) for s in node.sources)
+        return False
+
+    def _source_stream(self, node: P.PhysicalNode):
+        """A callable yielding a fresh page stream for node, for
+        operators that consume a source MULTIPLE times (partitioned
+        passes). Expensive subtrees materialize once into a PageStore
+        (device page list, or host RAM above host_spill_bytes) and
+        restream from it — the fix for partitioned passes compounding
+        recomputation down a join/agg pipeline (reference: PagesIndex /
+        FileSingleStreamSpiller; SURVEY §6.4)."""
+        if self._cheap_to_recompute(node):
+            return lambda: self.pages(node)
+        from presto_tpu.exec.pagestore import PageStore
+
+        # keyed by the (frozen, hashable) plan node itself: identical
+        # subtrees share one materialization, and a key can never alias
+        # a different plan the way a recycled id() could
+        key = node
+        if key not in self._stream_cache:
+            # NOTE: estimate_rows is a heuristic, not an upper bound —
+            # a many-to-many join can exceed max(left, right); a wrong
+            # device-tier pick costs HBM headroom, never correctness
+            est = self.estimate_rows(node) * _row_bytes(
+                self.output_types(node)
+            )
+            tier = (
+                "host"
+                if self.host_spill_bytes is not None
+                and est > self.host_spill_bytes
+                else "device"
+            )
+            store = PageStore(tier)
+            for page in self.pages(node):
+                store.put(page)
+            if tier == "host":
+                self.host_spill_pages += store.page_count
+                self.host_spill_bytes_used += store.bytes
+            self._stream_cache[key] = store
+        return self._stream_cache[key].stream
+
     # --------------------------------------------------------------- join
     def _exec_join(self, node: P.HashJoin) -> Iterator[Page]:
         left_types = self.output_types(node.left)
         right_types = self.output_types(node.right)
+        # <=1 match per probe row when ANY build key scans a connector-
+        # declared unique column (equality on a unique column alone
+        # pins the row): join output can never exceed the probe page,
+        # so output capacities stay exact (FK joins — the TPC-H common
+        # case)
+        unique_build = any(
+            self._scan_column_unique(node.right, k)
+            for k in node.right_keys
+        )
         parts = 1
-        if (
-            self.spill_bytes is not None  # skip estimation when disabled
-            and self._keys_partitionable(right_types, node.right_keys)
-            and self._keys_partitionable(left_types, node.left_keys)
-        ):
-            parts = self._spill_partitions(
-                self.estimate_rows(node.right) * _row_bytes(right_types)
-            )
+        if self._keys_partitionable(
+            right_types, node.right_keys
+        ) and self._keys_partitionable(left_types, node.left_keys):
+            est_build = self.estimate_rows(node.right)
+            if self.spill_bytes is not None:
+                parts = self._spill_partitions(
+                    est_build * _row_bytes(right_types)
+                )
+            if self.max_build_rows:
+                # kernel-size ceiling, independent of the byte threshold
+                parts = max(
+                    parts,
+                    _next_pow2(-(-est_build // self.max_build_rows)),
+                )
         if parts > 1:
             yield from self._exec_join_partitioned(
-                node, parts, left_types, right_types
+                node, parts, left_types, right_types, unique_build
             )
             return
         build_pages = list(self.pages(node.right))
@@ -1079,7 +1181,8 @@ class Executor:
             yield from self._pallas_join_pass(node, build, left_types)
             return
         yield from self._join_pass(
-            node, build, self.pages(node.left), left_types
+            node, build, self.pages(node.left), left_types,
+            unique_build=unique_build,
         )
 
     # ------------------------------------------------ Pallas fast path
@@ -1113,21 +1216,9 @@ class Executor:
 
     def _scan_column_unique(self, n: P.PhysicalNode, ch: int) -> bool:
         """Whether channel ch of node n provably carries a unique table
-        column (walk identity projections/filters/exchanges to the
-        scan; reference analog: table-layout constraint propagation)."""
-        if isinstance(n, (P.Filter, P.Exchange)):
-            return self._scan_column_unique(n.source, ch)
-        if isinstance(n, P.Project):
-            e = n.exprs[ch]
-            from presto_tpu.expr import ir as _ir
-
-            if isinstance(e, _ir.InputRef):
-                return self._scan_column_unique(n.source, e.channel)
-            return False
-        if isinstance(n, P.TableScan):
-            conn = self.catalogs[n.catalog]
-            return n.columns[ch] in conn.unique_columns(n.table)
-        return False
+        column (shared walker: P.scan_column_unique, also used by the
+        planner's join ordering)."""
+        return P.scan_column_unique(n, ch, self.catalogs)
 
     def _pallas_join_pass(self, node, build: Page,
                           left_types) -> Iterator[Page]:
@@ -1155,7 +1246,8 @@ class Executor:
             yield fn(page, build, table)
 
     def _exec_join_partitioned(
-        self, node: P.HashJoin, parts: int, left_types, right_types
+        self, node: P.HashJoin, parts: int, left_types, right_types,
+        unique_build: bool = False,
     ) -> Iterator[Page]:
         """Grace-style partition-wise join: P passes, each streaming both
         sides filtered to hash(key) % P == p, so the build materialization
@@ -1167,10 +1259,12 @@ class Executor:
         bfilter = self._partition_filter(node.right_keys, parts,
                                          keep_nulls=semi)
         pfilter = self._partition_filter(node.left_keys, parts)
+        right_stream = self._source_stream(node.right)
+        left_stream = self._source_stream(node.left)
         for p in range(parts):
             pj = jnp.uint64(p)
             build_pages = []
-            for pg in self.pages(node.right):
+            for pg in right_stream():
                 f = bfilter(pg, pj)
                 # compact each filtered build page to ~pg/parts before the
                 # concat — this is where the memory actually shrinks
@@ -1189,15 +1283,27 @@ class Executor:
             build = compact_page(build_all, _next_pow2(build_all.capacity))
             self._account_page(build)
             probe_pages = (
-                pfilter(pg, pj) for pg in self.pages(node.left)
+                pfilter(pg, pj) for pg in left_stream()
             )
+            # partition-filtered probe pages are ~1/parts dense — scale
+            # output capacities down accordingly or every pass's output
+            # pages balloon to unpartitioned size (and a downstream
+            # materialization would pin parts-times the real data)
             yield from self._join_pass(node, build, probe_pages,
-                                       left_types)
+                                       left_types,
+                                       unique_build=unique_build,
+                                       density=parts)
 
     def _join_pass(
-        self, node: P.HashJoin, build: Page, probe_pages, left_types
+        self, node: P.HashJoin, build: Page, probe_pages, left_types,
+        *, unique_build: bool = False, density: int = 1,
     ) -> Iterator[Page]:
-        """One build+probe pass (the whole join unless partitioned)."""
+        """One build+probe pass (the whole join unless partitioned).
+
+        unique_build: <=1 match per probe row — output sized to the probe
+        page exactly. density: probe pages carry ~1/density real rows
+        (partition-filtered passes); output capacity shrinks to match,
+        with the deferred overflow flag + boosted retry guarding skew."""
         if node.join_type in ("semi", "anti"):
             fn = self._jit(
                 ("semi", node, build.capacity),
@@ -1243,10 +1349,18 @@ class Executor:
             # double 262k -> 4.2M and cross the >=4M-row axon kernel
             # fault line). Real fan-out beyond the clamp lands on the
             # overflow-retry ladder (up to 4^5 x).
-            oc = page.capacity * 2
-            if page.capacity <= 1 << 16:
-                oc = max(oc, build.capacity)
+            if unique_build:
+                # output rows <= probe rows, exactly sized
+                oc = page.capacity
+            else:
+                oc = page.capacity * 2
+                if page.capacity <= 1 << 16:
+                    oc = max(oc, build.capacity)
             oc = min(oc, max(4 * self.page_rows, 1 << 19))
+            if density > 1:
+                # 2x slack over the expected 1/density occupancy absorbs
+                # partition-hash fluctuation without a boosted retry
+                oc = max(oc * 2 // density, 8192)
             oc = _next_pow2(max(oc, 8192) * self._capacity_boost)
             out, matched, overflow = probe_fn(page, build, index, oc)
             self._pending_overflow.append(overflow)
@@ -1445,6 +1559,20 @@ def _apply_agg_mask(spec, page: Page, blk: Optional[Block]):
                  dictionary=blk.dictionary)
 
 
+def _hll_hashes(blk: Block) -> jnp.ndarray:
+    """One u64 hash per row over the block's equality encoding (SQL-
+    equal values hash equal, including dictionary canonicalization)."""
+    cols = K.equality_encoding(blk)
+    return H.hash_columns(cols, [None] * len(cols))
+
+
+def _hll_contributing(groups, blk: Optional[Block]):
+    contributing = groups.row_valid
+    if blk is not None and blk.nulls is not None:
+        contributing = contributing & ~blk.nulls
+    return contributing
+
+
 def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
                       cap: int, max_iters: int = 64):
     groups = _group_ids(group_channels, page, cap, max_iters)
@@ -1459,6 +1587,15 @@ def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
     for spec, layout in zip(aggregates, layouts):
         blk = None if spec.channel is None else page.block(spec.channel)
         blk = _apply_agg_mask(spec, page, blk)
+        if spec.function == "approx_distinct":
+            words = HLL.insert(
+                groups.group_ids, _hll_contributing(groups, blk),
+                out_cap, _hll_hashes(blk),
+            )
+            state_blocks.append(
+                Block(data=words, type=T.HLL_STATE, nulls=None)
+            )
+            continue
         for st in layout:
             vals, out_nulls, dic = _state_reduce(
                 st, blk, st.input_kind, True,
@@ -1494,6 +1631,16 @@ def _merge_partials_page(aggregates, layouts, nkeys, merged: Page,
     out_blocks: List[Block] = []
     ch = nkeys
     for spec, layout in zip(aggregates, layouts):
+        if spec.function == "approx_distinct":
+            blk = merged.block(ch)
+            ch += 1
+            words = HLL.merge(
+                groups.group_ids, groups.row_valid, out_cap, blk.data
+            )
+            out_blocks.append(
+                Block(data=words, type=T.HLL_STATE, nulls=None)
+            )
+            continue
         for st in layout:
             blk = merged.block(ch)
             ch += 1
@@ -1528,6 +1675,17 @@ def _final_agg_page(group_channels, aggregates, layouts, in_types,
     out_blocks: List[Block] = []
     ch = nkeys
     for spec, layout, in_t in zip(aggregates, layouts, in_types):
+        if spec.function == "approx_distinct":
+            blk = merged.block(ch)
+            ch += 1
+            words = HLL.merge(
+                groups.group_ids, groups.row_valid, out_cap, blk.data
+            )
+            out_blocks.append(
+                Block(data=HLL.estimate(words), type=T.BIGINT,
+                      nulls=None)
+            )
+            continue
         states = []
         state_dic = None
         for st in layout:
@@ -1559,6 +1717,15 @@ def _partial_global_agg(aggregates, layouts, page: Page) -> Page:
     for spec, layout in zip(aggregates, layouts):
         blk = None if spec.channel is None else page.block(spec.channel)
         blk = _apply_agg_mask(spec, page, blk)
+        if spec.function == "approx_distinct":
+            contributing = page.valid
+            if blk is not None and blk.nulls is not None:
+                contributing = contributing & ~blk.nulls
+            words = HLL.global_insert(contributing, _hll_hashes(blk))
+            blocks.append(
+                Block(data=words, type=T.HLL_STATE, nulls=None)
+            )
+            continue
         for st in layout:
             vals, is_null, dic = _state_reduce(
                 st, blk, st.input_kind, True,
@@ -1581,6 +1748,15 @@ def _final_global_agg(aggregates, layouts, in_types, merged: Page) -> Page:
     out_blocks = []
     ch = 0
     for spec, layout, in_t in zip(aggregates, layouts, in_types):
+        if spec.function == "approx_distinct":
+            blk = merged.block(ch)
+            ch += 1
+            words = HLL.global_merge(merged.valid, blk.data)
+            out_blocks.append(
+                Block(data=HLL.estimate(words), type=T.BIGINT,
+                      nulls=None)
+            )
+            continue
         states = []
         state_dic = None
         for st in layout:
@@ -1608,6 +1784,18 @@ def _empty_state_page(aggregates, layouts) -> Page:
     blocks = []
     for spec, layout in zip(aggregates, layouts):
         for st in layout:
+            if isinstance(st.type, T.HllStateType):
+                blocks.append(
+                    Block(
+                        data=tuple(
+                            jnp.zeros((1,), dtype=jnp.int64)
+                            for _ in range(HLL.WORDS)
+                        ),
+                        type=st.type,
+                        nulls=None,
+                    )
+                )
+                continue
             blocks.append(
                 Block(
                     data=jnp.zeros((1,), dtype=np.dtype(st.type.numpy_dtype)),

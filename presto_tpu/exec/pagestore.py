@@ -1,0 +1,62 @@
+"""Materialized, restreamable intermediate pages.
+
+Reference: presto-main operator/PagesIndex.java (append-only page store
+shared across probe passes) and spiller/FileSingleStreamSpiller.java
+(serialized pages staged out of memory and read back per merge pass).
+
+The TPU translation has two tiers:
+
+- tier="device": the page list stays resident in HBM. Restreaming is
+  free and involves no transfers; every individual page remains small
+  (page-capacity granularity), which matters because the XLA:TPU
+  runtime on this host faults kernels touching >=~4M-row buffers — a
+  page LIST sidesteps that while a single concatenated buffer would
+  not.
+- tier="host": pages are pulled to host RAM as numpy pytrees
+  (jax.device_get) and re-staged with device_put on each stream() —
+  the HBM->host-RAM spill of SURVEY §6.4. This is what lets a
+  partitioned operator consume an intermediate larger than device
+  memory without recomputing the subplan that produced it.
+
+Stores are owned by the Executor per query attempt (capacity-boost
+retries invalidate them — cached pages may embed overflowed results).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+
+from presto_tpu.page import Page
+
+
+class PageStore:
+    """Append-once, stream-many page materialization."""
+
+    def __init__(self, tier: str = "device"):
+        assert tier in ("device", "host"), tier
+        self.tier = tier
+        self._pages: List = []
+        self.bytes = 0
+        self.page_count = 0
+
+    def put(self, page: Page) -> None:
+        from presto_tpu.exec.executor import page_bytes
+
+        self.bytes += page_bytes(page)
+        self.page_count += 1
+        if self.tier == "host":
+            # one bounded D2H transfer per page; the axon runtime
+            # degrades post-D2H kernel launches, so callers only pick
+            # the host tier when the intermediate cannot stay resident
+            self._pages.append(jax.device_get(page))
+        else:
+            self._pages.append(page)
+
+    def stream(self) -> Iterator[Page]:
+        if self.tier == "host":
+            for p in self._pages:
+                yield jax.device_put(p)
+        else:
+            yield from self._pages

@@ -138,6 +138,10 @@ class LocalRunner:
         self.executor.max_memory_bytes = limit or None
         spill = int(self.session.get("spill_threshold_bytes"))
         self.executor.spill_bytes = spill or None
+        host_spill = int(self.session.get("host_spill_bytes"))
+        self.executor.host_spill_bytes = host_spill or None
+        max_build = int(self.session.get("max_join_build_rows"))
+        self.executor.max_build_rows = max_build or None
         self.executor.pallas_join = bool(
             self.session.get("pallas_join_enabled")
         )

@@ -104,6 +104,23 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "disabled; reference: spill-enabled + revocable memory)",
             int, 0,
         ),
+        PropertyMetadata(
+            "max_join_build_rows",
+            "partition a join whenever the build-side row estimate "
+            "exceeds this many rows, regardless of the byte threshold "
+            "(kernel-size ceiling for runtimes that fault on huge "
+            "buffers; 0 = disabled)",
+            int, 0,
+        ),
+        PropertyMetadata(
+            "host_spill_bytes",
+            "materialized intermediates (multi-pass operator sources) "
+            "estimated above this many bytes stage to host RAM instead "
+            "of staying HBM-resident (0 = always device-resident; "
+            "reference: spiller/FileSingleStreamSpiller). Default 4GB "
+            "keeps huge intermediates from pinning device memory",
+            int, 1 << 32,
+        ),
     ]
 }
 
