@@ -727,3 +727,465 @@ def _var_numeric_resolve(args):
 
 register("greatest", _var_numeric_resolve, _impl_greatest_least("greatest"))
 register("least", _var_numeric_resolve, _impl_greatest_least("least"))
+
+
+# ----------------------------------------------- round-3 breadth batch
+# Reference: presto-main metadata/FunctionRegistry.java registrations for
+# MathFunctions, StringFunctions, DateTimeFunctions, JoniRegexpFunctions,
+# ConditionalFunctions — the most-used subset, TPU-idiomatic: numeric
+# work stays vectorized on device; string/regex work happens once per
+# distinct dictionary entry on the host at trace time.
+
+
+def _impl_simple_double(fn):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        xp = ctx.xp
+        x = _to_common(ctx, vals[0], T.DOUBLE).data
+        return Val(fn(xp, x), None, T.DOUBLE)
+
+    return impl
+
+
+for _name, _fn in [
+    ("log2", lambda xp, x: xp.log2(xp.where(x <= 0, np.nan, x))),
+    ("log10", lambda xp, x: xp.log10(xp.where(x <= 0, np.nan, x))),
+    ("cbrt", lambda xp, x: xp.sign(x) * xp.power(xp.abs(x), 1.0 / 3.0)),
+    ("sin", lambda xp, x: xp.sin(x)),
+    ("cos", lambda xp, x: xp.cos(x)),
+    ("tan", lambda xp, x: xp.tan(x)),
+    ("asin", lambda xp, x: xp.arcsin(x)),
+    ("acos", lambda xp, x: xp.arccos(x)),
+    ("atan", lambda xp, x: xp.arctan(x)),
+    ("sinh", lambda xp, x: xp.sinh(x)),
+    ("cosh", lambda xp, x: xp.cosh(x)),
+    ("tanh", lambda xp, x: xp.tanh(x)),
+    ("degrees", lambda xp, x: x * (180.0 / np.pi)),
+    ("radians", lambda xp, x: x * (np.pi / 180.0)),
+    ("truncate", lambda xp, x: xp.trunc(x)),
+]:
+    register(_name, lambda a: T.DOUBLE, _impl_simple_double(_fn))
+
+
+def _impl_atan2(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    xp = ctx.xp
+    y = _to_common(ctx, vals[0], T.DOUBLE).data
+    x = _to_common(ctx, vals[1], T.DOUBLE).data
+    return Val(xp.arctan2(y, x), None, T.DOUBLE)
+
+
+register("atan2", lambda a: T.DOUBLE, _impl_atan2)
+
+
+def _impl_log_base(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    """log(b, x) = ln(x)/ln(b) (reference: MathFunctions.log)."""
+    xp = ctx.xp
+    b = _to_common(ctx, vals[0], T.DOUBLE).data
+    x = _to_common(ctx, vals[1], T.DOUBLE).data
+    return Val(
+        xp.log(xp.where(x <= 0, np.nan, x))
+        / xp.log(xp.where(b <= 0, np.nan, b)),
+        None, T.DOUBLE,
+    )
+
+
+register("log", lambda a: T.DOUBLE, _impl_log_base)
+
+
+def _impl_mod(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    """mod(a, b) with Java remainder semantics (sign follows the
+    dividend); b == 0 -> NULL (masked-eval policy, module docstring)."""
+    xp = ctx.xp
+    ct = _var_numeric_resolve([vals[0].type, vals[1].type])
+    a = _to_common(ctx, vals[0], ct).data
+    b = _to_common(ctx, vals[1], ct).data
+    zero = b == 0
+    safe_b = xp.where(zero, 1, b)
+    if T.is_floating(ct):
+        out = a - xp.trunc(a / safe_b) * safe_b
+    else:
+        # truncation remainder: a - trunc(a/b)*b, via abs-quotient
+        q = xp.abs(a) // xp.abs(safe_b)
+        out = a - xp.sign(a) * q * xp.abs(safe_b)
+    return Val(xp.where(zero, 0, out), zero, ct)
+
+
+register("mod", lambda a: _var_numeric_resolve(a), _impl_mod)
+
+
+def _impl_sign(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    xp = ctx.xp
+    v = vals[0]
+    return Val(xp.sign(v.data).astype(v.data.dtype), None, v.type)
+
+
+register("sign", lambda a: a[0], _impl_sign)
+
+
+def _impl_zero_arg(value):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        return Val(ctx.xp.asarray(np.float64(value)), None, T.DOUBLE)
+
+    return impl
+
+
+register("pi", lambda a: T.DOUBLE, _impl_zero_arg(np.pi))
+register("e", lambda a: T.DOUBLE, _impl_zero_arg(np.e))
+register("infinity", lambda a: T.DOUBLE, _impl_zero_arg(np.inf))
+register("nan", lambda a: T.DOUBLE, _impl_zero_arg(np.nan))
+
+
+def _impl_float_pred(fn):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        xp = ctx.xp
+        x = _to_common(ctx, vals[0], T.DOUBLE).data
+        return Val(fn(xp, x), None, T.BOOLEAN)
+
+    return impl
+
+
+register("is_nan", lambda a: T.BOOLEAN,
+         _impl_float_pred(lambda xp, x: xp.isnan(x)))
+register("is_finite", lambda a: T.BOOLEAN,
+         _impl_float_pred(lambda xp, x: xp.isfinite(x)))
+register("is_infinite", lambda a: T.BOOLEAN,
+         _impl_float_pred(lambda xp, x: xp.isinf(x)))
+
+
+def _impl_width_bucket(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    """width_bucket(x, lo, hi, n) (reference: MathFunctions)."""
+    xp = ctx.xp
+    x = _to_common(ctx, vals[0], T.DOUBLE).data
+    lo = _to_common(ctx, vals[1], T.DOUBLE).data
+    hi = _to_common(ctx, vals[2], T.DOUBLE).data
+    n = _to_common(ctx, vals[3], T.BIGINT).data
+    width = (hi - lo) / xp.maximum(n, 1).astype(xp.float64)
+    raw = xp.floor((x - lo) / xp.where(width == 0, 1.0, width)) + 1
+    out = xp.clip(raw, 0, (n + 1).astype(xp.float64)).astype(np.int64)
+    return Val(out, None, T.BIGINT)
+
+
+register("width_bucket", lambda a: T.BIGINT, _impl_width_bucket)
+
+
+# ------------------------------------------------------------ conditional
+
+def _impl_nullif(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    """NULLIF(a, b): NULL where a = b, else a. Null semantics: a NULL ->
+    NULL; b NULL -> a (equality unknown keeps a). Reuses the comparison
+    kernel so string/dictionary/decimal coercions match `=` exactly."""
+    a, b = vals
+    eq = _impl_cmp("eq")(ctx, T.BOOLEAN, [a, b])
+    xp = ctx.xp
+    b_null = b.nulls if b.nulls is not None else None
+    is_eq = eq.data
+    if b_null is not None:
+        is_eq = is_eq & ~b_null
+    nulls = union_nulls(xp, a.nulls, is_eq)
+    return Val(a.data, nulls, a.type, a.dictionary)
+
+
+register("nullif", lambda a: a[0], _impl_nullif, propagate_nulls=False)
+
+
+# ----------------------------------------------------------------- regexp
+
+def _const_pattern(vals: List[Val], idx: int) -> str:
+    p = vals[idx]
+    if not p.is_const:
+        raise TypeError("regexp pattern must be a constant")
+    return str(p.py_value)
+
+
+def _impl_regexp_like(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    rx = re.compile(_const_pattern(vals, 1))
+    return _dict_predicate(
+        ctx, vals[0], lambda v: rx.search(str(v)) is not None
+    )
+
+
+register("regexp_like", lambda a: T.BOOLEAN, _impl_regexp_like)
+
+
+def _dict_map_nullable(ctx: Ctx, val: Val, fn, rt: T.SqlType) -> Val:
+    """_dict_map variant where fn may return None (SQL NULL): the
+    per-distinct-value null flags gather into a row null mask."""
+    d = _dict_of(val)
+    results = [fn(v) for v in d.values]
+    new = Dictionary(["" if r is None else r for r in results])
+    isnull = np.array([r is None for r in results] or [False], bool)
+    codes = ctx.xp.clip(val.data, 0, max(len(d) - 1, 0))
+    nulls = ctx.xp.asarray(isnull)[codes]
+    return Val(val.data, union_nulls(ctx.xp, val.nulls, nulls), rt, new)
+
+
+def _impl_regexp_extract(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    rx = re.compile(_const_pattern(vals, 1))
+    group = int(vals[2].py_value) if len(vals) > 2 else 0
+
+    def ext(v):
+        m = rx.search(str(v))
+        return m.group(group) if m else None  # no match -> NULL
+
+    return _dict_map_nullable(ctx, vals[0], ext, T.VARCHAR)
+
+
+register("regexp_extract", lambda a: T.VARCHAR, _impl_regexp_extract)
+
+
+def _impl_regexp_replace(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    rx = re.compile(_const_pattern(vals, 1))
+    repl = ""
+    if len(vals) > 2:
+        if not vals[2].is_const:
+            raise TypeError("regexp replacement must be a constant")
+        # Presto uses $1 group refs; Python uses \1
+        repl = re.sub(r"\$(\d+)", r"\\\1", str(vals[2].py_value))
+    return _dict_map(
+        ctx, vals[0], lambda v: rx.sub(repl, str(v)), T.VARCHAR
+    )
+
+
+register("regexp_replace", lambda a: T.VARCHAR, _impl_regexp_replace)
+
+
+# ----------------------------------------------------------------- string
+
+register("length", lambda a: T.BIGINT,
+         lambda ctx, rt, vals: _dict_int(ctx, vals[0],
+                                         lambda v: len(str(v))))
+register("codepoint", lambda a: T.BIGINT,
+         lambda ctx, rt, vals: _dict_int(
+             ctx, vals[0], lambda v: ord(str(v)[0]) if str(v) else 0))
+register("reverse", lambda a: T.VARCHAR,
+         lambda ctx, rt, vals: _dict_map(ctx, vals[0],
+                                         lambda v: str(v)[::-1], rt))
+
+
+def _impl_strpos(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    sub = vals[1]
+    if not sub.is_const:
+        raise TypeError("strpos substring must be a constant")
+    s = str(sub.py_value)
+    return _dict_int(ctx, vals[0], lambda v: str(v).find(s) + 1)
+
+
+register("strpos", lambda a: T.BIGINT, _impl_strpos)
+register("position", lambda a: T.BIGINT, _impl_strpos)
+
+
+def _impl_replace(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    if not (vals[1].is_const and (len(vals) < 3 or vals[2].is_const)):
+        raise TypeError("replace search/replacement must be constants")
+    find = str(vals[1].py_value)
+    repl = str(vals[2].py_value) if len(vals) > 2 else ""
+    return _dict_map(
+        ctx, vals[0], lambda v: str(v).replace(find, repl), T.VARCHAR
+    )
+
+
+register("replace", lambda a: T.VARCHAR, _impl_replace)
+
+
+def _impl_pad(side):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        if not (vals[1].is_const and vals[2].is_const):
+            raise TypeError("lpad/rpad size and padstring must be constants")
+        n = int(vals[1].py_value)
+        pad = str(vals[2].py_value) or " "
+
+        def do(v):
+            s = str(v)
+            if len(s) >= n:
+                return s[:n]
+            fill = (pad * n)[: n - len(s)]
+            return fill + s if side == "l" else s + fill
+
+        return _dict_map(ctx, vals[0], do, T.VARCHAR)
+
+    return impl
+
+
+register("lpad", lambda a: T.VARCHAR, _impl_pad("l"))
+register("rpad", lambda a: T.VARCHAR, _impl_pad("r"))
+
+
+def _impl_split_part(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    if not (vals[1].is_const and vals[2].is_const):
+        raise TypeError("split_part delimiter/index must be constants")
+    delim = str(vals[1].py_value)
+    idx = int(vals[2].py_value)
+
+    def do(v):
+        parts = str(v).split(delim)
+        return parts[idx - 1] if 1 <= idx <= len(parts) else ""
+
+    return _dict_map(ctx, vals[0], do, T.VARCHAR)
+
+
+register("split_part", lambda a: T.VARCHAR, _impl_split_part)
+
+
+# --------------------------------------------------------------- temporal
+
+_US = np.int64(1_000_000)
+_US_DAY = np.int64(86_400_000_000)
+
+
+def _days_and_us(v: Val):
+    """(days, intraday microseconds, is_timestamp) from a date/ts Val."""
+    if isinstance(v.type, T.TimestampType):
+        days = (v.data // _US_DAY).astype(np.int32)
+        return days, v.data - days.astype(np.int64) * _US_DAY, True
+    return v.data, None, False
+
+
+def _impl_date_trunc(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    if not vals[0].is_const:
+        raise TypeError("date_trunc unit must be a constant")
+    unit = str(vals[0].py_value).lower()
+    xp = ctx.xp
+    v = vals[1]
+    days, us, is_ts = _days_and_us(v)
+    if unit in ("hour", "minute", "second", "millisecond"):
+        if not is_ts:
+            return Val(v.data, None, v.type, v.dictionary)
+        q = {"hour": np.int64(3_600_000_000),
+             "minute": np.int64(60_000_000),
+             "second": _US,
+             "millisecond": np.int64(1000)}[unit]
+        return Val(v.data - (v.data % q), None, v.type)
+    y, m, _d = civil_from_days(xp, days)
+    one = xp.ones_like(y)
+    if unit == "day":
+        out_days = days.astype(np.int64)
+    elif unit == "week":
+        out_days = days.astype(np.int64) - (
+            (days.astype(np.int64) + np.int64(3)) % np.int64(7)
+        )
+    elif unit == "month":
+        out_days = days_from_civil(xp, y, m, one)
+    elif unit == "quarter":
+        qm = ((m - 1) // np.int64(3)) * np.int64(3) + np.int64(1)
+        out_days = days_from_civil(xp, y, qm, one)
+    elif unit == "year":
+        out_days = days_from_civil(xp, y, one, one)
+    else:
+        raise ValueError(f"date_trunc unit {unit!r}")
+    if is_ts:
+        return Val(out_days * _US_DAY, None, v.type)
+    return Val(out_days.astype(v.data.dtype), None, v.type)
+
+
+register("date_trunc", lambda a: a[1], _impl_date_trunc)
+
+
+def _impl_date_add(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    if not vals[0].is_const:
+        raise TypeError("date_add unit must be a constant")
+    unit = str(vals[0].py_value).lower()
+    xp = ctx.xp
+    n = _to_common(ctx, vals[1], T.BIGINT).data
+    v = vals[2]
+    days, us, is_ts = _days_and_us(v)
+    if unit in ("hour", "minute", "second", "millisecond"):
+        if not is_ts:
+            raise TypeError(f"date_add({unit}) over DATE")
+        q = {"hour": np.int64(3_600_000_000),
+             "minute": np.int64(60_000_000),
+             "second": _US,
+             "millisecond": np.int64(1000)}[unit]
+        return Val(v.data + n * q, None, v.type)
+    if unit in ("day", "week"):
+        k = np.int64(7) if unit == "week" else np.int64(1)
+        out_days = days.astype(np.int64) + n * k
+    elif unit in ("month", "quarter", "year"):
+        k = {"month": 1, "quarter": 3, "year": 12}[unit]
+        out_days = add_months_to_days(
+            xp, days.astype(np.int64), n * np.int64(k)
+        )
+    else:
+        raise ValueError(f"date_add unit {unit!r}")
+    if is_ts:
+        return Val(out_days * _US_DAY + us, None, v.type)
+    return Val(out_days.astype(v.data.dtype), None, v.type)
+
+
+register("date_add", lambda a: a[2], _impl_date_add)
+
+
+def _impl_date_diff(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    """date_diff(unit, a, b) = complete units from a to b (reference:
+    DateTimeFunctions via Joda *.between — counts whole periods)."""
+    if not vals[0].is_const:
+        raise TypeError("date_diff unit must be a constant")
+    unit = str(vals[0].py_value).lower()
+    xp = ctx.xp
+    a, b = vals[1], vals[2]
+    da, ua, a_ts = _days_and_us(a)
+    db, ub, b_ts = _days_and_us(b)
+    usa = da.astype(np.int64) * _US_DAY + (ua if ua is not None else 0)
+    usb = db.astype(np.int64) * _US_DAY + (ub if ub is not None else 0)
+    if unit in ("hour", "minute", "second", "millisecond", "day", "week"):
+        # complete elapsed units, truncated toward zero (Joda *.between):
+        # day/week over timestamps count whole 24h/168h periods, not
+        # calendar-day boundaries
+        q = {"hour": np.int64(3_600_000_000),
+             "minute": np.int64(60_000_000),
+             "second": _US,
+             "millisecond": np.int64(1000),
+             "day": _US_DAY,
+             "week": _US_DAY * np.int64(7)}[unit]
+        delta = usb - usa
+        out = xp.sign(delta) * (xp.abs(delta) // q)
+        return Val(out, None, T.BIGINT)
+    if unit in ("month", "quarter", "year"):
+        ya, ma, dda = civil_from_days(xp, da)
+        yb, mb, ddb = civil_from_days(xp, db)
+        months = (yb.astype(np.int64) - ya.astype(np.int64)) * 12 + (
+            mb.astype(np.int64) - ma.astype(np.int64)
+        )
+        # incomplete final month doesn't count (Joda monthsBetween)
+        incomplete = xp.where(
+            months > 0, ddb < dda, xp.where(months < 0, ddb > dda, False)
+        )
+        months = months - xp.where(
+            incomplete, xp.sign(months), np.int64(0)
+        )
+        k = {"month": 1, "quarter": 3, "year": 12}[unit]
+        return Val(months // np.int64(k) if k == 1 else
+                   xp.sign(months) * (xp.abs(months) // np.int64(k)),
+                   None, T.BIGINT)
+    raise ValueError(f"date_diff unit {unit!r}")
+
+
+register("date_diff", lambda a: T.BIGINT, _impl_date_diff)
+
+
+def _impl_from_unixtime(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    x = _to_common(ctx, vals[0], T.DOUBLE).data
+    return Val((x * 1e6).astype(np.int64), None, T.TIMESTAMP)
+
+
+def _impl_to_unixtime(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    return Val(vals[0].data.astype(np.float64) / 1e6, None, T.DOUBLE)
+
+
+register("from_unixtime", lambda a: T.TIMESTAMP, _impl_from_unixtime)
+register("to_unixtime", lambda a: T.DOUBLE, _impl_to_unixtime)
+
+
+for _part in ("hour", "minute", "second", "millisecond"):
+    def _impl_ts_part(part=_part):
+        def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+            v = vals[0]
+            if not isinstance(v.type, T.TimestampType):
+                raise TypeError(f"{part}() over {v.type}")
+            q = {"hour": (np.int64(3_600_000_000), np.int64(24)),
+                 "minute": (np.int64(60_000_000), np.int64(60)),
+                 "second": (_US, np.int64(60)),
+                 "millisecond": (np.int64(1000), np.int64(1000))}[part]
+            return Val((v.data // q[0]) % q[1], None, T.BIGINT)
+
+        return impl
+
+    register(_part, lambda a: T.BIGINT, _impl_ts_part())

@@ -1,0 +1,168 @@
+"""Round-3 function-breadth batch: SQL-level checks of the new scalar
+builtins (math/regexp/string/temporal/conditional) against Python-
+computed expectations over tiny generated tables.
+
+Reference test pattern: presto-main operator/scalar/* TestNN classes
+assert single expressions via FunctionAssertions; our analog drives the
+whole engine (parse -> plan -> jit) per expression, so coverage here
+also exercises type resolution and constant handling end to end.
+"""
+
+import datetime
+import math
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runner import LocalRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalRunner(
+        {"tpch": TpchConnector(0.001)}, page_rows=1 << 12
+    )
+
+
+def one(runner, expr, frm="region"):
+    rows = runner.execute(f"select {expr} from {frm} limit 1").rows
+    return rows[0][0]
+
+
+@pytest.mark.parametrize("expr,want", [
+    ("log2(8e0)", 3.0),
+    ("log10(1000e0)", 3.0),
+    ("log(3e0, 81e0)", 4.0),
+    ("cbrt(-27e0)", -3.0),
+    ("mod(10, 3)", 1),
+    ("mod(-10, 3)", -1),
+    ("sign(-5)", -1),
+    ("truncate(-1.7e0)", -1.0),
+    ("degrees(pi())", 180.0),
+    ("width_bucket(5e0, 0e0, 10e0, 5)", 3),
+    ("atan2(1e0, 1e0)", math.pi / 4),
+    ("is_nan(nan())", True),
+    ("is_finite(infinity())", False),
+    ("is_infinite(infinity())", True),
+])
+def test_math(runner, expr, want):
+    got = one(runner, expr)
+    if isinstance(want, float):
+        assert got == pytest.approx(want, rel=1e-12), expr
+    else:
+        assert got == want, expr
+
+
+def test_trig(runner):
+    assert one(runner, "sin(0e0)") == 0.0
+    assert one(runner, "cos(0e0)") == 1.0
+    assert one(runner, "tanh(0e0)") == 0.0
+    assert one(runner, "acos(1e0)") == 0.0
+
+
+def test_mod_by_zero_is_null(runner):
+    assert one(runner, "mod(10, 0)") is None
+
+
+def test_nullif(runner):
+    assert one(runner, "nullif(3, 3)") is None
+    assert one(runner, "nullif(3, 4)") == 3
+    assert one(runner, "nullif(r_name, 'AFRICA')",
+               "region where r_regionkey = 0") is None
+    assert one(runner, "nullif(r_name, 'ASIA')",
+               "region where r_regionkey = 0") == "AFRICA"
+
+
+def test_regexp(runner):
+    assert one(runner, "regexp_like(r_name, '^AF')",
+               "region where r_regionkey = 0") is True
+    assert one(runner, "regexp_like(r_name, 'ZZZ')",
+               "region where r_regionkey = 0") is False
+    assert one(runner, "regexp_extract(r_name, '([A-Z]+)ICA', 1)",
+               "region where r_regionkey = 0") == "AFR"
+    assert one(runner, "regexp_replace(r_name, 'AFR', 'X')",
+               "region where r_regionkey = 0") == "XICA"
+
+
+def test_regexp_extract_no_match_is_null(runner):
+    assert one(runner, "regexp_extract(r_name, 'ZZZ')",
+               "region where r_regionkey = 0") is None
+
+
+def test_date_diff_truncates_toward_zero(runner):
+    # 2h elapsed across a midnight boundary: 0 complete days, not 1;
+    # negative diffs truncate toward zero (-1h30 -> -1 hour, not -2)
+    rows = runner.execute(
+        "select date_diff('day', from_unixtime(82800e0), "
+        "from_unixtime(90000e0)), "
+        "date_diff('hour', from_unixtime(5400e0), from_unixtime(0e0)) "
+        "from region limit 1"
+    ).rows
+    assert rows[0] == (0, -1)
+
+
+def test_string_batch(runner):
+    frm = "region where r_regionkey = 0"  # AFRICA
+    assert one(runner, "length(r_name)", frm) == 6
+    assert one(runner, "reverse(r_name)", frm) == "ACIRFA"
+    assert one(runner, "strpos(r_name, 'RIC')", frm) == 3
+    assert one(runner, "strpos(r_name, 'ZZ')", frm) == 0
+    assert one(runner, "replace(r_name, 'AFR', 'AMER')", frm) == "AMERICA"
+    assert one(runner, "lpad(r_name, 8, '*')", frm) == "**AFRICA"
+    assert one(runner, "rpad(r_name, 8, '*')", frm) == "AFRICA**"
+    assert one(runner, "split_part(r_name, 'R', 1)", frm) == "AF"
+    assert one(runner, "codepoint(r_name)", frm) == ord("A")
+
+
+def test_temporal_batch(runner):
+    # o_orderdate values are real dates; compare against Python math
+    rows = runner.execute(
+        "select o_orderdate, date_trunc('month', o_orderdate), "
+        "date_trunc('year', o_orderdate), "
+        "date_add('day', 31, o_orderdate), "
+        "date_add('month', 2, o_orderdate), "
+        "date_diff('day', o_orderdate, date_add('day', 45, o_orderdate)),"
+        "date_diff('month', o_orderdate, date_add('day', 65, o_orderdate))"
+        " from orders limit 50"
+    ).rows
+    epoch = datetime.date(1970, 1, 1)
+
+    def day(v):
+        return epoch + datetime.timedelta(days=int(v))
+
+    for (d, tm, ty, plus31, plus2m, diff45, diffm) in rows:
+        base = day(d)
+        assert day(tm) == base.replace(day=1)
+        assert day(ty) == base.replace(month=1, day=1)
+        assert day(plus31) == base + datetime.timedelta(days=31)
+        m0 = base.month - 1 + 2
+        y, m = base.year + m0 // 12, m0 % 12 + 1
+        import calendar
+
+        dd = min(base.day, calendar.monthrange(y, m)[1])
+        assert day(plus2m) == datetime.date(y, m, dd)
+        assert diff45 == 45
+        plus65 = base + datetime.timedelta(days=65)
+        months = (plus65.year - base.year) * 12 + (
+            plus65.month - base.month
+        )
+        if plus65.day < base.day:
+            months -= 1
+        assert diffm == months, (d, diffm, months)
+
+
+def test_week_trunc_is_monday(runner):
+    rows = runner.execute(
+        "select date_trunc('week', o_orderdate) from orders limit 20"
+    ).rows
+    epoch = datetime.date(1970, 1, 1)
+    for (d,) in rows:
+        monday = epoch + datetime.timedelta(days=int(d))
+        assert monday.weekday() == 0
+
+
+def test_unixtime_roundtrip(runner):
+    rows = runner.execute(
+        "select to_unixtime(from_unixtime(1456e0)) from region limit 1"
+    ).rows
+    assert rows[0][0] == pytest.approx(1456.0)
