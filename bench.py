@@ -40,21 +40,35 @@ import sys
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-# (rung name, suite, query id, scale factor). BASELINE.md ramp order.
+# (rung name, suite, query id, scale factor, session props).
+# BASELINE.md ramp order.
+#
+# The SF10 join rungs carry spill/partitioning props: grace-style
+# partition passes + the max_join_build_rows kernel-size ceiling keep
+# every device buffer under the axon >=4M-row fault line, and the
+# PageStore materialization keeps partition passes from compounding
+# recomputation down the join pipeline (round-3 executor work).
+SF10_PROPS = (
+    "spill_threshold_bytes=268435456",
+    "max_join_build_rows=1048576",
+)
 RUNGS = [
-    ("q1_sf1", "tpch", 1, 1.0),
-    ("q6_sf1", "tpch", 6, 1.0),
-    ("q3_sf01", "tpch", 3, 0.1),
-    ("q1_sf10", "tpch", 1, 10.0),
-    ("q6_sf10", "tpch", 6, 10.0),
-    # q3 at SF1 became runnable once join-output capacities stopped
-    # compounding (oc clamp) and partial-agg pages fold incrementally —
-    # both keep every buffer under the axon >=4M-row fault line. SF10
-    # still needs host-side re-streamable intermediates (next round).
-    ("q3_sf1", "tpch", 3, 1.0),
+    ("q1_sf1", "tpch", 1, 1.0, ()),
+    ("q6_sf1", "tpch", 6, 1.0, ()),
+    ("q3_sf01", "tpch", 3, 0.1, ()),
+    ("q1_sf10", "tpch", 1, 10.0, ()),
+    ("q6_sf10", "tpch", 6, 10.0, ()),
+    ("q3_sf1", "tpch", 3, 1.0, ()),
+    # BASELINE rung 4 family: Q5 became plannable at scale once the
+    # join tree orders FK-safe (unique-key) builds first — the
+    # c_nationkey fan-out join is gone (sql/planner.py
+    # _build_join_tree)
+    ("q5_sf1", "tpch", 5, 1.0, ()),
+    ("q3_sf10", "tpch", 3, 10.0, SF10_PROPS),
+    ("q5_sf10", "tpch", 5, 10.0, SF10_PROPS),
     # BASELINE rung 5 (TPC-DS). SF0.25 keeps the largest join build
     # (store_returns, next_pow2 of 1.32M slots) under the same line.
-    ("q17_sf025", "tpcds", 17, 0.25),
+    ("q17_sf025", "tpcds", 17, 0.25, ()),
 ]
 HEADLINE = "q1_sf1"
 ORACLE_SF = 0.01  # small-SF correctness cross-check (fast)
@@ -77,6 +91,14 @@ QUERY_COLS = {
                    "o_shippriority"],
         "lineitem": ["l_orderkey", "l_extendedprice", "l_discount",
                      "l_shipdate"]},
+    ("tpch", 5): {
+        "customer": ["c_custkey", "c_nationkey"],
+        "orders": ["o_orderkey", "o_custkey", "o_orderdate"],
+        "lineitem": ["l_orderkey", "l_suppkey", "l_extendedprice",
+                     "l_discount"],
+        "supplier": ["s_suppkey", "s_nationkey"],
+        "nation": ["n_nationkey", "n_name", "n_regionkey"],
+        "region": ["r_regionkey", "r_name"]},
     ("tpcds", 17): {
         "store_sales": ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
                         "ss_store_sk", "ss_ticket_number", "ss_quantity"],
@@ -151,11 +173,11 @@ def main() -> int:
         print(f"# timing child incomplete: {err}", file=sys.stderr)
 
     # ---- phase 2: per-rung validation children
-    for name, suite, qid, sf in RUNGS:
+    for name, suite, qid, sf, props in RUNGS:
         info, err = _run_child(
             [sys.executable,
              os.path.join(REPO, "tools", "validate_rung.py"),
-             suite, str(qid), str(sf)],
+             suite, str(qid), str(sf), *props],
             timeout=1800,
         )
         r = details["rungs"].setdefault(name, {})
@@ -192,7 +214,7 @@ def main() -> int:
         env={"JAX_PLATFORMS": "cpu"},
     )
     cache = info or {}
-    for name, suite, qid, sf in RUNGS:
+    for name, suite, qid, sf, _props in RUNGS:
         prefix = "" if suite == "tpch" else f"{suite}_"
         key = f"{prefix}q{qid}_sf{sf}"
         r = details["rungs"][name]
@@ -216,10 +238,41 @@ def main() -> int:
 # -------------------------------------------------------------- children
 
 
+# HBM bandwidth of one v5e chip, for the efficiency metric
+HBM_GBPS = 819.0
+# rungs that get the device-resident (memory-connector analog) timing:
+# scan = HBM read, separating data generation from query compute
+RESIDENT = {"q1_sf1", "q6_sf1", "q1_sf10", "q6_sf10"}
+
+
+def _col_byte_width(t) -> int:
+    import numpy as np
+
+    from presto_tpu import types as T
+
+    if T.is_string(t):
+        return 4  # dictionary codes
+    if isinstance(t, T.DecimalType) and not t.is_short:
+        return 16
+    try:
+        return np.dtype(t.numpy_dtype).itemsize
+    except Exception:
+        return 8
+
+
 def time_child() -> int:
     """Compile + timed device runs for every rung; ZERO device->host
     reads until all timing is written, then the deferred overflow flags
-    are read (slow/hung reads can no longer hurt the numbers)."""
+    are read (slow/hung reads can no longer hurt the numbers).
+
+    Attribution per rung (VERDICT r2 #3): gen_s times the on-device
+    generation of exactly the columns the query touches (scan==generate
+    for the generator connectors, SURVEY §8.2.6), so steady_s can be
+    read as generation + query compute. resident_steady_s (RESIDENT
+    rungs) times the query over a device-resident page cache — the
+    memory-connector analog where a scan is an HBM read — with
+    touched_gb / eff_gbps / pct_hbm quantifying how close the query
+    kernel runs to the chip's HBM bandwidth."""
     import statistics
     import time
 
@@ -230,20 +283,27 @@ def time_child() -> int:
                "device": str(jax.devices()[0])}
     runners = {}
 
-    def runner_for(suite, sf):
-        if (suite, sf) not in runners:
-            runners[(suite, sf)] = make_runner(suite, sf)
-        return runners[(suite, sf)]
+    def runner_for(suite, sf, props):
+        key = (suite, sf, props)
+        if key not in runners:
+            runners[key] = make_runner(suite, sf, props)
+        return runners[key]
 
-    for name, suite, qid, sf in RUNGS:
-        runner = runner_for(suite, sf)
+    profile_dir = (
+        os.path.join(REPO, "bench_profile")
+        if os.environ.get("BENCH_PROFILE") else None
+    )
+
+    for name, suite, qid, sf, props in RUNGS:
+        runner = runner_for(suite, sf, props)
         ex = runner.executor
         plan = runner.plan(queries(suite)[qid])
 
-        def run_device():
+        def run_device(ex=ex, plan=plan):
             ex._pending_overflow = []
             pages = list(ex.pages(plan))
             jax.block_until_ready(jax.tree_util.tree_leaves(pages))
+            ex._stream_cache = {}  # free materialized intermediates
 
         t0 = time.time()
         run_device()
@@ -254,22 +314,110 @@ def time_child() -> int:
             run_device()
             times.append(time.time() - t0)
         steady = statistics.median(times)
+        if profile_dir and name == HEADLINE:
+            with jax.profiler.trace(profile_dir):
+                run_device()
         table = "lineitem" if suite == "tpch" else "store_sales"
         slots_in = runner.catalogs[suite].row_count(table)
-        details["rungs"][name] = {
+        r = {
             "suite": suite,
             "query": qid,
             "sf": sf,
+            "props": list(props),
             "compile_s": round(compile_s, 3),
             "steady_s": round(steady, 5),
             "times_s": [round(t, 5) for t in times],
             "fact_slots": slots_in,
             "slots_per_s": round(slots_in / steady),
         }
+        details["rungs"][name] = r
         print(f"# {name}: steady {steady*1e3:.1f} ms "
               f"({slots_in/steady/1e6:.0f}M slots/s), "
               f"compile {compile_s:.0f}s", file=sys.stderr)
         _write_details(details)
+
+        # ---- generation-only attribution
+        cols = QUERY_COLS.get((suite, qid))
+        if cols:
+            conn = runner.catalogs[suite]
+            page_rows = int(runner.session.get("page_rows"))
+            touched = 0
+            for t, cs in cols.items():
+                schema = conn.table_schema(t)
+                touched += conn.row_count(t) * sum(
+                    _col_byte_width(schema.column_type(c)) for c in cs
+                )
+
+            def run_gen(conn=conn, cols=cols, page_rows=page_rows):
+                for t, cs in cols.items():
+                    pages = list(
+                        conn.pages(t, cs, target_rows=page_rows)
+                    )
+                    jax.block_until_ready(
+                        jax.tree_util.tree_leaves(pages)
+                    )
+
+            t0 = time.time()
+            run_gen()
+            gen_compile = time.time() - t0
+            gtimes = []
+            for _ in range(3):
+                t0 = time.time()
+                run_gen()
+                gtimes.append(time.time() - t0)
+            gen_s = statistics.median(gtimes)
+            r["gen_s"] = round(gen_s, 5)
+            r["gen_compile_s"] = round(gen_compile, 3)
+            r["touched_gb"] = round(touched / 1e9, 3)
+            r["gen_gbps"] = round(touched / gen_s / 1e9, 2)
+            r["eff_gbps"] = round(touched / steady / 1e9, 2)
+            r["pct_hbm"] = round(
+                100.0 * touched / steady / 1e9 / HBM_GBPS, 2
+            )
+            print(f"# {name}: gen {gen_s*1e3:.1f} ms "
+                  f"({r['gen_gbps']} GB/s), query+gen eff "
+                  f"{r['eff_gbps']} GB/s = {r['pct_hbm']}% HBM",
+                  file=sys.stderr)
+            _write_details(details)
+
+        # ---- device-resident (memory-connector analog) timing
+        if name in RESIDENT:
+            rr = make_runner(suite, sf, props, cached=True)
+            rex = rr.executor
+            rplan = rr.plan(queries(suite)[qid])
+
+            def run_res(rex=rex, rplan=rplan):
+                rex._pending_overflow = []
+                pages = list(rex.pages(rplan))
+                jax.block_until_ready(jax.tree_util.tree_leaves(pages))
+                rex._stream_cache = {}
+
+            t0 = time.time()
+            run_res()  # fills the page cache + compiles
+            res_first = time.time() - t0
+            rtimes = []
+            for _ in range(REPS):
+                t0 = time.time()
+                run_res()
+                rtimes.append(time.time() - t0)
+            res_steady = statistics.median(rtimes)
+            r["resident_first_s"] = round(res_first, 3)
+            r["resident_steady_s"] = round(res_steady, 5)
+            r["resident_slots_per_s"] = round(slots_in / res_steady)
+            if cols:
+                r["resident_eff_gbps"] = round(
+                    touched / res_steady / 1e9, 2
+                )
+                r["resident_pct_hbm"] = round(
+                    100.0 * touched / res_steady / 1e9 / HBM_GBPS, 2
+                )
+            print(f"# {name}: resident steady "
+                  f"{res_steady*1e3:.1f} ms "
+                  f"({slots_in/res_steady/1e6:.0f}M slots/s"
+                  + (f", {r['resident_pct_hbm']}% HBM" if cols else "")
+                  + ")", file=sys.stderr)
+            del rr, rex, rplan  # free the cached pages
+            _write_details(details)
 
     # overflow detection is delegated to the validator children: they
     # re-execute each rung's plan at the SAME initial capacities, so a
@@ -290,7 +438,7 @@ def oracle_child() -> int:
         from tools._common import configure_jax, make_runner
 
         configure_jax()
-        suite_qids = sorted({(s, q) for _, s, q, _ in RUNGS})
+        suite_qids = sorted({(s, q) for _, s, q, _, _ in RUNGS})
         runner = make_runner("tpch", ORACLE_SF)
         db = load_sqlite(runner.catalogs["tpch"],
                          runner.catalogs["tpch"].tables())
@@ -398,7 +546,7 @@ def sqlite_child() -> int:
 
         return ds_oracle(qid)[0]
 
-    for name, suite, qid, sf in RUNGS:
+    for name, suite, qid, sf, _props in RUNGS:
         prefix = "" if suite == "tpch" else f"{suite}_"
         key = f"{prefix}q{qid}_sf{sf}"
         if cache.get(key) is not None or sf > MAX_SQLITE_SF:

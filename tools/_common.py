@@ -21,15 +21,22 @@ def configure_jax():
     return jax
 
 
-def make_runner(suite: str, sf: float, props=()):
+def make_runner(suite: str, sf: float, props=(), cached: bool = False):
     """LocalRunner over the named generator suite with k=v session
-    properties applied to both the session and the live executor."""
+    properties applied to both the session and the live executor.
+    cached=True wraps the connector in the device-resident page cache
+    (scan = HBM read after the first streaming, the memory-connector
+    analog) for generate-vs-query attribution."""
+    from presto_tpu.connectors.cached import CachingConnector
     from presto_tpu.connectors.tpcds import TpcdsConnector
     from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.runner import LocalRunner
 
     cls = TpchConnector if suite == "tpch" else TpcdsConnector
-    runner = LocalRunner({suite: cls(scale=sf)}, default_catalog=suite)
+    conn = cls(scale=sf)
+    if cached:
+        conn = CachingConnector(conn)
+    runner = LocalRunner({suite: conn}, default_catalog=suite)
     for kv in props:
         k, v = kv.split("=", 1)
         runner.session.set(k, v)
@@ -42,6 +49,12 @@ def make_runner(suite: str, sf: float, props=()):
     )
     ex.spill_bytes = (
         int(runner.session.get("spill_threshold_bytes")) or None
+    )
+    ex.host_spill_bytes = (
+        int(runner.session.get("host_spill_bytes")) or None
+    )
+    ex.max_build_rows = (
+        int(runner.session.get("max_join_build_rows")) or None
     )
     return runner
 
