@@ -1,0 +1,54 @@
+"""Split-range filtering for multi-process (DCN) scans.
+
+Reference: SOURCE_DISTRIBUTION split assignment — the coordinator's
+SourcePartitionedScheduler streams each split to exactly one task
+(presto-main execution/scheduler/SourcePartitionedScheduler.java).
+The TPU translation assigns the designated fact table's splits
+round-robin by worker index; every other table scans whole (the
+broadcast-build / split-probe shape that keeps FK joins exact under
+data parallelism). Generator connectors make a worker's scan of its
+splits free of other workers' data by construction (scan==generate).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from presto_tpu.connectors.base import Split
+
+
+class SplitFilterConnector:
+    """Wraps a connector; worker ``index`` of ``count`` sees only its
+    round-robin share of ``table``'s splits."""
+
+    def __init__(self, inner, table: str, index: int, count: int):
+        self._inner = inner
+        self._table = table
+        self._index = index
+        self._count = count
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def splits(self, table: str, target_rows: int):
+        splits = self._inner.splits(table, target_rows)
+        if table != self._table:
+            return splits
+        mine = splits[self._index::self._count]
+        return mine or [Split(table, 0, 0)]
+
+    def pages(
+        self,
+        table: str,
+        columns: Optional[Sequence[str]] = None,
+        target_rows: int = 1 << 20,
+        constraint=None,
+    ):
+        # must re-implement (not delegate): the inner pages() would call
+        # the inner splits() and bypass the filter
+        splits = self.splits(table, target_rows)
+        if constraint:
+            splits = self._inner.prune_splits(table, splits, constraint)
+        for split in splits:
+            if split.row_count:
+                yield self._inner.page_for_split(split, columns)

@@ -1,0 +1,211 @@
+"""DCN coordinator: multi-process query execution over localhost (or
+any network) workers.
+
+Reference: the coordinator half of distributed execution —
+server/remotetask/HttpRemoteTask.java (task create + status),
+operator/ExchangeClient.java + HttpPageBufferClient.java (token-acked
+page fetch with retries), metadata/DiscoveryNodeManager +
+failureDetector/HeartbeatFailureDetector (peer liveness).
+
+TPU-native shape (SURVEY §6.8): ICI-scale parallelism stays INSIDE a
+worker process as compiled collectives; this layer is the DCN half —
+processes exchange serialized pages over HTTP exactly where the
+reference does, but only at the PARTIAL/FINAL aggregation boundary:
+
+    worker w: scan(splits w::K of fact table) -> ... -> partial agg
+              -> serialized state pages
+    coordinator: RemoteSource(all workers) -> final agg -> rest of plan
+
+Plan distribution is by REPLAY, not shipping: the worker re-plans the
+same SQL with the same deterministic planner and takes the same cut
+(fragment identity = (sql, role); divergence from the reference's
+serialized PlanFragment, documented in server/worker.py).
+
+Failure model matches the reference: a worker death or exhausted fetch
+retries fails the QUERY cleanly (no task-level recovery; SURVEY §6.3),
+while the heartbeat detector tracks liveness for scheduling decisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Dict, List, Optional
+
+from presto_tpu.dist import serde
+from presto_tpu.exec import plan as P
+from presto_tpu.server.heartbeat import HeartbeatFailureDetector
+from presto_tpu.server.worker import (
+    fanout_safe,
+    find_partial_cut,
+    largest_table,
+)
+
+
+class DcnQueryFailed(RuntimeError):
+    """Query-level failure (reference: the fail-query-and-let-the-
+    client-retry model — no task-level recovery)."""
+
+
+def _replace_node(root, target, repl):
+    """Structural replace of one subtree in a frozen plan tree."""
+    if root is target:
+        return repl
+    changes = {}
+    for f in dataclasses.fields(root):
+        v = getattr(root, f.name)
+        if isinstance(v, P.PhysicalNode):
+            nv = _replace_node(v, target, repl)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple) and v and isinstance(
+            v[0], P.PhysicalNode
+        ):
+            nv = tuple(_replace_node(x, target, repl) for x in v)
+            if any(a is not b for a, b in zip(nv, v)):
+                changes[f.name] = nv
+    return dataclasses.replace(root, **changes) if changes else root
+
+
+class DcnRunner:
+    """Coordinator over N worker processes (single fat workers each).
+
+    execute(sql) returns (names, rows) like LocalRunner.execute's
+    underlying executor, with the heavy PARTIAL pipeline fanned out.
+    """
+
+    def __init__(self, catalogs, worker_uris: List[str], *,
+                 default_catalog: Optional[str] = None,
+                 page_rows: int = 1 << 16,
+                 fetch_retries: int = 3,
+                 session_props: Optional[Dict] = None):
+        from presto_tpu.runner import LocalRunner
+        from presto_tpu.session import Session
+
+        self.worker_uris = list(worker_uris)
+        self.fetch_retries = fetch_retries
+        self.session_props = dict(session_props or {})
+        cat = default_catalog or next(iter(catalogs))
+        self.runner = LocalRunner(
+            catalogs,
+            page_rows=page_rows,
+            default_catalog=cat,
+            session=Session(catalog=cat,
+                            properties=self.session_props),
+        )
+        self.heartbeat = HeartbeatFailureDetector(
+            [f"{u}" for u in self.worker_uris]
+        )
+
+    # --------------------------------------------------------- protocol
+    def _post_task(self, uri: str, payload: Dict) -> Dict:
+        req = urllib.request.Request(
+            f"{uri}/v1/task",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read().decode())
+
+    def _fetch_pages(self, uri: str, task_id: str):
+        """Token-acked page fetch with bounded retries (the
+        HttpPageBufferClient protocol: at-least-once + dedupe by
+        token)."""
+        token = 0
+        while True:
+            attempt = 0
+            while True:
+                try:
+                    req = urllib.request.Request(
+                        f"{uri}/v1/task/{task_id}/results/{token}"
+                    )
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        if r.status == 204:
+                            if r.headers.get("X-Done") == "1":
+                                return
+                            break  # long-poll timeout; re-ask
+                        body = r.read()
+                        token = int(r.headers["X-Next-Token"])
+                        yield serde.deserialize_page(body)
+                        break
+                except (urllib.error.URLError, urllib.error.HTTPError,
+                        ConnectionError, OSError) as e:
+                    attempt += 1
+                    if attempt > self.fetch_retries:
+                        raise DcnQueryFailed(
+                            f"worker {uri} task {task_id}: page fetch "
+                            f"failed after {self.fetch_retries} "
+                            f"retries: {e}"
+                        ) from e
+                    time.sleep(0.1 * attempt)
+
+    # ---------------------------------------------------------- execute
+    def execute(self, sql: str):
+        plan = self.runner.plan(sql)
+        cut = find_partial_cut(plan)
+        if cut is None:
+            # no aggregation boundary: run locally (out of DCN scope)
+            return self.runner.execute(sql).rows
+        ex = self.runner.executor
+        split_table = largest_table(cut.source, self.runner.catalogs)
+        if split_table is None or not fanout_safe(cut, split_table):
+            # non-decomposable shape (DISTINCT masks, outer/semi joins,
+            # self-joins of the fact table, nested aggs): run locally
+            # rather than wrong
+            return self.runner.execute(sql).rows
+        # coordinator-side final stage honors the same session the
+        # workers were sent
+        self.runner.apply_session()
+
+        # launch one task per worker
+        qid = uuid.uuid4().hex[:12]
+        tasks = []
+        for w, uri in enumerate(self.worker_uris):
+            payload = {
+                "taskId": f"{qid}.{w}",
+                "sql": sql,
+                "splitTable": split_table,
+                "splitIndex": w,
+                "splitCount": len(self.worker_uris),
+                "session": self.session_props,
+            }
+            try:
+                self._post_task(uri, payload)
+            except (urllib.error.URLError, OSError) as e:
+                raise DcnQueryFailed(
+                    f"worker {uri}: task submit failed: {e}"
+                ) from e
+            tasks.append((uri, f"{qid}.{w}"))
+
+        # coordinator-side plan: PARTIAL subtree -> RemoteSource
+        partial = dataclasses.replace(cut, step="partial")
+        state_types = tuple(ex.output_types(partial))
+        key = f"dcn-{qid}"
+        remote = P.RemoteSource(types=state_types, key=key,
+                                origin=partial)
+        final = dataclasses.replace(cut, step="final", source=remote)
+        coord_plan = _replace_node(plan, cut, final)
+
+        def supplier():
+            for uri, task_id in tasks:
+                yield from self._fetch_pages(uri, task_id)
+
+        ex.remote_sources[key] = supplier
+        try:
+            _, rows = ex.execute(coord_plan)
+            return rows
+        finally:
+            ex.remote_sources.pop(key, None)
+            # release worker-side page buffers (reference: task expiry)
+            for uri, task_id in tasks:
+                try:
+                    req = urllib.request.Request(
+                        f"{uri}/v1/task/{task_id}", method="DELETE"
+                    )
+                    urllib.request.urlopen(req, timeout=5).close()
+                except (urllib.error.URLError, OSError):
+                    pass  # dead worker: nothing to free

@@ -1,0 +1,114 @@
+"""Page wire format for the DCN (inter-process) boundary.
+
+Reference: presto-main execution/buffer/PagesSerde.java +
+SerializedPage (block-encoded pages, LZ4, length-prefixed) fetched by
+operator/HttpPageBufferClient.java. The TPU translation keeps raw
+arrays on ICI (collectives inside compiled programs, dist/executor.py)
+and serializes ONLY at the process boundary, exactly as SURVEY §6.8
+prescribes: "the HTTP shapes survive only at the pod boundary".
+
+Format (little-endian, zlib-compressed payload):
+    header: JSON {blocks: [{dtype(s), has_nulls, dictionary?, type}],
+            capacity} + per-array raw bytes, length-prefixed.
+Types are reconstructed by name through presto_tpu.types; dictionaries
+ship as JSON value lists (content-equal on arrival — Dictionary hashes
+by content).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Iterator, List
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.page import Block, Dictionary, Page
+
+_MAGIC = b"PTP1"
+
+
+def _type_to_json(t: T.SqlType):
+    return t.display()
+
+
+def _type_from_json(s: str) -> T.SqlType:
+    return T.parse_type(s)
+
+
+def _arrays_of(block: Block) -> List[np.ndarray]:
+    datas = block.data if isinstance(block.data, tuple) else (block.data,)
+    return [np.asarray(d) for d in datas]
+
+
+def serialize_page(page: Page) -> bytes:
+    """One Page -> bytes (the SerializedPage analog)."""
+    header = {"capacity": int(page.capacity), "blocks": []}
+    payload = bytearray()
+
+    def put(arr: np.ndarray):
+        b = np.ascontiguousarray(arr).tobytes()
+        payload.extend(struct.pack("<q", len(b)))
+        payload.extend(b)
+
+    for blk in page.blocks:
+        arrays = _arrays_of(blk)
+        header["blocks"].append({
+            "type": _type_to_json(blk.type),
+            "dtypes": [a.dtype.str for a in arrays],
+            "nwords": len(arrays),
+            "has_nulls": blk.nulls is not None,
+            "dictionary": (
+                [None if v is None else str(v)
+                 for v in blk.dictionary.values]
+                if blk.dictionary is not None else None
+            ),
+        })
+        for a in arrays:
+            put(a)
+        if blk.nulls is not None:
+            put(np.asarray(blk.nulls))
+    put(np.asarray(page.valid))
+    hdr = json.dumps(header).encode()
+    body = zlib.compress(bytes(payload), level=1)
+    return (_MAGIC + struct.pack("<ii", len(hdr), len(body))
+            + hdr + body)
+
+
+def deserialize_page(buf: bytes) -> Page:
+    assert buf[:4] == _MAGIC, "bad page magic"
+    hlen, blen = struct.unpack("<ii", buf[4:12])
+    header = json.loads(buf[12:12 + hlen].decode())
+    payload = zlib.decompress(buf[12 + hlen:12 + hlen + blen])
+    pos = 0
+
+    def take(dtype, n):
+        nonlocal pos
+        (ln,) = struct.unpack_from("<q", payload, pos)
+        pos += 8
+        arr = np.frombuffer(payload, dtype=dtype, count=n,
+                            offset=pos).copy()
+        pos += ln
+        return arr
+
+    cap = header["capacity"]
+    blocks = []
+    for bh in header["blocks"]:
+        arrays = [take(np.dtype(d), cap) for d in bh["dtypes"]]
+        nulls = take(np.bool_, cap) if bh["has_nulls"] else None
+        dic = (Dictionary(bh["dictionary"])
+               if bh["dictionary"] is not None else None)
+        data = tuple(arrays) if bh["nwords"] > 1 else arrays[0]
+        blocks.append(Block(
+            data=data, type=_type_from_json(bh["type"]), nulls=nulls,
+            dictionary=dic,
+        ))
+    valid = take(np.bool_, cap)
+    return Page(blocks=tuple(blocks), valid=valid)
+
+
+def serialize_pages(pages) -> Iterator[bytes]:
+    for p in pages:
+        yield serialize_page(p)

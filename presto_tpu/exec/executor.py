@@ -258,6 +258,9 @@ class Executor:
         # property); pallas_joins_used is observability for tests
         self.pallas_join = False
         self.pallas_joins_used = 0
+        # DCN ingest registry: RemoteSource.key -> callable yielding
+        # host pages (reference: ExchangeClient wiring per task)
+        self.remote_sources: Dict[str, object] = {}
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
@@ -273,7 +276,7 @@ class Executor:
         if isinstance(node, P.TableScan):
             schema = self.catalogs[node.catalog].table_schema(node.table)
             return [schema.column_type(c) for c in node.columns]
-        if isinstance(node, P.Values):
+        if isinstance(node, (P.Values, P.RemoteSource)):
             return list(node.types)
         if isinstance(node, (P.Filter, P.Limit, P.Sort, P.TopN, P.Output)):
             return self.output_types(node.source)
@@ -377,6 +380,12 @@ class Executor:
                 node.table, node.columns, target_rows=self.page_rows,
                 constraint=node.constraint,
             )
+            return
+        if isinstance(node, P.RemoteSource):
+            # DCN ingest (reference: ExchangeOperator): the registered
+            # supplier yields deserialized host pages; stage on device
+            for page in self.remote_sources[node.key]():
+                yield jax.device_put(page)
             return
         if isinstance(node, P.Values):
             cols = list(zip(*node.rows)) if node.rows else [
@@ -632,10 +641,13 @@ class Executor:
 
     def _partial_origin(self, node: P.Aggregation) -> P.Aggregation:
         """The partial-step aggregation feeding a final-step one (possibly
-        through exchanges); needed to recover original input types."""
+        through exchanges or a DCN RemoteSource); needed to recover
+        original input types."""
         src = node.source
         while isinstance(src, P.Exchange):
             src = src.source
+        if isinstance(src, P.RemoteSource) and src.origin is not None:
+            src = src.origin
         if not (isinstance(src, P.Aggregation) and src.step == "partial"):
             raise TypeError(
                 "final-step aggregation must consume a partial-step one"

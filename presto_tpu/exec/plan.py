@@ -266,6 +266,23 @@ class Output(PhysicalNode):
         return (self.source,)
 
 
+@dataclasses.dataclass(frozen=True)
+class RemoteSource(PhysicalNode):
+    """Pages fetched from remote tasks over the DCN boundary
+    (reference: RemoteSourceNode + operator/ExchangeOperator.java).
+    The executor resolves ``key`` in its ``remote_sources`` registry to
+    a callable yielding deserialized pages. ``origin`` carries the
+    remote fragment's root (e.g. the partial-step aggregation) so the
+    consuming final step can recover original input types."""
+
+    types: Tuple[T.SqlType, ...]
+    key: str
+    origin: Optional[PhysicalNode] = None
+
+    def children(self):
+        return ()
+
+
 def scan_column_unique(node: PhysicalNode, ch: int, catalogs) -> bool:
     """Whether channel ch of node provably carries a connector-declared
     unique column, walked through filters, limits, exchanges, and

@@ -126,25 +126,33 @@ class LocalRunner:
             )
         return conn, catalog, table
 
+    def apply_session(self) -> None:
+        """Session properties -> live executor knobs. The ONE wiring
+        site (reference: SystemSessionProperties consumption) — every
+        driver of the executor (execute() below, the DCN worker/
+        coordinator, the bench tools) must call this rather than copy
+        the mapping, so the knob set cannot drift between drivers."""
+        ex = self.executor
+        ex.use_jit = bool(self.session.get("tpu_offload_enabled"))
+        limit = int(self.session.get("query_max_memory_bytes"))
+        ex.max_memory_bytes = limit or None
+        ex.spill_bytes = (
+            int(self.session.get("spill_threshold_bytes")) or None
+        )
+        ex.host_spill_bytes = (
+            int(self.session.get("host_spill_bytes")) or None
+        )
+        ex.max_build_rows = (
+            int(self.session.get("max_join_build_rows")) or None
+        )
+        ex.pallas_join = bool(self.session.get("pallas_join_enabled"))
+
     def execute(self, sql: str) -> QueryResult:
         stmt = parse(sql)
         # session properties gate the accelerator path per query
         # (reference: SystemSessionProperties; north-star's
         # tpu_offload_enabled -> compiled XLA vs eager fallback)
-        self.executor.use_jit = bool(
-            self.session.get("tpu_offload_enabled")
-        )
-        limit = int(self.session.get("query_max_memory_bytes"))
-        self.executor.max_memory_bytes = limit or None
-        spill = int(self.session.get("spill_threshold_bytes"))
-        self.executor.spill_bytes = spill or None
-        host_spill = int(self.session.get("host_spill_bytes"))
-        self.executor.host_spill_bytes = host_spill or None
-        max_build = int(self.session.get("max_join_build_rows"))
-        self.executor.max_build_rows = max_build or None
-        self.executor.pallas_join = bool(
-            self.session.get("pallas_join_enabled")
-        )
+        self.apply_session()
         if isinstance(stmt, N.SetSession):
             self.session.set(stmt.name, stmt.value)
             return QueryResult([], [], update_type="SET SESSION")

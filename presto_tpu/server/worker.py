@@ -1,0 +1,369 @@
+"""Worker process: the /v1/task control plane + page-buffer data plane.
+
+Reference: presto-main server/TaskResource.java (task create/status/
+cancel), execution/SqlTaskManager.java (task registry + execution),
+execution/buffer/OutputBuffer (token-indexed page buffer consumed by
+HttpPageBufferClient with at-least-once + token-dedupe semantics).
+
+The TPU-native shape: one worker process = one host driving its local
+devices. A task carries (sql, fragment role, split assignment); the
+worker re-plans the SQL with the same deterministic planner the
+coordinator ran (the fragment identity is (sql, role) — plan shipping
+is replaced by plan replay, documented divergence from the reference's
+serialized PlanFragment), restricts the designated fact table to its
+round-robin split share, executes the PARTIAL subtree, and buffers
+serialized pages (dist/serde.py) for token-indexed fetch.
+
+Fault-injection hooks (SURVEY §6.3: inject at the host page proxy —
+ICI collectives cannot be faulted): FAULT_DELAY_MS delays every
+results fetch; FAULT_DROP_EVERY=n returns HTTP 500 on every nth fetch.
+Token-indexed re-fetch makes drops recoverable (at-least-once).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from presto_tpu.connectors.split_filter import SplitFilterConnector
+from presto_tpu.dist import serde
+from presto_tpu.exec import plan as P
+from presto_tpu.session import Session
+
+
+class _Task:
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self.pages: List[bytes] = []
+        self.done = False
+        self.error: Optional[str] = None
+        self.cancelled = False
+        self.lock = threading.Lock()
+
+
+def find_partial_cut(plan: P.PhysicalNode) -> Optional[P.Aggregation]:
+    """The topmost single-step aggregation — the PARTIAL/FINAL split
+    point for the DCN boundary (reference: AddExchanges splitting
+    AggregationNode into PARTIAL below / FINAL above the exchange)."""
+    if isinstance(node := plan, P.Aggregation) and node.step == "single":
+        return node
+    for c in plan.children():
+        hit = find_partial_cut(c)
+        if hit is not None:
+            return hit
+    return None
+
+
+def fanout_safe(cut: P.Aggregation, split_table: str) -> bool:
+    """Whether the PARTIAL subtree distributes over a round-robin
+    partition of split_table's rows. Safe shape: decomposable
+    aggregates with no DISTINCT masks (a MarkDistinct below the cut
+    would mark first-occurrence per worker and double-count values
+    spanning workers — the in-mesh fragmenter gathers MarkDistinct for
+    the same reason), and below the cut only Filter / Project /
+    Exchange / TableScan / INNER hash joins with exactly ONE scan of
+    the split table. Inner joins distribute over a partition of any
+    single table (each result row maps to exactly one row of it);
+    outer/semi/anti/cross joins, nested aggregations, sorts, limits,
+    windows, and self-joins of the split table do not — those queries
+    fall back to local execution."""
+    if any(s.mask is not None for s in cut.aggregates):
+        return False
+    state = {"scans": 0, "ok": True}
+
+    def walk(n):
+        if not state["ok"]:
+            return
+        if isinstance(n, P.TableScan):
+            if n.table == split_table:
+                state["scans"] += 1
+            return
+        if isinstance(n, (P.Filter, P.Project, P.Exchange)):
+            walk(n.source)
+            return
+        if isinstance(n, P.HashJoin):
+            if n.join_type != "inner":
+                state["ok"] = False
+                return
+            walk(n.left)
+            walk(n.right)
+            return
+        state["ok"] = False
+
+    walk(cut.source)
+    return state["ok"] and state["scans"] == 1
+
+
+def largest_table(node: P.PhysicalNode, catalogs) -> Optional[str]:
+    """The fact table to split across workers: the scanned table with
+    the most rows under this subtree (SOURCE_DISTRIBUTION pick)."""
+    tables = []
+
+    def scans(n):
+        if isinstance(n, P.TableScan):
+            tables.append((n.catalog, n.table))
+        for ch in n.children():
+            scans(ch)
+
+    scans(node)
+    if not tables:
+        return None
+    return max(
+        tables, key=lambda ct: catalogs[ct[0]].row_count(ct[1])
+    )[1]
+
+
+class _WorkerHandler(BaseHTTPRequestHandler):
+    server_version = "presto-tpu-worker/0.3"
+
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    @property
+    def app(self) -> "WorkerServer":
+        return self.server.app  # type: ignore[attr-defined]
+
+    def _json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        if not self.path.startswith("/v1/task"):
+            self._json({"error": "not found"}, 404)
+            return
+        n = int(self.headers.get("Content-Length", "0"))
+        req = json.loads(self.rfile.read(n) or b"{}")
+        task = self.app.create_task(req)
+        self._json({"taskId": task.task_id, "state": "RUNNING"})
+
+    def do_GET(self):
+        parts = self.path.strip("/").split("/")
+        if self.path.startswith("/v1/info"):
+            self._json({
+                "nodeId": self.app.node_id,
+                "state": "ACTIVE",
+                "uptime_s": round(time.time() - self.app.started, 1),
+                "tasks": len(self.app.tasks),
+            })
+            return
+        # /v1/task/{id}/results/{token}
+        if len(parts) == 5 and parts[:2] == ["v1", "task"] \
+                and parts[3] == "results":
+            task = self.app.tasks.get(parts[2])
+            if task is None:
+                self._json({"error": "no such task"}, 404)
+                return
+            token = int(parts[4])
+            if self.app.maybe_inject_fault():
+                self._json({"error": "injected fault"}, 500)
+                return
+            # bounded long-poll until the page at `token` exists or the
+            # task finishes (reference: HttpPageBufferClient long-poll)
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                with task.lock:
+                    if task.error:
+                        self._json({"error": task.error}, 500)
+                        return
+                    if token < len(task.pages):
+                        body = task.pages[token]
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "application/x-presto-pages")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.send_header("X-Next-Token", str(token + 1))
+                        self.send_header("X-Done", "0")
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    if task.done:
+                        self.send_response(204)
+                        self.send_header("X-Done", "1")
+                        self.end_headers()
+                        return
+                time.sleep(0.02)
+            self.send_response(204)
+            self.send_header("X-Done", "0")
+            self.end_headers()
+            return
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            task = self.app.tasks.get(parts[2])
+            if task is None:
+                self._json({"error": "no such task"}, 404)
+                return
+            self._json({
+                "taskId": task.task_id,
+                "state": ("FAILED" if task.error else
+                          "FINISHED" if task.done else "RUNNING"),
+                "pages": len(task.pages),
+                "error": task.error,
+            })
+            return
+        self._json({"error": "not found"}, 404)
+
+    def do_DELETE(self):
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            task = self.app.tasks.pop(parts[2], None)
+            if task is not None:
+                task.cancelled = True
+                with task.lock:
+                    task.pages.clear()  # free the page buffer
+                self._json({"taskId": task.task_id,
+                            "state": "CANCELED"})
+                return
+        self._json({"error": "not found"}, 404)
+
+
+class WorkerServer:
+    """One worker process's task runtime (SqlTaskManager analog)."""
+
+    def __init__(self, catalogs, *, port: int = 0, node_id: str = "w0",
+                 default_catalog: Optional[str] = None,
+                 page_rows: int = 1 << 16):
+        self.catalogs = catalogs
+        self.node_id = node_id
+        self.default_catalog = default_catalog
+        self.page_rows = page_rows
+        self.tasks: Dict[str, _Task] = {}
+        self.started = time.time()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                          _WorkerHandler)
+        self._httpd.app = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._fault_lock = threading.Lock()
+        self._results_calls = 0
+
+    # -------------------------------------------------- fault injection
+    def maybe_inject_fault(self) -> bool:
+        """SURVEY §6.3: faults inject at the host page proxy (delay /
+        drop); returns True when this fetch should fail with HTTP 500.
+        Token-indexed re-fetch makes drops recoverable."""
+        delay = int(os.environ.get("FAULT_DELAY_MS", "0"))
+        if delay:
+            time.sleep(delay / 1000.0)
+        drop = int(os.environ.get("FAULT_DROP_EVERY", "0"))
+        if drop:
+            with self._fault_lock:
+                self._results_calls += 1
+                if self._results_calls % drop == 0:
+                    return True
+        return False
+
+    # -------------------------------------------------------- lifecycle
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+
+    # ------------------------------------------------------------ tasks
+    MAX_RETAINED_TASKS = 32
+
+    def create_task(self, req: Dict) -> _Task:
+        # expire oldest finished tasks (reference: SqlTaskManager task
+        # expiry) so a long-lived worker's page buffers are bounded
+        done = [tid for tid, t in self.tasks.items() if t.done]
+        while len(done) > self.MAX_RETAINED_TASKS:
+            old = self.tasks.pop(done.pop(0), None)
+            if old is not None:
+                with old.lock:
+                    old.pages.clear()
+        task = _Task(req.get("taskId") or f"t{len(self.tasks)}")
+        self.tasks[task.task_id] = task
+        t = threading.Thread(target=self._run_task, args=(task, req),
+                             daemon=True)
+        t.start()
+        return task
+
+    def _run_task(self, task: _Task, req: Dict) -> None:
+        try:
+            from presto_tpu.runner import LocalRunner
+
+            split_table = req["splitTable"]
+            index, count = int(req["splitIndex"]), int(req["splitCount"])
+            catalogs = {
+                name: SplitFilterConnector(conn, split_table, index,
+                                           count)
+                for name, conn in self.catalogs.items()
+            }
+            session = Session(catalog=self.default_catalog or
+                              next(iter(catalogs)))
+            for k, v in (req.get("session") or {}).items():
+                session.set(k, v)
+            runner = LocalRunner(
+                catalogs, page_rows=self.page_rows,
+                default_catalog=session.catalog, session=session,
+            )
+            plan = runner.plan(req["sql"])
+            cut = find_partial_cut(plan)
+            if cut is None:
+                raise ValueError("no aggregation cut in fragment")
+            partial = dataclasses.replace(cut, step="partial")
+            ex = runner.executor
+            runner.apply_session()
+            for page in ex.pages(partial):
+                if task.cancelled:
+                    break
+                import jax
+
+                host = jax.device_get(page)
+                blob = serde.serialize_page(host)
+                with task.lock:
+                    task.pages.append(blob)
+            with task.lock:
+                task.done = True
+        except Exception as e:  # pragma: no cover - error path
+            with task.lock:
+                task.error = repr(e)[:400]
+                task.done = True
+
+
+def main() -> int:  # pragma: no cover - subprocess entry
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--suite", default="tpch")
+    parser.add_argument("--scale", type=float, default=0.01)
+    parser.add_argument("--node-id", default="w0")
+    parser.add_argument("--page-rows", type=int, default=1 << 16)
+    args = parser.parse_args()
+
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+    from presto_tpu.connectors.tpch import TpchConnector
+
+    cls = TpchConnector if args.suite == "tpch" else TpcdsConnector
+    srv = WorkerServer(
+        {args.suite: cls(scale=args.scale)}, port=args.port,
+        node_id=args.node_id, default_catalog=args.suite,
+        page_rows=args.page_rows,
+    )
+    port = srv.start()
+    print(json.dumps({"port": port, "nodeId": args.node_id}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

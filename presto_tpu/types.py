@@ -396,6 +396,9 @@ def parse_type(text: str) -> SqlType:
         "timestamp": TIMESTAMP,
         "varbinary": VARBINARY,
         "unknown": UNKNOWN,
+        "hyperloglog": HLL_STATE,
+        "interval day to second": INTERVAL_DAY_TIME,
+        "interval year to month": INTERVAL_YEAR_MONTH,
     }
     if base in simple:
         if args:

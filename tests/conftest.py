@@ -34,6 +34,10 @@ def pytest_configure(config):
         "tpu: drives the real TPU chip in a subprocess (opt-in via "
         "RUN_TPU_SMOKE=1)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: boots real OS processes / long compiles",
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,181 @@
+"""Ring-3.5: multi-process (DCN) distributed execution on localhost.
+
+Reference: presto-tests tests/DistributedQueryRunner.java boots real
+servers with real HTTP shuffle in one JVM; our DCN analog goes one
+step further and uses real OS processes (separate JAX runtimes), per
+SURVEY §6.3/§6.8 — the host page proxy is also where faults inject
+(delay/drop/kill), since compiled ICI collectives cannot be faulted.
+
+Process workers are expensive to boot (fresh XLA compiles), so most
+tests share two in-process WorkerServers (threads — same HTTP protocol,
+same serde boundary) and two tests pay for real subprocesses: the
+end-to-end parity run and the kill-a-worker failure path.
+"""
+
+import collections
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.dist.dcn import DcnQueryFailed, DcnRunner
+from presto_tpu.runner import LocalRunner
+from presto_tpu.server.worker import WorkerServer
+from tests.tpch_queries import QUERIES
+
+SF = 0.01
+PAGE_ROWS = 1 << 13
+
+
+@pytest.fixture(scope="module")
+def single():
+    return LocalRunner({"tpch": TpchConnector(SF)}, page_rows=PAGE_ROWS)
+
+
+@pytest.fixture(scope="module")
+def workers():
+    w1 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w1",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    w2 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w2",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    uris = [f"http://127.0.0.1:{w1.start()}",
+            f"http://127.0.0.1:{w2.start()}"]
+    yield uris
+    w1.stop()
+    w2.stop()
+
+
+@pytest.fixture(scope="module")
+def coord(workers):
+    return DcnRunner({"tpch": TpchConnector(SF)}, workers,
+                     default_catalog="tpch", page_rows=PAGE_ROWS)
+
+
+def rows_equal(a, b):
+    return collections.Counter(map(repr, a)) == collections.Counter(
+        map(repr, b)
+    )
+
+
+@pytest.mark.parametrize("qid", [1, 6, 3])
+def test_dcn_matches_single(qid, single, coord):
+    want = single.execute(QUERIES[qid]).rows
+    got = coord.execute(QUERIES[qid])
+    assert rows_equal(want, got), f"Q{qid} diverged"
+
+
+def test_dcn_approx_distinct(single, coord):
+    q = ("select o_orderpriority, approx_distinct(o_custkey), "
+         "sum(o_totalprice) from orders group by o_orderpriority")
+    assert rows_equal(single.execute(q).rows, coord.execute(q))
+
+
+def test_heartbeat_sees_workers(coord):
+    coord.heartbeat.check_once()
+    assert len(coord.heartbeat.alive_nodes()) == 2
+
+
+def test_fault_delay_and_drop_recovered(workers, single, monkeypatch):
+    """Injected page-proxy faults (delay + periodic HTTP 500) must be
+    absorbed by the token-acked retry protocol — same rows, no error."""
+    monkeypatch.setenv("FAULT_DELAY_MS", "20")
+    monkeypatch.setenv("FAULT_DROP_EVERY", "3")
+    coord = DcnRunner({"tpch": TpchConnector(SF)}, workers,
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    q = ("select l_returnflag, count(*), sum(l_quantity) "
+         "from lineitem group by l_returnflag")
+    want = single.execute(q).rows
+    got = coord.execute(q)
+    assert rows_equal(want, got)
+
+
+def _boot_subprocess_worker(port_env):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("FAULT_DELAY_MS", None)
+    env.pop("FAULT_DROP_EVERY", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.server.worker",
+         "--port", "0", "--suite", "tpch", "--scale", str(SF),
+         "--page-rows", str(PAGE_ROWS)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        text=True,
+    )
+    line = proc.stdout.readline()
+    info = json.loads(line)
+    return proc, f"http://127.0.0.1:{info['port']}"
+
+
+@pytest.mark.slow
+def test_two_real_processes_and_kill(single):
+    """The VERDICT ring-3.5 gate: Q3 across 2 real OS processes matches
+    single-process; killing a worker mid-query fails the query cleanly
+    (reference failure model: no task-level recovery, SURVEY §6.3)."""
+    p1, u1 = _boot_subprocess_worker(0)
+    p2, u2 = _boot_subprocess_worker(0)
+    try:
+        coord = DcnRunner({"tpch": TpchConnector(SF)}, [u1, u2],
+                          default_catalog="tpch", page_rows=PAGE_ROWS,
+                          fetch_retries=2)
+        want = single.execute(QUERIES[3]).rows
+        got = coord.execute(QUERIES[3])
+        assert rows_equal(want, got), "Q3 across processes diverged"
+
+        # kill one worker, then run again: clean query failure
+        p2.send_signal(signal.SIGKILL)
+        p2.wait(timeout=10)
+        with pytest.raises(DcnQueryFailed):
+            coord.execute(QUERIES[3])
+    finally:
+        for p in (p1, p2):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+def test_non_aggregate_query_falls_back_local(coord, single):
+    q = "select r_regionkey, r_name from region order by r_regionkey"
+    assert coord.execute(q) == single.execute(q).rows
+
+
+@pytest.mark.parametrize("q", [
+    # DISTINCT masks: MarkDistinct below the cut would double-count
+    # values spanning workers — must fall back local, stay correct
+    "select count(distinct o_custkey) from orders",
+    # outer join below the cut: null-extension is not split-safe
+    "select count(*) from customer left join orders "
+    "on c_custkey = o_custkey",
+    # NOT IN (anti join) below the cut
+    "select count(*) from customer where c_custkey not in "
+    "(select o_custkey from orders)",
+])
+def test_unsafe_shapes_fall_back_local(coord, single, q):
+    assert rows_equal(coord.execute(q), single.execute(q).rows)
+
+
+def test_self_join_of_fact_table_falls_back(coord, single):
+    q = ("select count(*) from orders o1, orders o2 "
+         "where o1.o_orderkey = o2.o_orderkey")
+    assert rows_equal(coord.execute(q), single.execute(q).rows)
+
+
+def test_session_props_reach_both_halves(workers, single):
+    coord = DcnRunner(
+        {"tpch": TpchConnector(SF)}, workers,
+        default_catalog="tpch", page_rows=PAGE_ROWS,
+        session_props={"spill_threshold_bytes": 1 << 15},
+    )
+    q = ("select o_custkey, count(*) from orders group by o_custkey "
+         "order by 2 desc, 1 limit 5")
+    got = coord.execute(q)
+    assert rows_equal(got, single.execute(q).rows)
+    # the coordinator-side final stage honored the session (spill knob
+    # reached the shared executor through apply_session)
+    assert coord.runner.executor.spill_bytes == 1 << 15

@@ -40,22 +40,9 @@ def make_runner(suite: str, sf: float, props=(), cached: bool = False):
     for kv in props:
         k, v = kv.split("=", 1)
         runner.session.set(k, v)
-    # mirror LocalRunner.execute's session application for direct
-    # executor drivers (bisect_rung times ex.pages without execute())
-    ex = runner.executor
-    ex.use_jit = bool(runner.session.get("tpu_offload_enabled"))
-    ex.max_memory_bytes = (
-        int(runner.session.get("query_max_memory_bytes")) or None
-    )
-    ex.spill_bytes = (
-        int(runner.session.get("spill_threshold_bytes")) or None
-    )
-    ex.host_spill_bytes = (
-        int(runner.session.get("host_spill_bytes")) or None
-    )
-    ex.max_build_rows = (
-        int(runner.session.get("max_join_build_rows")) or None
-    )
+    # session -> executor for direct executor drivers (bisect_rung
+    # times ex.pages without execute())
+    runner.apply_session()
     return runner
 
 
