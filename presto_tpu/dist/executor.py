@@ -145,6 +145,35 @@ class DistExecutor(Executor):
         if isinstance(node, P.UniqueId):
             yield from self._dist_unique_id(node)
             return
+        if isinstance(node, P.Unnest):
+            from presto_tpu.exec.executor import _unnest_page
+
+            for page in self.pages(node.source):
+                dic = page.block(node.array_channel).dictionary
+                fn = self._shard_page_kernel(
+                    ("d_unnest", node, dic),
+                    functools.partial(
+                        _unnest_page, node.array_channel,
+                        node.element_type, node.with_ordinality,
+                    ),
+                )
+                yield fn(page)
+            return
+        if isinstance(node, P.GroupId):
+            from presto_tpu.exec.executor import _group_id_page
+
+            fns = [
+                self._shard_page_kernel(
+                    ("d_groupid", node, si),
+                    functools.partial(_group_id_page,
+                                      node.key_channels, mask, si),
+                )
+                for si, mask in enumerate(node.set_masks)
+            ]
+            for page in self.pages(node.source):
+                for fn in fns:
+                    yield fn(page)
+            return
         if isinstance(node, P.Union):
             for src in node.sources:
                 yield from self.pages(src)
@@ -283,8 +312,14 @@ class DistExecutor(Executor):
     def _repartition_fn(self, keys: Tuple[int, ...]):
         """hash(keys) % D routing via lax.all_to_all — the
         PartitionedOutputOperator -> ExchangeOperator data plane as one
-        compiled collective (SURVEY §3.3 north-star mapping)."""
+        compiled collective (SURVEY §3.3 north-star mapping).
+
+        The landing-zone capacity rides the boosted-retry ladder: a
+        skewed key routing most rows to one device overflows the 2R
+        default and the query retries with 4x landing capacity (SURVEY
+        §6.7 — correctness under skew never depends on balance)."""
         D = self.D
+        boost = self._capacity_boost
 
         def body(page: Page):
             R = page.capacity  # local rows per device
@@ -323,7 +358,7 @@ class DistExecutor(Executor):
             )
             flat_valid = flat.valid
             # compact the D*R landing zone back to a bounded local page
-            out_cap = min(D * R, _next_pow2(2 * R))
+            out_cap = min(D * R, _next_pow2(2 * R * boost))
             targets, out_valid, num = compact_indices(flat_valid, out_cap)
             blocks = []
             for blk in flat.blocks:
@@ -344,7 +379,7 @@ class DistExecutor(Executor):
                 (num > out_cap).astype(jnp.int32), "d") > 0
             return out, overflow
 
-        key = ("d_repart", keys, self.D)
+        key = ("d_repart", keys, self.D, boost)
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(jax.shard_map(
                 body, mesh=self.mesh, in_specs=(PS("d"),),

@@ -58,6 +58,10 @@ def est_rows(node: P.PhysicalNode, catalogs) -> int:
         return sum(est_rows(s, catalogs) for s in node.sources)
     if isinstance(node, (P.Sort, P.Output, P.Window, P.MarkDistinct)):
         return est_rows(node.source, catalogs)
+    if isinstance(node, P.GroupId):
+        return est_rows(node.source, catalogs) * len(node.set_masks)
+    if isinstance(node, P.Unnest):
+        return est_rows(node.source, catalogs) * 4
     if isinstance(node, P.TopN):
         return min(est_rows(node.source, catalogs), node.limit)
     if isinstance(node, P.Limit):
@@ -88,7 +92,12 @@ def add_exchanges(
             return n, SHARDED
         if isinstance(n, P.Values):
             return n, REPLICATED
-        if isinstance(n, (P.Filter, P.Project, P.UniqueId)):
+        if isinstance(
+            n, (P.Filter, P.Project, P.UniqueId, P.GroupId, P.Unnest)
+        ):
+            # row-local transforms keep their source's distribution
+            # (GroupId replicas and Unnest expansion are per-row,
+            # shard-transparent)
             src, d = rewrite(n.source)
             return dataclasses.replace(n, source=src), d
         if isinstance(n, P.Union):

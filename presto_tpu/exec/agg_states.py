@@ -86,12 +86,45 @@ def state_layout(function: str, in_type: Optional[T.SqlType]) -> List[StateCol]:
         # kernels (exec/executor.py) against ops/hll.py. Reference:
         # operator/aggregation/ApproximateCountDistinctAggregation.
         return [StateCol("hll", A.HLL_INSERT, A.HLL_MERGE, T.HLL_STATE)]
+    if function in _PLUGIN_AGGS:
+        return list(_PLUGIN_AGGS[function].state)
     raise ValueError(f"unknown aggregate function: {function}")
 
 
 VARIANCE_FNS = frozenset(
     {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 )
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateFunctionSpec:
+    """Plugin aggregate (reference: @AggregationFunction state/input/
+    combine/output; spi/Plugin.getFunctions). The TPU decomposition:
+    ``state`` columns are built from the primitive segmented-reduction
+    kinds of ops/agg (input_kind on raw input, merge_kind on partial
+    states — so PARTIAL/FINAL splits, spill partitions, and mesh
+    repartition all work unchanged), and ``finalize(xp, states)``
+    combines the merged state arrays into ``(data, nulls-or-None)``.
+
+    ``StateCol.pre`` may be a module-level callable (traced transform
+    applied to the raw input before reduction); lambdas would defeat
+    the jit cache keying, so use named functions."""
+
+    name: str
+    state: Tuple[StateCol, ...]
+    result: object  # SqlType, or callable(in_type) -> SqlType
+    finalize: object  # fn(xp, states) -> (data, nulls or None)
+
+
+_PLUGIN_AGGS: dict = {}
+
+
+def register_aggregate(spec: AggregateFunctionSpec) -> None:
+    _PLUGIN_AGGS[spec.name] = spec
+
+
+def is_plugin_aggregate(name: str) -> bool:
+    return name in _PLUGIN_AGGS
 
 
 def result_type(function: str, in_type: Optional[T.SqlType]) -> T.SqlType:
@@ -118,12 +151,17 @@ def result_type(function: str, in_type: Optional[T.SqlType]) -> T.SqlType:
         return T.DOUBLE
     if function == "approx_distinct":
         return T.BIGINT
+    if function in _PLUGIN_AGGS:
+        r = _PLUGIN_AGGS[function].result
+        return r(in_type) if callable(r) else r
     raise ValueError(f"unknown aggregate function: {function}")
 
 
-def pre_transform(pre: Optional[str], data: jnp.ndarray) -> jnp.ndarray:
+def pre_transform(pre, data: jnp.ndarray) -> jnp.ndarray:
     if pre is None:
         return data
+    if callable(pre):  # plugin aggregates: named traced transform
+        return pre(data)
     if pre == "hi32":
         return data >> jnp.int64(32)  # arithmetic: floor(v / 2^32)
     if pre == "lo32":
@@ -206,4 +244,7 @@ def finalize(
         if function.startswith("stddev"):
             var = xp.sqrt(var)
         return Block(data=var, type=T.DOUBLE, nulls=nulls)
+    if function in _PLUGIN_AGGS:
+        data, nulls = _PLUGIN_AGGS[function].finalize(xp, states)
+        return Block(data=data, type=out_type, nulls=nulls)
     raise ValueError(f"unknown aggregate function: {function}")

@@ -339,6 +339,13 @@ class Executor:
                 node.right)
         if isinstance(node, P.UniqueId):
             return self.output_types(node.source) + [T.BIGINT]
+        if isinstance(node, P.GroupId):
+            return self.output_types(node.source) + [T.BIGINT]
+        if isinstance(node, P.Unnest):
+            out = self.output_types(node.source) + [node.element_type]
+            if node.with_ordinality:
+                out.append(T.BIGINT)
+            return out
         if isinstance(node, P.Union):
             return self.output_types(node.sources[0])
         raise TypeError(f"unknown node: {node!r}")
@@ -448,6 +455,33 @@ class Executor:
                 )
                 offset += page.capacity
                 yield Page(blocks=page.blocks + (ids,), valid=page.valid)
+            return
+        if isinstance(node, P.Unnest):
+            for page in self.pages(node.source):
+                dic = page.block(node.array_channel).dictionary
+                fn = self._jit(
+                    ("unnest", node, dic, page.capacity),
+                    functools.partial(
+                        _unnest_page, node.array_channel,
+                        node.element_type, node.with_ordinality,
+                    ),
+                )
+                yield fn(page)
+            return
+        if isinstance(node, P.GroupId):
+            # one replica per grouping set: absent keys nulled, gid
+            # appended (reference: GroupIdOperator's page replication)
+            fns = [
+                self._jit(
+                    ("groupid", node, si),
+                    functools.partial(_group_id_page, node.key_channels,
+                                      mask, si),
+                )
+                for si, mask in enumerate(node.set_masks)
+            ]
+            for page in self.pages(node.source):
+                for fn in fns:
+                    yield fn(page)
             return
         if isinstance(node, P.Union):
             for src in node.sources:
@@ -1047,6 +1081,11 @@ class Executor:
             )
         if isinstance(node, P.Union):
             return sum(self.estimate_rows(s) for s in node.sources)
+        if isinstance(node, P.GroupId):
+            return self.estimate_rows(node.source) * len(node.set_masks)
+        if isinstance(node, P.Unnest):
+            # expansion factor unknown statically; modest heuristic
+            return self.estimate_rows(node.source) * 4
         kids = node.children()
         return self.estimate_rows(kids[0]) if kids else 1
 
@@ -1888,6 +1927,78 @@ def _probe_join_page(left_keys, right_keys, join_type, page: Page,
         )
         out = concat_all([out, pad])
     return out, m.build_matched, m.overflow
+
+
+def _unnest_page(array_channel, elem_type, with_ordinality,
+                 page: Page) -> Page:
+    """Static-shape UNNEST: output capacity = input capacity x L where
+    L = max array length over the channel's dictionary (a compile-time
+    constant — dictionaries are static aux data). Element values gather
+    from a trace-time flat lut; shorter arrays mask out their padding
+    (reference: UnnestOperator's per-row element loop, vectorized)."""
+    blk = page.block(array_channel)
+    dic = blk.dictionary
+    vals = [tuple(v) for v in (dic.values if dic is not None else [])]
+    n = max(len(vals), 1)
+    L = max((len(v) for v in vals), default=0) or 1
+    lens = np.zeros((n,), np.int64)
+    string_elem = elem_type.is_dictionary_encoded
+    if string_elem:
+        uniq: dict = {}
+        for v in vals:
+            for x in v:
+                if x is not None:
+                    uniq.setdefault(x, len(uniq))
+        edic = Dictionary(list(uniq))
+        flat = np.zeros((n, L), np.int32)
+    else:
+        edic = None
+        flat = np.zeros((n, L), np.dtype(elem_type.numpy_dtype))
+    enull = np.ones((n, L), bool)
+    for vi, v in enumerate(vals):
+        lens[vi] = len(v)
+        for k, x in enumerate(v):
+            if x is None:
+                continue
+            enull[vi, k] = False
+            flat[vi, k] = uniq[x] if string_elem else x
+    cap = page.capacity
+    idx = jnp.arange(cap * L, dtype=jnp.int64)
+    i, k = idx // L, idx % L
+    codes = jnp.clip(blk.data.astype(jnp.int64), 0, n - 1)[i]
+    valid = page.valid[i] & (k < jnp.asarray(lens)[codes])
+    if blk.nulls is not None:
+        valid = valid & ~blk.nulls[i]
+    src = gather_rows(page, i, valid)
+    eblock = Block(
+        data=jnp.asarray(flat)[codes, k],
+        type=elem_type,
+        nulls=jnp.asarray(enull)[codes, k],
+        dictionary=edic,
+    )
+    blocks = src.blocks + (eblock,)
+    if with_ordinality:
+        blocks += (Block(data=k + 1, type=T.BIGINT, nulls=None),)
+    return Page(blocks=blocks, valid=valid)
+
+
+def _group_id_page(key_channels, mask, set_index, page: Page) -> Page:
+    """One grouping-set replica: null out keys absent from the set and
+    append the constant gid channel."""
+    blocks = list(page.blocks)
+    for kc, keep in zip(key_channels, mask):
+        if not keep:
+            b = blocks[kc]
+            blocks[kc] = Block(
+                data=b.data, type=b.type,
+                nulls=jnp.ones((page.capacity,), dtype=jnp.bool_),
+                dictionary=b.dictionary,
+            )
+    gid = Block(
+        data=jnp.full((page.capacity,), set_index, dtype=jnp.int64),
+        type=T.BIGINT,
+    )
+    return Page(blocks=tuple(blocks) + (gid,), valid=page.valid)
 
 
 def _cross_join_page(page: Page, build: Page) -> Page:

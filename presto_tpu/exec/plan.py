@@ -267,6 +267,39 @@ class Output(PhysicalNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Unnest(PhysicalNode):
+    """Expand an array-typed channel: one output row per element, source
+    columns replicated (reference: operator/UnnestOperator.java +
+    plan/UnnestNode). Static-shape translation: the expansion factor is
+    the max array length over the channel's host dictionary (a
+    compile-time constant), with a validity mask for shorter arrays."""
+
+    source: PhysicalNode
+    array_channel: int
+    element_type: T.SqlType
+    with_ordinality: bool = False
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupId(PhysicalNode):
+    """Grouping-sets expansion (reference: operator/GroupIdOperator.java
+    + plan/GroupIdNode): replicate the input once per grouping set,
+    nulling out key channels absent from the set, and append a BIGINT
+    group-id channel so one aggregation over (keys..., gid) computes
+    every set. set_masks[s][k] = key_channels[k] participates in set s."""
+
+    source: PhysicalNode
+    key_channels: Tuple[int, ...]
+    set_masks: Tuple[Tuple[bool, ...], ...]
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
 class RemoteSource(PhysicalNode):
     """Pages fetched from remote tasks over the DCN boundary
     (reference: RemoteSourceNode + operator/ExchangeOperator.java).

@@ -57,8 +57,11 @@ def _channel_count(node: P.PhysicalNode, counts: Dict) -> int:
     elif isinstance(node, P.CrossJoin):
         n = _channel_count(node.left, counts) + _channel_count(
             node.right, counts)
-    elif isinstance(node, P.UniqueId):
+    elif isinstance(node, (P.UniqueId, P.GroupId)):
         n = _channel_count(node.source, counts) + 1
+    elif isinstance(node, P.Unnest):
+        n = _channel_count(node.source, counts) + 1 + int(
+            node.with_ordinality)
     elif isinstance(node, P.Union):
         n = _channel_count(node.sources[0], counts)
     elif isinstance(node, P.Window):
@@ -101,8 +104,13 @@ def output_types(node: P.PhysicalNode, catalogs: Dict) -> List[T.SqlType]:
     if isinstance(node, P.CrossJoin):
         return output_types(node.left, catalogs) + output_types(
             node.right, catalogs)
-    if isinstance(node, P.UniqueId):
+    if isinstance(node, (P.UniqueId, P.GroupId)):
         return output_types(node.source, catalogs) + [T.BIGINT]
+    if isinstance(node, P.Unnest):
+        out = output_types(node.source, catalogs) + [node.element_type]
+        if node.with_ordinality:
+            out.append(T.BIGINT)
+        return out
     if isinstance(node, P.Union):
         return output_types(node.sources[0], catalogs)
     if isinstance(node, P.Window):
@@ -252,6 +260,34 @@ def _prune(node: P.PhysicalNode, needed: Set[int], ctx: Dict):
         mapping = dict(m)
         mapping[nsrc] = len(m)  # id channel
         return P.UniqueId(src), mapping
+    if isinstance(node, P.GroupId):
+        nsrc = _channel_count(node.source, counts)
+        child_needed = (
+            {c for c in needed if c < nsrc} | set(node.key_channels)
+        )
+        src, m = _prune(node.source, child_needed, ctx)
+        mapping = dict(m)
+        mapping[nsrc] = len(m)  # gid channel
+        return (
+            P.GroupId(src, tuple(m[c] for c in node.key_channels),
+                      node.set_masks),
+            mapping,
+        )
+    if isinstance(node, P.Unnest):
+        nsrc = _channel_count(node.source, counts)
+        child_needed = (
+            {c for c in needed if c < nsrc} | {node.array_channel}
+        )
+        src, m = _prune(node.source, child_needed, ctx)
+        mapping = dict(m)
+        mapping[nsrc] = len(m)  # element channel
+        if node.with_ordinality:
+            mapping[nsrc + 1] = len(m) + 1
+        return (
+            P.Unnest(src, m[node.array_channel], node.element_type,
+                     node.with_ordinality),
+            mapping,
+        )
     if isinstance(node, P.Union):
         keep = sorted(needed)
         new_sources = []

@@ -51,6 +51,19 @@ def _const_val(ctx, node: ir.Constant) -> Val:
             t,
             py_value=node.value,
         )
+    if t.is_dictionary_encoded:
+        # complex constants (array/map/row literals): a one-entry
+        # dictionary makes the value first-class — projection, decode,
+        # UNNEST, and the _dict_* function helpers all apply
+        from presto_tpu.page import Dictionary
+
+        return Val(
+            ctx.xp.zeros((), dtype=np.int32),
+            None,
+            t,
+            Dictionary([node.value]),
+            py_value=node.value,
+        )
     dt = np.dtype(t.numpy_dtype)
     return Val(
         ctx.xp.asarray(np.asarray(node.value, dtype=dt)),

@@ -1189,3 +1189,194 @@ for _part in ("hour", "minute", "second", "millisecond"):
         return impl
 
     register(_part, lambda a: T.BIGINT, _impl_ts_part())
+
+
+# ------------------------------------------------- complex types (v1)
+# ARRAY/MAP/ROW values are dictionary-coded (host tuples, i32 codes) —
+# per-distinct-value work at trace time, vectorized gathers per row
+# (same scheme as strings; reference: spi/block/{Array,Map,Row}Block +
+# operator/scalar/{Array,Map}Functions).
+
+
+def _impl_cardinality(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    return _dict_int(ctx, vals[0], lambda v: len(v))
+
+
+def _cardinality_resolve(args):
+    if not isinstance(args[0], (T.ArrayType, T.MapType)):
+        raise TypeError(f"cardinality over {args[0]}")
+    return T.BIGINT
+
+
+register("cardinality", _cardinality_resolve, _impl_cardinality)
+
+
+def _elem_result_val(ctx: Ctx, col: Val, results, elem_t: T.SqlType) -> Val:
+    """Per-distinct-value results (may contain None) -> a typed Val:
+    dictionary-coded element types build a new dictionary; numeric
+    element types gather from a typed lut."""
+    codes = ctx.xp.clip(col.data, 0, max(len(results) - 1, 0))
+    isnull = np.array([r is None for r in results] or [True], bool)
+    nulls = union_nulls(
+        ctx.xp, col.nulls, ctx.xp.asarray(isnull)[codes]
+    )
+    if elem_t.is_dictionary_encoded:
+        d = Dictionary(["" if r is None else r for r in results])
+        return Val(col.data, nulls, elem_t, d)
+    lut = np.zeros((max(len(results), 1),),
+                   np.dtype(elem_t.numpy_dtype))
+    for i, r in enumerate(results):
+        if r is not None:
+            lut[i] = r
+    return Val(ctx.xp.asarray(lut)[codes], nulls, elem_t)
+
+
+def _impl_element_at(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    """element_at(array, index) / element_at(map, key) / element_at(row,
+    ordinal). Array/row indices are 1-based; out-of-range and missing
+    map keys yield NULL (reference: MapFunctions.elementAt /
+    ArrayFunctions)."""
+    col, key = vals[0], vals[1]
+    if not key.is_const:
+        raise TypeError("element_at key/index must be a constant")
+    k = key.py_value
+    d = _dict_of(col)
+    t = col.type
+    if isinstance(t, T.MapType):
+        def get(v):
+            for mk, mv in v:
+                if mk == k:
+                    return mv
+            return None
+
+        return _elem_result_val(
+            ctx, col, [get(v) for v in d.values], t.value
+        )
+    # array / row: 1-based ordinal
+    idx = int(k)
+
+    def at(v):
+        return v[idx - 1] if 1 <= idx <= len(v) else None
+
+    elem_t = (t.element if isinstance(t, T.ArrayType)
+              else (t.fields[idx - 1] if isinstance(t, T.RowType)
+                    and 1 <= idx <= len(t.fields) else T.UNKNOWN))
+    return _elem_result_val(
+        ctx, col, [at(v) for v in d.values], elem_t
+    )
+
+
+def _element_at_resolve(args):
+    t = args[0]
+    if isinstance(t, T.ArrayType):
+        return t.element
+    if isinstance(t, T.MapType):
+        return t.value
+    if isinstance(t, T.RowType):
+        return T.UNKNOWN  # refined at eval; constants resolve later
+    raise TypeError(f"element_at over {t}")
+
+
+register("element_at", _element_at_resolve, _impl_element_at)
+
+
+def _impl_contains(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col, needle = vals[0], vals[1]
+    if not needle.is_const:
+        raise TypeError("contains() value must be a constant")
+    k = needle.py_value
+    return _dict_predicate(ctx, col, lambda v: k in v)
+
+
+register("contains", lambda a: T.BOOLEAN, _impl_contains)
+
+
+def _impl_array_minmax(which):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        col = vals[0]
+        d = _dict_of(col)
+        f = min if which == "min" else max
+
+        def get(v):
+            xs = [x for x in v if x is not None]
+            return f(xs) if xs else None
+
+        return _elem_result_val(
+            ctx, col, [get(v) for v in d.values], col.type.element
+        )
+
+    return impl
+
+
+def _array_elem_resolve(args):
+    if not isinstance(args[0], T.ArrayType):
+        raise TypeError(f"array function over {args[0]}")
+    return args[0].element
+
+
+register("array_min", _array_elem_resolve, _impl_array_minmax("min"))
+register("array_max", _array_elem_resolve, _impl_array_minmax("max"))
+
+
+def _impl_map_keys_values(which):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        col = vals[0]
+        t = col.type
+        d = _dict_of(col)
+        i = 0 if which == "keys" else 1
+        results = [tuple(pair[i] for pair in v) for v in d.values]
+        elem = t.key if which == "keys" else t.value
+        new = Dictionary(results)
+        return Val(col.data, col.nulls, T.ArrayType(elem), new)
+
+    return impl
+
+
+def _map_arr_resolve(which):
+    def resolve(args):
+        t = args[0]
+        if not isinstance(t, T.MapType):
+            raise TypeError(f"map function over {t}")
+        return T.ArrayType(t.key if which == "keys" else t.value)
+
+    return resolve
+
+
+register("map_keys", _map_arr_resolve("keys"),
+         _impl_map_keys_values("keys"))
+register("map_values", _map_arr_resolve("values"),
+         _impl_map_keys_values("values"))
+
+
+def _impl_map_ctor(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    """map(key_array, value_array) over constant arrays."""
+    ka, va = vals[0], vals[1]
+    if not (ka.is_const and va.is_const):
+        raise TypeError("map() arguments must be constant arrays")
+    pairs = tuple(zip(ka.py_value, va.py_value))
+    t = T.MapType(ka.type.element, va.type.element)
+    return Val(ctx.xp.zeros((), dtype=np.int32), None, t,
+               Dictionary([pairs]), py_value=pairs)
+
+
+def _map_ctor_resolve(args):
+    if len(args) != 2 or not all(
+        isinstance(a, T.ArrayType) for a in args
+    ):
+        raise TypeError("map() takes two array arguments")
+    return T.MapType(args[0].element, args[1].element)
+
+
+register("map", _map_ctor_resolve, _impl_map_ctor)
+
+
+def _impl_row_ctor(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    if not all(v.is_const for v in vals):
+        raise TypeError("row() arguments must be constants")
+    tup = tuple(v.py_value for v in vals)
+    t = T.RowType(tuple(v.type for v in vals))
+    return Val(ctx.xp.zeros((), dtype=np.int32), None, t,
+               Dictionary([tup]), py_value=tup)
+
+
+register("row", lambda a: T.RowType(tuple(a)), _impl_row_ctor)

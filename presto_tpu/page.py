@@ -49,7 +49,13 @@ class Dictionary:
 
     def __init__(self, values: Sequence[Any]):
         vals = list(values)
-        self.values = np.array(vals, dtype=object)
+        # element-wise fill: np.array(list_of_equal_length_tuples)
+        # would build a 2-D object array, breaking decode/gather for
+        # complex-typed (array/map/row tuple) values
+        arr = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            arr[i] = v
+        self.values = arr
         self._index = {v: i for i, v in enumerate(vals)}
         self._hash = hash(tuple(vals))
 

@@ -81,6 +81,15 @@ class Plugin:
         @scalar_function."""
         return []
 
+    def aggregate_functions(self):
+        """Aggregate UDFs (reference: @AggregationFunction classes in
+        getFunctions). Entries are
+        presto_tpu.exec.agg_states.AggregateFunctionSpec: state columns
+        decomposed into the primitive segmented-reduction kinds, so a
+        plugin aggregate inherits PARTIAL/FINAL splits, spill
+        partitioning, and mesh repartition for free."""
+        return []
+
     def event_listeners(self) -> List[EventListener]:
         """Reference: getEventListenerFactories."""
         return []
@@ -140,6 +149,10 @@ def install(plugin: Plugin, catalogs: Optional[Dict] = None) -> Plugin:
     ConnectorManager.createConnection)."""
     for item in plugin.scalar_functions():
         _install_function(_as_spec(item))
+    for agg in plugin.aggregate_functions():
+        from presto_tpu.exec import agg_states as AS
+
+        AS.register_aggregate(agg)
     if catalogs is not None:
         for name, conn in plugin.connectors().items():
             if name in catalogs:

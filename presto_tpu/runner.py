@@ -147,6 +147,47 @@ class LocalRunner:
         )
         ex.pallas_join = bool(self.session.get("pallas_join_enabled"))
 
+    def estimate_memory(self, sql: str) -> int:
+        """Crude peak-HBM estimate for admission control (reference:
+        the coordinator-side memory accounting ClusterMemoryManager
+        consults): sum of join-build and aggregation-state
+        materializations plus one streamed page per scan. Statements
+        that don't plan as queries (DDL/SET/...) get a small floor."""
+        from presto_tpu.exec.executor import _row_bytes
+
+        floor = 1 << 24
+        try:
+            plan = self.plan(sql)
+        except Exception:
+            return floor
+        ex = self.executor
+        total = 0
+
+        def walk(n):
+            nonlocal total
+            if isinstance(n, P.HashJoin):
+                total += ex.estimate_rows(n.right) * _row_bytes(
+                    ex.output_types(n.right)
+                )
+            if isinstance(n, P.Aggregation) and n.group_channels:
+                total += min(
+                    ex.estimate_rows(n), n.capacity
+                ) * _row_bytes(ex.output_types(n))
+            if isinstance(n, (P.Sort, P.Window, P.MarkDistinct)):
+                total += ex.estimate_rows(n) * _row_bytes(
+                    ex.output_types(n)
+                )
+            if isinstance(n, P.TableScan):
+                rows = min(
+                    ex.estimate_rows(n), self.executor.page_rows
+                )
+                total += rows * _row_bytes(ex.output_types(n))
+            for c in n.children():
+                walk(c)
+
+        walk(plan)
+        return max(total, floor)
+
     def execute(self, sql: str) -> QueryResult:
         stmt = parse(sql)
         # session properties gate the accelerator path per query

@@ -60,17 +60,51 @@ class _Query:
         }
 
 
+class MemoryArbiter:
+    """Admission by estimated HBM footprint (reference:
+    memory/ClusterMemoryManager + query.max-memory): queries reserve
+    their estimate and block until it fits the budget. A query larger
+    than the whole budget is admitted only when it would run alone —
+    progress is guaranteed, concurrency degrades to serial exactly
+    when memory demands it (the reference's reserved-pool promotion)."""
+
+    def __init__(self, total_bytes: int):
+        self.total = int(total_bytes)
+        self.used = 0
+        self.active = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, est: int, should_abort=None) -> bool:
+        with self._cv:
+            while True:
+                if should_abort is not None and should_abort():
+                    return False
+                if self.used + est <= self.total or self.active == 0:
+                    self.used += est
+                    self.active += 1
+                    return True
+                self._cv.wait(timeout=0.1)
+
+    def release(self, est: int) -> None:
+        with self._cv:
+            self.used -= est
+            self.active -= 1
+            self._cv.notify_all()
+
+
 class QueryManager:
     """Reference: execution/SqlQueryManager.java — registry + lifecycle
     (QUEUED -> RUNNING -> FINISHED/FAILED/CANCELED)."""
 
     def __init__(self, runner_factory, listeners=(),
-                 resource_groups=None):
+                 resource_groups=None, memory_arbiter=None):
         self._runner_factory = runner_factory
         self._queries: Dict[str, _Query] = {}
         self._seq = 0
         self._lock = threading.Lock()
-        self._exec_lock = threading.Lock()  # one query on the device
+        # serial fallback when no arbiter is configured
+        self._exec_lock = threading.Lock()
+        self.memory = memory_arbiter
         self.listeners = list(listeners)
         # admission control (reference: resourceGroups/*; None = admit
         # everything, the pre-RG behavior)
@@ -139,7 +173,26 @@ class QueryManager:
                 self.resource_groups.release(group)
 
     def _run_locked(self, q: _Query) -> None:
-        with self._exec_lock:
+        if self.memory is None:
+            with self._exec_lock:
+                self._execute(q)
+            return
+        # concurrent path: admission by estimated footprint replaces
+        # the global device lock (VERDICT r2 #8); each query runs on
+        # its own runner/executor (shared jit cache), so small queries
+        # interleave while the arbiter keeps the sum under budget
+        runner = self._runner_factory(q.session)
+        est = runner.estimate_memory(q.sql)
+        if not self.memory.acquire(est,
+                                   should_abort=lambda: q.cancelled):
+            self._record_completion(q)
+            return
+        try:
+            self._execute(q, runner)
+        finally:
+            self.memory.release(est)
+
+    def _execute(self, q: _Query, runner=None) -> None:
             if q.cancelled:
                 # canceled while queued: still record completion so event
                 # listeners and /metrics see every created query finish
@@ -147,7 +200,8 @@ class QueryManager:
                 return
             q.state = "RUNNING"
             try:
-                runner = self._runner_factory(q.session)
+                if runner is None:
+                    runner = self._runner_factory(q.session)
                 result = runner.execute(q.sql)
                 types = result.column_types or [
                     "unknown" for _ in result.column_names
@@ -417,6 +471,7 @@ class PrestoTpuServer:
         peer_uris=(),
         plugins=(),
         resource_groups=None,
+        memory_budget_bytes: Optional[int] = None,
     ):
         from presto_tpu.runner import LocalRunner
 
@@ -444,19 +499,44 @@ class PrestoTpuServer:
         except Exception:  # pragma: no cover
             self.backend_name = "unknown"
 
-        # one engine, re-sessioned per query (plans/jit caches persist)
+        # bootstrap runner installs plugins into catalogs/registries;
+        # it also serves the serial (no-arbiter) path
         self._runner = LocalRunner(
             catalogs, default_catalog=default_catalog,
             page_rows=page_rows, mesh=mesh, plugins=plugins,
         )
+        self.catalogs = self._runner.catalogs  # incl. plugin catalogs
+        # compiled kernels shared across per-query executors (the
+        # compiled-expression LRU is process-wide in the reference too)
+        self._shared_jit_cache = self._runner.executor._jit_cache
+        self._mesh = mesh
+        self._page_rows = page_rows
+        self._default_catalog = default_catalog
+
+        memory_arbiter = None
+        if memory_budget_bytes:
+            memory_arbiter = MemoryArbiter(memory_budget_bytes)
 
         def runner_factory(session: Session):
-            self._runner.session = session
-            return self._runner
+            if memory_arbiter is None:
+                # serial path: one engine, re-sessioned per query
+                self._runner.session = session
+                return self._runner
+            # concurrent path: per-query runner/executor so query state
+            # (overflow flags, capacity boosts, stream caches) never
+            # crosses queries; compiled kernels are shared
+            r = LocalRunner(
+                self.catalogs, default_catalog=self._default_catalog,
+                page_rows=self._page_rows, mesh=self._mesh,
+                session=session,
+            )
+            r.executor._jit_cache = self._shared_jit_cache
+            return r
 
         self.manager = QueryManager(runner_factory,
                                     listeners=event_listeners,
-                                    resource_groups=resource_groups)
+                                    resource_groups=resource_groups,
+                                    memory_arbiter=memory_arbiter)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 

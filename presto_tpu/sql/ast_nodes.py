@@ -167,6 +167,23 @@ class SubqueryRelation(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class UnnestRelation(Node):
+    """UNNEST(expr) [WITH ORDINALITY] — lateral expansion of an array
+    expression over the preceding FROM items (reference:
+    sql/tree/Unnest)."""
+
+    expr: Node
+    with_ordinality: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayLiteral(Node):
+    """ARRAY[e1, e2, ...] (reference: sql/tree/ArrayConstructor)."""
+
+    items: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class JoinRelation(Node):
     join_type: str  # inner | left | right | full | cross
     left: Node
@@ -202,6 +219,10 @@ class QuerySpec(Node):
     order_by: Tuple[OrderItem, ...]
     limit: Optional[int]
     offset: int = 0
+    # GROUPING SETS / ROLLUP / CUBE: each set = indices into group_by
+    # (the union key list); None = plain GROUP BY (reference:
+    # sql/tree/GroupBy + GroupingSets/Rollup/Cube elements)
+    grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)

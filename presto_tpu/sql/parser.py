@@ -23,7 +23,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<dquoted>"(?:[^"]|"")*")
   | (?P<name>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;])
+  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -38,7 +38,8 @@ KEYWORDS = {
     "timestamp", "interval", "true", "false", "explain", "analyze",
     "substring", "for", "create", "table", "drop", "insert", "into",
     "set", "session", "show", "tables", "over", "partition",
-    "delete", "update",
+    "delete", "update", "grouping", "sets", "rollup", "cube",
+    "unnest", "ordinality", "array",
 }
 
 
@@ -335,11 +336,10 @@ class Parser:
         where = self.parse_expr() if self.accept_keyword("where") else None
 
         group_by: List[N.Node] = []
+        grouping_sets = None
         if self.accept_keyword("group"):
             self.expect_keyword("by")
-            group_by.append(self.parse_expr())
-            while self.accept_op(","):
-                group_by.append(self.parse_expr())
+            group_by, grouping_sets = self.parse_group_by_body()
 
         having = self.parse_expr() if self.accept_keyword("having") else None
 
@@ -347,7 +347,64 @@ class Parser:
             select=tuple(select), distinct=distinct, from_=tuple(from_),
             where=where, group_by=tuple(group_by), having=having,
             order_by=(), limit=None, offset=0,
+            grouping_sets=grouping_sets,
         )
+
+    def parse_group_by_body(self):
+        """Plain key list, or GROUPING SETS / ROLLUP / CUBE (reference:
+        SqlBase.g4 groupingElement). Returns (union key list, set index
+        tuples or None)."""
+
+        def key_index(keys: List[N.Node], e: N.Node) -> int:
+            for i, k in enumerate(keys):
+                if k == e:
+                    return i
+            keys.append(e)
+            return len(keys) - 1
+
+        if self.accept_keyword("grouping"):
+            self.expect_keyword("sets")
+            self.expect_op("(")
+            keys: List[N.Node] = []
+            sets: List[Tuple[int, ...]] = []
+            while True:
+                self.expect_op("(")
+                members: List[int] = []
+                if not self.accept_op(")"):
+                    members.append(key_index(keys, self.parse_expr()))
+                    while self.accept_op(","):
+                        members.append(key_index(keys, self.parse_expr()))
+                    self.expect_op(")")
+                sets.append(tuple(members))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return keys, tuple(sets)
+        if self.accept_keyword("rollup"):
+            self.expect_op("(")
+            keys = [self.parse_expr()]
+            while self.accept_op(","):
+                keys.append(self.parse_expr())
+            self.expect_op(")")
+            n = len(keys)
+            return keys, tuple(
+                tuple(range(k)) for k in range(n, -1, -1)
+            )
+        if self.accept_keyword("cube"):
+            self.expect_op("(")
+            keys = [self.parse_expr()]
+            while self.accept_op(","):
+                keys.append(self.parse_expr())
+            self.expect_op(")")
+            n = len(keys)
+            return keys, tuple(
+                tuple(i for i in range(n) if mask & (1 << i))
+                for mask in range((1 << n) - 1, -1, -1)
+            )
+        group_by = [self.parse_expr()]
+        while self.accept_op(","):
+            group_by.append(self.parse_expr())
+        return group_by, None
 
     def parse_order_by(self) -> Tuple[N.OrderItem, ...]:
         self.expect_keyword("order")
@@ -429,7 +486,16 @@ class Parser:
             left = N.JoinRelation(jt, left, right, on)
 
     def parse_aliased_relation(self) -> N.Node:
-        if self.accept_op("("):
+        if self.accept_keyword("unnest"):
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_op(")")
+            with_ord = False
+            if self.accept_keyword("with"):
+                self.expect_keyword("ordinality")
+                with_ord = True
+            rel = N.UnnestRelation(e, with_ord)
+        elif self.accept_op("("):
             if self.at_keyword("select", "with"):
                 rel: N.Node = N.SubqueryRelation(self.parse_query())
             else:
@@ -560,6 +626,15 @@ class Parser:
     def parse_keyword_expr(self) -> N.Node:
         if self.accept_keyword("not"):
             return N.UnaryOp("not", self.parse_expr(3))
+        if self.accept_keyword("array"):
+            self.expect_op("[")
+            items: List[N.Node] = []
+            if not self.accept_op("]"):
+                items.append(self.parse_expr())
+                while self.accept_op(","):
+                    items.append(self.parse_expr())
+                self.expect_op("]")
+            return N.ArrayLiteral(tuple(items))
         if self.accept_keyword("exists"):
             self.expect_op("(")
             q = self.parse_query()

@@ -59,6 +59,29 @@ _AGG_CANON = {"stddev": "stddev_samp", "variance": "var_samp",
 def _canon_agg(name: str) -> str:
     return _AGG_CANON.get(name, name)
 
+
+def _is_agg_name(name: str) -> bool:
+    """Builtin aggregates plus plugin-registered ones (reference:
+    FunctionRegistry resolution spanning builtins and plugins)."""
+    return name in AGG_FUNCTIONS or AS.is_plugin_aggregate(name)
+
+
+def _extract_unnests(item: N.Node):
+    """Peel UNNEST relations off a FROM item: returns (base relation or
+    None, [(UnnestRelation, column_aliases), ...])."""
+    if isinstance(item, N.UnnestRelation):
+        return None, [(item, ())]
+    if isinstance(item, N.AliasedRelation) and isinstance(
+        item.relation, N.UnnestRelation
+    ):
+        return None, [(item.relation, tuple(item.column_aliases))]
+    if isinstance(item, N.JoinRelation) and item.join_type == "cross":
+        rbase, runs = _extract_unnests(item.right)
+        if rbase is None and runs:
+            lbase, lruns = _extract_unnests(item.left)
+            return lbase, lruns + runs
+    return item, []
+
 _EPOCH = datetime.date(1970, 1, 1)
 
 
@@ -316,7 +339,7 @@ def find_aggregates(e: N.Node) -> List[N.FunctionCall]:
                 walk(o.expr)
             return
         if isinstance(x, N.FunctionCall) and (
-            x.name in AGG_FUNCTIONS or x.is_star
+            _is_agg_name(x.name) or x.is_star
         ):
             out.append(x)
             return
@@ -662,13 +685,26 @@ class Planner:
     ):
         """Plan FROM relations and WHERE; returns (RelationPlan, corr_eqs,
         residual_correlated) where corr_eqs are (outer_channel,
-        local_channel) equality pairs when collect_correlation is set."""
-        if not spec.from_:
+        local_channel) equality pairs when collect_correlation is set.
+
+        UNNEST items are lateral: they are peeled off the FROM list
+        here and applied AFTER the join tree, where their array
+        expressions can see every base relation's columns. (WHERE
+        conjuncts cannot reference UNNEST outputs in this version —
+        filter in an enclosing query.)"""
+        base_items: List[N.Node] = []
+        unnests: List[Tuple[N.UnnestRelation, tuple]] = []
+        for item in spec.from_:
+            b, us = _extract_unnests(item)
+            if b is not None:
+                base_items.append(b)
+            unnests.extend(us)
+        if not base_items:
             rp = RelationPlan(P.Values((T.BIGINT,), ((0,),)),
                               [Field(None, T.BIGINT)])
             units = [rp]
         else:
-            units = [self.plan_relation(r, outer) for r in spec.from_]
+            units = [self.plan_relation(r, outer) for r in base_items]
 
         offsets = []
         total = 0
@@ -769,6 +805,28 @@ class Planner:
             plan = RelationPlan(
                 P.Filter(plan.node, _and_ir(post)), plan.fields
             )
+
+        # lateral UNNEST expansion over the joined relation
+        for un, cols in unnests:
+            tr2 = ExprTranslator(self, Scope(plan.fields, outer))
+            e = tr2.translate(un.expr)
+            if not isinstance(e.type, T.ArrayType):
+                raise PlanningError(
+                    f"UNNEST requires an array-typed expression, got "
+                    f"{e.type}"
+                )
+            ch = self._append_channel(plan, e)
+            elem_t = e.type.element
+            plan.node = P.Unnest(plan.node, ch, elem_t,
+                                 un.with_ordinality)
+            plan.fields = plan.fields + [
+                Field(cols[0] if cols else None, elem_t)
+            ]
+            if un.with_ordinality:
+                plan.fields = plan.fields + [
+                    Field(cols[1] if len(cols) > 1 else "ordinality",
+                          T.BIGINT)
+                ]
         return plan, corr_eqs, corr_residual
 
     def _try_subquery_conjunct(self, c: N.Node, scope: Scope,
@@ -1120,7 +1178,8 @@ class Planner:
                 else:
                     group_irs.append(tr.translate(g))
             (plan2, names) = self._plan_aggregation_block(
-                plan, scope, group_irs, list(spec.select), spec.having
+                plan, scope, group_irs, list(spec.select), spec.having,
+                grouping_sets=spec.grouping_sets,
             )
             plan = plan2
         else:
@@ -1275,6 +1334,7 @@ class Planner:
         select_items: List[N.SelectItem],
         having: Optional[N.Node],
         include_keys: bool = False,
+        grouping_sets=None,
     ):
         """GROUP BY block: pre-project group keys + agg args, aggregate,
         post-project select expressions with agg calls substituted
@@ -1309,17 +1369,36 @@ class Planner:
             if (_canon_agg(a.name) in AS.VARIANCE_FNS
                     and e.type != T.DOUBLE):
                 e = ir.cast(e, T.DOUBLE)
+            idx = None
             if e in pre_exprs:
-                agg_arg_ch.append(pre_exprs.index(e))
-            else:
+                i0 = pre_exprs.index(e)
+                # under GROUPING SETS an aggregate argument must NOT
+                # alias a group-key channel: GroupId nulls absent keys
+                # per replica and would null the aggregate's input too
+                if grouping_sets is None or i0 >= len(group_irs):
+                    idx = i0
+            if idx is None:
                 pre_exprs.append(e)
-                agg_arg_ch.append(len(pre_exprs) - 1)
+                idx = len(pre_exprs) - 1
+            agg_arg_ch.append(idx)
             agg_arg_ir.append(e)
         pre_fields = [Field(None, e.type) for e in pre_exprs]
         pre = RelationPlan(P.Project(plan.node, tuple(pre_exprs)),
                            pre_fields)
 
         nkeys = len(group_irs)
+        # GROUPING SETS: expand through GroupId and aggregate over
+        # (keys..., gid) — absent keys are NULLed per replica, and the
+        # gid keeps visibly-equal groups of different sets apart
+        # (reference: plan/GroupIdNode lowering)
+        gid_extra = 0
+        if grouping_sets is not None:
+            if distinct_aggs:
+                raise PlanningError(
+                    "DISTINCT aggregates with GROUPING SETS are not "
+                    "supported yet"
+                )
+            gid_extra = 1
         d_channels = sorted({
             ch for a, ch in zip(uniq_aggs, agg_arg_ch) if a.distinct
         })
@@ -1373,16 +1452,29 @@ class Planner:
                     specs.append(P.AggSpec("count_star", None))
                 else:
                     specs.append(P.AggSpec(fn, ch))
+            src_node = pre.node
+            group_channels = tuple(range(nkeys))
+            if grouping_sets is not None:
+                masks = tuple(
+                    tuple(i in s for i in range(nkeys))
+                    for s in grouping_sets
+                )
+                src_node = P.GroupId(pre.node, tuple(range(nkeys)),
+                                     masks)
+                # gid channel appended after every pre-projection column
+                group_channels = group_channels + (len(pre_exprs),)
             agg_node = P.Aggregation(
-                pre.node, tuple(range(nkeys)), tuple(specs),
-                capacity=_agg_capacity(pre.node, self.catalogs),
+                src_node, group_channels, tuple(specs),
+                capacity=_agg_capacity(src_node, self.catalogs),
             )
 
-        # aggregate output fields: keys then one per agg
+        # aggregate output fields: keys (then gid) then one per agg
         out_fields: List[Field] = []
         for i, g in enumerate(group_irs):
             nm = None
             out_fields.append(Field(nm, g.type))
+        for _ in range(gid_extra):
+            out_fields.append(Field(None, T.BIGINT))
         for a, e in zip(uniq_aggs, agg_arg_ir):
             if a.is_star or e is None:
                 out_t = T.BIGINT
@@ -1396,7 +1488,10 @@ class Planner:
         # substitution: agg AST -> channel; group ir -> channel
         subst: Dict[object, ir.RowExpression] = {}
         for i, a in enumerate(uniq_aggs):
-            ref = ir.InputRef(nkeys + i, out_fields[nkeys + i].type)
+            ref = ir.InputRef(
+                nkeys + gid_extra + i,
+                out_fields[nkeys + gid_extra + i].type,
+            )
             subst[a] = ref
         group_map = {e: i for i, e in enumerate(group_irs)}
 
@@ -1573,6 +1668,27 @@ class ExprTranslator:
             return OuterRef(ch, f.type)
         if isinstance(e, N.Literal):
             return _literal(e)
+        if isinstance(e, N.ArrayLiteral):
+            items = [self._tr(i) for i in e.items]
+            vals = []
+            elem_t: T.SqlType = T.UNKNOWN
+            for it in items:
+                if not isinstance(it, ir.Constant):
+                    raise PlanningError(
+                        "ARRAY[...] elements must be constants"
+                    )
+                vals.append(it.value)
+                if not isinstance(it.type, T.UnknownType):
+                    ct = (T.common_super_type(elem_t, it.type)
+                          if not isinstance(elem_t, T.UnknownType)
+                          else it.type)
+                    if ct is None:
+                        raise PlanningError(
+                            f"ARRAY[] elements have incompatible types: "
+                            f"{elem_t} vs {it.type}"
+                        )
+                    elem_t = ct
+            return ir.Constant(tuple(vals), T.ArrayType(elem_t))
         if isinstance(e, N.UnaryOp):
             if e.op == "not":
                 return ir.not_(self._tr(e.operand))
@@ -1614,7 +1730,7 @@ class ExprTranslator:
         if isinstance(e, N.Extract):
             return ir.call(e.field.lower(), self._tr(e.value))
         if isinstance(e, N.FunctionCall):
-            if e.name in AGG_FUNCTIONS or e.is_star:
+            if _is_agg_name(e.name) or e.is_star:
                 raise PlanningError(
                     f"aggregate {e.name} in invalid context"
                 )

@@ -282,19 +282,56 @@ class UnknownType(SqlType):
 
 @dataclasses.dataclass(frozen=True)
 class ArrayType(SqlType):
+    """ARRAY(element). Device representation: dictionary-coded i32 —
+    the distinct array VALUES (Python tuples) live in a host-side
+    Dictionary, rows carry codes (reference: spi/block/ArrayBlock's
+    offsets+elements, re-expressed for static shapes: per-value work
+    happens once per distinct array on the host at trace time, row
+    work is vectorized gathers — same scheme as strings)."""
+
     element: SqlType = dataclasses.field(default_factory=UnknownType)
     name: str = dataclasses.field(init=False, default="array")
 
     @property
     def device_dtype(self):
-        return self.element.device_dtype
+        return jnp.int32
+
+    @property
+    def is_dictionary_encoded(self) -> bool:
+        return True
 
     def display(self) -> str:
         return f"array({self.element.display()})"
 
 
 @dataclasses.dataclass(frozen=True)
+class MapType(SqlType):
+    """MAP(key, value): dictionary-coded like ARRAY; each distinct map
+    value is a Python tuple of (key, value) pairs (reference:
+    spi/block/ MapBlock / SingleMapBlock)."""
+
+    key: SqlType = dataclasses.field(default_factory=UnknownType)
+    value: SqlType = dataclasses.field(default_factory=UnknownType)
+    name: str = dataclasses.field(init=False, default="map")
+
+    @property
+    def device_dtype(self):
+        return jnp.int32
+
+    @property
+    def is_dictionary_encoded(self) -> bool:
+        return True
+
+    def display(self) -> str:
+        return f"map({self.key.display()}, {self.value.display()})"
+
+
+@dataclasses.dataclass(frozen=True)
 class RowType(SqlType):
+    """ROW(fields...): dictionary-coded; each distinct row value is a
+    Python tuple (reference: spi/block/RowBlock). Field access via
+    element_at(row, ordinal)."""
+
     fields: tuple = ()
     field_names: tuple = ()
     name: str = dataclasses.field(init=False, default="row")
@@ -302,6 +339,10 @@ class RowType(SqlType):
     @property
     def device_dtype(self):
         return jnp.int32
+
+    @property
+    def is_dictionary_encoded(self) -> bool:
+        return True
 
     def display(self) -> str:
         inner = ", ".join(f.display() for f in self.fields)
@@ -380,7 +421,21 @@ def parse_type(text: str) -> SqlType:
             raise ValueError(f"malformed type: {text!r}")
         base, rest = s.split("(", 1)
         base = base.strip()
-        args = [a.strip() for a in rest[:-1].split(",") if a.strip()]
+        # split on top-level commas only (nested parametric types:
+        # map(bigint, array(varchar)))
+        args, depth, cur = [], 0, []
+        for ch in rest[:-1]:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            if ch == "," and depth == 0:
+                args.append("".join(cur).strip())
+                cur = []
+            else:
+                cur.append(ch)
+        if cur and "".join(cur).strip():
+            args.append("".join(cur).strip())
     simple = {
         "bigint": BIGINT,
         "integer": INTEGER,
@@ -414,6 +469,15 @@ def parse_type(text: str) -> SqlType:
         if len(args) == 1:
             return DecimalType(int(args[0]), 0)
         return DecimalType(38, 0)
+    if base == "array":
+        return ArrayType(parse_type(args[0]) if args else UNKNOWN)
+    if base == "map":
+        return MapType(
+            parse_type(args[0]) if args else UNKNOWN,
+            parse_type(args[1]) if len(args) > 1 else UNKNOWN,
+        )
+    if base == "row":
+        return RowType(tuple(parse_type(a) for a in args))
     raise ValueError(f"unknown type: {text!r}")
 
 
