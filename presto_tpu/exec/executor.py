@@ -1236,16 +1236,45 @@ class Executor:
             unique_build=unique_build,
         )
 
-    # ------------------------------------------------ Pallas fast path
+    # ---------------------------------------------------- Pallas paths
+    def _pallas_mode_allows(self, layout) -> bool:
+        """pallas_join_enabled semantics: "off" never; "force" always
+        (oversized/unlowerable layouts run the kernels in interpret
+        mode — the CPU test path); "auto" only layouts whose kernel
+        REALLY lowers through Mosaic, and only on TPU (the interpreted
+        kernels exist for testing, not speed)."""
+        from presto_tpu.ops import pallas_join as PJ
+
+        mode = self.pallas_join
+        if mode in (False, None, "off"):
+            return False
+        if mode in (True, "force"):
+            return True
+        return (
+            jax.default_backend() == "tpu"
+            and PJ.layout_lowers_on_tpu(layout)
+        )
+
+    @staticmethod
+    def _pallas_interpret(layout) -> bool:
+        from presto_tpu.ops import pallas_join as PJ
+
+        return not (
+            jax.default_backend() == "tpu"
+            and PJ.layout_lowers_on_tpu(layout)
+        )
+
     def _pallas_join_eligible(self, node, build: Page, left_types,
                               right_types) -> bool:
-        """The VMEM-resident open-addressing probe applies to inner/left
-        joins on ONE non-string key whose build side scans a connector-
-        declared UNIQUE column (<=1 match per probe row, so no output
-        expansion) and fits the table in VMEM. Boosted retries fall back
-        to the general join (the overflow flag may have come from the
-        Pallas build)."""
-        if not self.pallas_join or self._capacity_boost > 1:
+        """Unique-key fast path: inner/left joins on ONE u64-encodable
+        key whose build side scans a connector-declared UNIQUE column —
+        <=1 match per probe row, so the probe page extends in place with
+        no match expansion at all. Boosted retries fall back to the
+        general join (the overflow flag may have come from the Pallas
+        table build)."""
+        from presto_tpu.ops import pallas_join as PJ
+
+        if self._capacity_boost > 1:
             return False
         if node.join_type not in ("inner", "left"):
             return False
@@ -1253,17 +1282,40 @@ class Executor:
             return False
         for t in (left_types[node.left_keys[0]],
                   right_types[node.right_keys[0]]):
-            if T.is_string(t):
+            if T.is_string(t) or t.is_dictionary_encoded:
+                # dictionary codes are not comparable across sides
+                # without the merged-universe canonicalization the
+                # general path does
                 return False
             if isinstance(t, T.DecimalType) and not t.is_short:
                 # long decimals encode as (hi, lo) limb pairs — one u64
                 # key cannot carry them
                 return False
-        if build.capacity > (1 << 19):
-            # table = 2x capacity x 3 int32 arrays, loaded whole into
-            # VMEM per grid step; 1<<19 keeps it ~12 MB (<16 MB budget)
+        if build.capacity > PJ.RADIX_MAX_BUILD:
+            return False
+        if not self._pallas_mode_allows(PJ.plan_layout(build.capacity)):
             return False
         return self._scan_column_unique(node.right, node.right_keys[0])
+
+    def _radix_join_eligible(self, node, build: Page) -> bool:
+        """The radix-partitioned Pallas join (ops/pallas_join.py) as the
+        general range finder for inner/left/right/full equi-joins: any
+        key count/types, duplicate build keys. On TPU (auto) it engages
+        for layouts whose kernel really lowers (the dim layout — star-
+        schema dimension builds); forced mode additionally runs the
+        bucketed radix kernel in interpret mode up to RADIX_MAX_BUILD
+        rows (the CPU test path). Boosted retries fall back to the sort
+        join — the overflow may have been a bucket overfull in the
+        Pallas table build."""
+        if self._capacity_boost > 1:
+            return False
+        if node.join_type not in ("inner", "left", "right", "full"):
+            return False
+        from presto_tpu.ops import pallas_join as PJ
+
+        if build.capacity > PJ.RADIX_MAX_BUILD:
+            return False
+        return self._pallas_mode_allows(PJ.plan_layout(build.capacity))
 
     def _scan_column_unique(self, n: P.PhysicalNode, ch: int) -> bool:
         """Whether channel ch of node n provably carries a unique table
@@ -1276,25 +1328,24 @@ class Executor:
         from presto_tpu.ops import pallas_join as PJ
 
         self.pallas_joins_used += 1
-        interpret = jax.default_backend() != "tpu"
-        bblk = build.block(node.right_keys[0])
-        bkeys = K.equality_encoding(bblk)[0]
-        bvalid = build.valid
-        if bblk.nulls is not None:
-            bvalid = bvalid & ~bblk.nulls
-        table, build_ovf = PJ.build_table(
-            bkeys, bvalid, PJ.table_capacity(build.capacity)
-        )
+        layout = PJ.plan_layout(build.capacity)
+        interpret = self._pallas_interpret(layout)
+        index, build_ovf = self._jit(
+            ("pallas_ubuild", node, build.capacity),
+            functools.partial(
+                _pallas_unique_build, node.right_keys[0], layout
+            ),
+        )(build)
         self._pending_overflow.append(build_ovf)
         fn = self._jit(
             ("pallas_probe", node, build.capacity, interpret),
             functools.partial(
                 _pallas_probe_page, node.left_keys[0], node.join_type,
-                interpret,
+                layout, interpret,
             ),
         )
         for page in self.pages(node.left):
-            yield fn(page, build, table)
+            yield fn(page, build, index)
 
     def _exec_join_partitioned(
         self, node: P.HashJoin, parts: int, left_types, right_types,
@@ -1365,14 +1416,33 @@ class Executor:
                 yield fn(page, build)
             return
 
-        probe_fn = self._jit(
-            ("join_probe", node, build.capacity),
-            functools.partial(
-                _probe_join_page, node.left_keys, node.right_keys,
-                node.join_type
-            ),
-            static_argnums=(3,),
-        )
+        # Radix Pallas path: same verified match expansion, but the
+        # candidate ranges come from the bucketed open-addressing kernel
+        # instead of searchsorted (north-star's radix-partitioned join)
+        use_radix = self._radix_join_eligible(node, build)
+        if use_radix:
+            from presto_tpu.ops import pallas_join as PJ
+
+            self.pallas_joins_used += 1
+            layout = PJ.plan_layout(build.capacity)
+            interpret = self._pallas_interpret(layout)
+            probe_fn = self._jit(
+                ("radix_probe", node, build.capacity, interpret),
+                functools.partial(
+                    _probe_radix_join_page, node.left_keys,
+                    node.right_keys, node.join_type, layout, interpret,
+                ),
+                static_argnums=(3,),
+            )
+        else:
+            probe_fn = self._jit(
+                ("join_probe", node, build.capacity),
+                functools.partial(
+                    _probe_join_page, node.left_keys, node.right_keys,
+                    node.join_type
+                ),
+                static_argnums=(3,),
+            )
         build_matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
         # canonical key encodings depend on the probe page's dictionaries
         # (merged-universe remap), which can differ across pages when the
@@ -1385,13 +1455,26 @@ class Executor:
                 page.block(c).dictionary for c in node.left_keys
             )
             if sig not in indexes:
-                indexes[sig] = self._jit(
-                    ("join_build", node, build.capacity, sig),
-                    functools.partial(
-                        _build_join_index, node.left_keys,
-                        node.right_keys,
-                    ),
-                )(page, build)
+                if use_radix:
+                    index, b_ovf = self._jit(
+                        ("radix_build", node, build.capacity, sig),
+                        functools.partial(
+                            _build_radix_join_index, node.left_keys,
+                            node.right_keys, layout,
+                        ),
+                    )(page, build)
+                    # bucket-overfull escape: boosted retries fall back
+                    # to the sort join (eligibility checks the boost)
+                    self._pending_overflow.append(b_ovf)
+                else:
+                    index = self._jit(
+                        ("join_build", node, build.capacity, sig),
+                        functools.partial(
+                            _build_join_index, node.left_keys,
+                            node.right_keys,
+                        ),
+                    )(page, build)
+                indexes[sig] = index
             index = indexes[sig]
             # probe-relative sizing (many-to-one joins dominate), with a
             # build term for small-probe fan-out joins, clamped so the 2x
@@ -1433,20 +1516,44 @@ class Executor:
 # jit caches hit across pages.
 
 
-def _pallas_probe_page(key_ch, join_type, interpret, page: Page,
-                       build: Page, table) -> Page:
-    """Probe one page through the Pallas open-addressing kernel: unique
-    build keys mean <=1 match per probe row, so the output page is the
-    probe page extended with gathered build columns (no expansion)."""
+def _pallas_unique_build(key_ch, layout, build: Page):
+    """Unique-key Pallas index over the IDENTITY u64 key encoding —
+    in-kernel (lo, hi) equality IS key equality, so probe hits extend
+    rows without re-verification."""
     from presto_tpu.ops import pallas_join as PJ
 
+    blk = build.block(key_ch)
+    bkeys = K.equality_encoding(blk)[0]
+    bvalid = build.valid
+    if blk.nulls is not None:
+        bvalid = bvalid & ~blk.nulls
+    tables, perm, ovf = PJ.build_index(
+        bkeys.astype(jnp.uint64), bvalid, layout
+    )
+    return (tables, perm), ovf
+
+
+def _pallas_probe_page(key_ch, join_type, layout, interpret, page: Page,
+                       build: Page, index) -> Page:
+    """Probe one page through the Pallas kernel: unique build keys mean
+    <=1 match per probe row, so the output page is the probe page
+    extended with gathered build columns (no expansion)."""
+    from presto_tpu.ops import pallas_join as PJ
+
+    tables, perm = index
     blk = page.block(key_ch)
     pkeys = K.equality_encoding(blk)[0]
-    rid = PJ.probe_any(pkeys, table, interpret=interpret)
     valid_key = page.valid
     if blk.nulls is not None:
         valid_key = valid_key & ~blk.nulls
-    rid = jnp.where(valid_key, rid, jnp.int32(-1))
+    start, cnt = PJ.probe_index(
+        pkeys.astype(jnp.uint64), tables, layout, interpret=interpret
+    )
+    hit = valid_key & (cnt > 0)
+    rid = jnp.where(
+        hit, perm[jnp.clip(start, 0, None)].astype(jnp.int32),
+        jnp.int32(-1),
+    )
     matched = rid >= 0
     safe = jnp.clip(rid, 0, build.capacity - 1).astype(jnp.int64)
     right_blocks = []
@@ -1902,6 +2009,53 @@ def _probe_join_page(left_keys, right_keys, join_type, page: Page,
     m = J.hash_join_match(
         None, None, None, lcols, lnulls, page.valid, out_cap, index=index
     )
+    return _assemble_join_output(join_type, page, build, m)
+
+
+def _build_radix_join_index(left_keys, right_keys, layout, page: Page,
+                            build: Page):
+    """Pallas join index (kernel): hash-sorted build order + the
+    layout-shaped per-unique-hash (start, count) tables. The probe page
+    supplies the static dictionary context, as in _build_join_index."""
+    from presto_tpu.ops import pallas_join as PJ
+
+    lblocks = [page.block(c) for c in left_keys]
+    rblocks = [build.block(c) for c in right_keys]
+    _lcols, _lnulls, rcols, rnulls = _canonical_join_cols(lblocks, rblocks)
+    bcols, b_null = J._fold_nulls(rcols, rnulls, False)
+    bvalid = build.valid & ~b_null
+    bhash = H.hash_columns(bcols, [None] * len(bcols))
+    tables, perm, overflow = PJ.build_index(bhash, bvalid, layout)
+    return (tuple(bcols), bvalid, perm, tables), overflow
+
+
+def _probe_radix_join_page(left_keys, right_keys, join_type, layout,
+                           interpret, page: Page, build: Page,
+                           index, out_cap: int):
+    """Probe one page through the Pallas range kernel, then the shared
+    verified expansion (J.expand_matches) — identical output contract to
+    _probe_join_page; only the range finder differs."""
+    from presto_tpu.ops import pallas_join as PJ
+
+    lblocks = [page.block(c) for c in left_keys]
+    rblocks = [build.block(c) for c in right_keys]
+    lcols, lnulls, _rcols, _rnulls = _canonical_join_cols(lblocks, rblocks)
+    bcols, bvalid, perm, tables = index
+    pcols, p_null = J._fold_nulls(lcols, lnulls, False)
+    pvalid = page.valid & ~p_null
+    phash = H.hash_columns(pcols, [None] * len(pcols))
+    start, cnt = PJ.probe_index(
+        phash, tables, layout, interpret=interpret
+    )
+    m = J.expand_matches(
+        bcols, bvalid, perm, pcols, pvalid,
+        jnp.clip(start, 0, None), cnt, out_cap,
+    )
+    return _assemble_join_output(join_type, page, build, m)
+
+
+def _assemble_join_output(join_type, page: Page, build: Page,
+                          m: J.JoinMatches):
     out_valid = m.match
     left_out = gather_rows(page, m.probe_idx, out_valid)
     right_out = gather_rows(build, m.build_idx, out_valid)

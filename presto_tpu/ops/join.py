@@ -22,9 +22,11 @@ outer-row emission (LEFT/RIGHT/FULL) and semi joins assemble from the match
 statistics returned here (reference: LookupJoinOperators factories,
 HashSemiJoinOperator).
 
-A Pallas open-addressing probe kernel for the dominant unique-key joins
-lives in presto_tpu/ops/pallas_join.py (north-star requirement); executor
-wiring behind a session flag is the documented next step.
+The Pallas radix-partitioned kernels in presto_tpu/ops/pallas_join.py
+(north-star requirement) replace the searchsorted range finder on TPU —
+they produce the same per-probe-row [lo, lo+count) candidate ranges and
+share expand_matches() below for verified expansion. The executor picks
+per join (pallas_join_enabled=auto: Pallas on TPU, sort elsewhere).
 """
 
 from __future__ import annotations
@@ -122,8 +124,6 @@ def hash_join_match(
             null_equals_null=null_equals_null,
         )
     bcols, bvalid, sorted_hash, perm = index
-    build_cap = bvalid.shape[0]
-    probe_cap = probe_valid.shape[0]
 
     pcols, p_null_out = _fold_nulls(probe_cols, probe_nulls, null_equals_null)
     pvalid = probe_valid & ~p_null_out
@@ -131,7 +131,31 @@ def hash_join_match(
 
     lo = jnp.searchsorted(sorted_hash, phash, side="left")
     hi = jnp.searchsorted(sorted_hash, phash, side="right")
-    counts = jnp.where(pvalid, (hi - lo).astype(jnp.int64), 0)
+    counts = (hi - lo).astype(jnp.int64)
+
+    return expand_matches(
+        bcols, bvalid, perm, pcols, pvalid, lo, counts, out_capacity
+    )
+
+
+def expand_matches(
+    bcols,
+    bvalid: jnp.ndarray,
+    perm: jnp.ndarray,
+    pcols,
+    pvalid: jnp.ndarray,
+    lo: jnp.ndarray,
+    counts: jnp.ndarray,
+    out_capacity: int,
+) -> JoinMatches:
+    """Flatten per-probe-row candidate ranges [lo, lo+counts) over the
+    hash-sorted build order `perm` into a fixed-capacity match list,
+    verifying true key equality per slot. Shared tail of the sort join
+    (searchsorted ranges) and the Pallas radix join (kernel-probed
+    ranges) — the range *finder* is the only thing that differs."""
+    build_cap = bvalid.shape[0]
+    probe_cap = pvalid.shape[0]
+    counts = jnp.where(pvalid, counts.astype(jnp.int64), 0)
 
     cum = jnp.cumsum(counts)
     total = cum[-1] if counts.shape[0] else jnp.int64(0)

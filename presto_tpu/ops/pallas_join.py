@@ -1,42 +1,71 @@
-"""Pallas TPU hash-join probe kernel (north-star: "hash join as a Pallas
+"""Pallas TPU hash-join kernels (north-star: "hash join as a Pallas
 radix-partitioned join", SURVEY §8.2.2).
 
-Scope (v1, deliberately narrow): single 64-bit key, UNIQUE build keys —
-the primary-key joins that dominate TPC-H (lineitem->orders on orderkey,
-orders->customer on custkey). The general path (duplicate keys, multi-key,
-nulls) stays on the sort+searchsorted join in ops/join.py; this kernel is
-the VMEM-resident fast path for the common shape.
+The join contract is shared with the sort join (ops/join.py): an index
+over the HASH-SORTED build side where equal-hash rows form contiguous
+segments, and a probe that returns, per probe row, the segment range
+(start, count) of equal-hash build rows. ops/join.expand_matches then
+flattens ranges into verified matches identically for every range
+finder — searchsorted (sort join) or the open-addressing tables here.
 
-Design:
-  build (XLA, once per join): vectorized open-addressing insert — every
-    build row claims slots by scatter-min of its row id, lockstep linear
-    probing (same deterministic scheme as ops/agg.compute_groups_hashed).
-    Table = (key lo32, key hi32, row id) arrays, capacity 2x rows, pow2.
-  probe (Pallas): grid over probe-row blocks; each block loads its keys
-    into VMEM, computes the initial slot from the mixed key, then runs K
-    bounded probe rounds entirely on the VPU — gather table entries,
-    compare lo/hi words, advance unresolved lanes to the next slot.
-    Returns the matching build row id or -1 per probe row.
+Two table layouts, picked by plan_layout(build_capacity):
 
-u64 handling: TPU lanes are 32-bit, so keys travel as (lo32, hi32) int32
-pairs and the table is int32 throughout — no 64-bit emulation inside the
-kernel. The table must fit VMEM (~16 MB: up to ~1M build rows); larger
-builds stay on the sort join (the caller checks).
+1. **"dim"** — dimension-table layout, up to DIM_MAX_BUILD build rows.
+   The table is T radix tiles of 128 entries; each tile is replicated
+   across the 8 sublanes, so a probe block gathers entries with the ONE
+   per-lane gather this Mosaic toolchain lowers: jnp.take_along_axis on
+   an (8, 128) value along the lane axis (verified on hardware; every
+   wider/per-ref gather form crashes the tpu_compile_helper). Collision
+   chains stay inside a tile's 128 lanes. This is the REAL compiled
+   kernel and the default on TPU (pallas_join_enabled=auto) — it serves
+   the broadcast-side joins of star schemas (region/nation in Q5).
+
+2. **"radix"** — general bucketed layout up to RADIX_MAX_BUILD rows:
+   VMEM-sized buckets addressed by the hash's top bits, one (hash,
+   start, count) entry per unique hash, probed by a (bucket,
+   probe-block) grid kernel. The kernel is correct and covered by the
+   CPU suite in interpret mode, but its per-lane table gather exceeds
+   what this Mosaic version can lower, so on TPU it runs only when
+   forced (pallas_join_enabled=true) and then in interpret mode
+   (XLA-emulated grid). The blueprint is written for the day the
+   toolchain grows vector gather; until then big builds default to the
+   sort join, which is the better TPU program anyway.
+
+Reference: presto-main operator/{PagesIndex,JoinHash}.java — the
+address-sorted PagesIndex plus an open-addressing hash over row
+addresses is exactly this index, minus the pointer chasing.
+
+u64 handling: TPU lanes are 32-bit, so hashes travel as (lo32, hi32)
+int32 pairs and tables are int32 throughout. Loop carries in kernels are
+int32/int32-vectors only — boolean vector carries crash this compiler
+(bisected on hardware).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-_EMPTY = jnp.int32(-1)
+# dim layout: T (pow2) tiles x 128 lanes, row-replicated; chains wrap
+# within a tile's 128 lanes. 2x-entries load factor => builds up to
+# DIM_TILES_MAX * 128 / 2 rows.
+DIM_TILES_MAX = 32
+DIM_MAX_BUILD = DIM_TILES_MAX * 128 // 2  # 2048 rows
+# probe groups of (8, 128) keys processed per grid step (amortizes the
+# per-step fixed cost)
+_DIM_GROUPS = 16
+
+# radix layout: buckets of 2^14 entries (4 x int32 arrays = 256 KB per
+# bucket slice)
+BUCKET_CAP = 1 << 14
+RADIX_MAX_BUILD = 1 << 20
+
+_MAX_ITERS = 64
 
 
-def _split64(keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _split64(keys: jnp.ndarray):
     u = keys.astype(jnp.uint64)
     lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32).astype(jnp.int32)
     hi = (u >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32)
@@ -56,39 +85,84 @@ def _mix32(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
-def build_table(
-    keys: jnp.ndarray, valid: jnp.ndarray, table_cap: int,
-    max_iters: int = 64,
-):
-    """Open-addressing insert of (unique) build keys, fully vectorized.
+def plan_layout(build_cap: int):
+    """Static layout choice for a build of `build_cap` rows:
+    ("dim", tiles) or ("radix", (num_buckets, bucket_cap)). Hashable —
+    executors put it in jit cache keys."""
+    if build_cap <= DIM_MAX_BUILD:
+        total = max(128, 1 << (2 * build_cap - 1).bit_length())
+        return ("dim", total // 128)
+    total = max(BUCKET_CAP, 1 << (2 * build_cap - 1).bit_length())
+    return ("radix", (total // BUCKET_CAP, BUCKET_CAP))
 
-    Returns (tab_lo, tab_hi, tab_row) int32[table_cap] plus an overflow
-    flag (unresolved rows after max_iters — callers fall back to the
-    sort join)."""
-    n = keys.shape[0]
-    lo, hi = _split64(keys)
-    h = _mix32(lo, hi)
-    mask = jnp.uint32(table_cap - 1)
-    slot0 = (h & mask).astype(jnp.int32)
-    row_idx = jnp.arange(n, dtype=jnp.int32)
+
+# ----------------------------------------------------------- index build
+
+
+def _sorted_segments(bhash: jnp.ndarray, bvalid: jnp.ndarray):
+    """Hash-sort the build side; equal-hash runs become segments. Per
+    sorted row: the segment's first VALID position and valid count.
+    Invalid rows poison to the max hash and sort last, so ordinary
+    segments hold only valid rows. Callers must exclude VALID rows
+    carrying the poison hash itself beforehand (build_index does, via
+    the overflow escape): inside the max-hash segment the stable sort
+    preserves the original valid/invalid interleaving, so (vstart,
+    vcnt) would cover a non-contiguous valid set and drop matches."""
+    n = bhash.shape[0]
+    poisoned = jnp.where(bvalid, bhash, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    perm = jnp.argsort(poisoned)
+    sorted_h = poisoned[perm]
+    valid_s = bvalid[perm]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_h[1:] != sorted_h[:-1]]
+    )
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    vcnt = (
+        jnp.zeros((n,), jnp.int32).at[seg_id].add(valid_s.astype(jnp.int32))
+    )[seg_id]
+    vstart = (
+        jnp.full((n,), n, jnp.int32)
+        .at[jnp.where(valid_s, seg_id, n)]
+        .min(idx, mode="drop")
+    )[seg_id]
+    # one entry per segment with >=1 valid row, anchored at its first
+    # valid sorted position
+    entry = valid_s & (idx == vstart) & (vcnt > 0)
+    return perm, sorted_h, entry, vstart, vcnt
+
+
+def _insert(sorted_h, entry, vstart, vcnt, base, width, table_cap,
+            max_iters: int = _MAX_ITERS):
+    """Vectorized open-addressing insert of segment entries by
+    scatter-min, lockstep linear probing within each entry's [base,
+    base+width) span. Returns flat (lo, hi, start, count) int32 tables
+    and an overflow flag (unsettled after max_iters — callers fall back
+    to the sort join)."""
+    n = sorted_h.shape[0]
+    lo, hi = _split64(sorted_h)
+    h32 = _mix32(lo, hi)
+    wmask = jnp.uint32(width - 1)
+    slot0 = base + (h32 & wmask).astype(jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
     BIG = jnp.int32(n)
 
     def settled(owner, slot):
-        win = owner[slot]
-        return valid & (win == row_idx)
+        return entry & (owner[slot] == idx)
 
     def cond(state):
         owner, slot, it = state
-        return jnp.any(valid & ~settled(owner, slot)) & (it < max_iters)
+        return jnp.any(entry & ~settled(owner, slot)) & (it < max_iters)
 
     def body(state):
         owner, slot, it = state
         done = settled(owner, slot)
-        claim = jnp.where(done | ~valid, BIG, row_idx)
+        claim = jnp.where(done | ~entry, BIG, idx)
         owner = owner.at[slot].min(claim)
         done2 = settled(owner, slot)
-        nxt = (slot.astype(jnp.uint32) + jnp.uint32(1)) & mask
-        slot = jnp.where(done2 | ~valid, slot, nxt.astype(jnp.int32))
+        within = (slot - base).astype(jnp.uint32)
+        nxt = base + ((within + jnp.uint32(1)) & wmask).astype(jnp.int32)
+        slot = jnp.where(done2 | ~entry, slot, nxt)
         return owner, slot, it + 1
 
     owner0 = jnp.full((table_cap,), BIG, dtype=jnp.int32)
@@ -96,106 +170,302 @@ def build_table(
         cond, body, (owner0, slot0, jnp.int32(0))
     )
     ok = settled(owner, slot)
-    overflow = jnp.any(valid & ~ok)
-    tab_row = jnp.full((table_cap,), _EMPTY, dtype=jnp.int32)
-    tab_row = tab_row.at[jnp.where(ok, slot, table_cap)].set(
-        row_idx, mode="drop"
+    overflow = jnp.any(entry & ~ok)
+    tgt = jnp.where(ok, slot, table_cap)
+    tab_lo = jnp.zeros((table_cap,), jnp.int32).at[tgt].set(lo, mode="drop")
+    tab_hi = jnp.zeros((table_cap,), jnp.int32).at[tgt].set(hi, mode="drop")
+    tab_start = jnp.zeros((table_cap,), jnp.int32).at[tgt].set(
+        vstart, mode="drop")
+    tab_count = jnp.zeros((table_cap,), jnp.int32).at[tgt].set(
+        vcnt, mode="drop")
+    return (tab_lo, tab_hi, tab_start, tab_count), overflow
+
+
+def build_index(bhash: jnp.ndarray, bvalid: jnp.ndarray, layout):
+    """Build the (start, count) range index for `layout` (plan_layout).
+
+    Returns (tables, perm, overflow): `perm` is the hash-sorted build
+    order that start/count ranges refer to; `tables` is layout-shaped:
+      dim:   4 x int32[T, 8, 128] (row-replicated tiles)
+      radix: 4 x int32[num_buckets * bucket_cap] (flat bucketed)
+    """
+    # a VALID row whose hash equals the poison value would interleave
+    # with poisoned invalid rows inside the max-hash segment and lose
+    # matches (stable sort keeps original order there) — exclude such
+    # rows and raise overflow so the query retries on the exact sort
+    # join. Identity-encoded keys hit this for BIGINT -1; real hashes
+    # at 2^-64.
+    MAXU = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    poison_conflict = jnp.any(bvalid & (bhash == MAXU))
+    bvalid = bvalid & (bhash != MAXU)
+    perm, sorted_h, entry, vstart, vcnt = _sorted_segments(bhash, bvalid)
+    lo, hi = _split64(sorted_h)
+    h32 = _mix32(lo, hi)
+    kind, spec = layout
+    if kind == "dim":
+        tiles = spec
+        tile = (
+            ((h32 >> jnp.uint32(7))
+             & jnp.uint32(tiles - 1)).astype(jnp.int32)
+            if tiles > 1 else jnp.zeros(h32.shape, jnp.int32)
+        )
+        tabs, overflow = _insert(
+            sorted_h, entry, vstart, vcnt, tile * 128, 128, tiles * 128
+        )
+        tabs = tuple(
+            jnp.broadcast_to(t.reshape(tiles, 1, 128), (tiles, 8, 128))
+            for t in tabs
+        )
+        return tabs, perm, overflow | poison_conflict
+    num_buckets, bucket_cap = spec
+    log2b = (num_buckets - 1).bit_length() if num_buckets > 1 else 0
+    bucket = (
+        (h32 >> jnp.uint32(32 - log2b)).astype(jnp.int32)
+        if log2b else jnp.zeros(h32.shape, jnp.int32)
     )
-    tab_lo = jnp.zeros((table_cap,), dtype=jnp.int32).at[
-        jnp.where(ok, slot, table_cap)
-    ].set(lo, mode="drop")
-    tab_hi = jnp.zeros((table_cap,), dtype=jnp.int32).at[
-        jnp.where(ok, slot, table_cap)
-    ].set(hi, mode="drop")
-    return (tab_lo, tab_hi, tab_row), overflow
-
-
-def _probe_kernel(plo_ref, phi_ref, tlo_ref, thi_ref, trow_ref, out_ref,
-                  *, table_cap: int, max_probes: int):
-    plo = plo_ref[:]
-    phi = phi_ref[:]
-    h = _mix32(plo, phi)
-    mask = jnp.uint32(table_cap - 1)
-    slot = (h & mask).astype(jnp.int32)
-    result = jnp.full(plo.shape, -1, dtype=jnp.int32)
-    live = jnp.ones(plo.shape, dtype=jnp.bool_)
-
-    def body(_i, carry):
-        slot, result, live = carry
-        tlo = tlo_ref[slot]
-        thi = thi_ref[slot]
-        trow = trow_ref[slot]
-        hit = live & (trow != -1) & (tlo == plo) & (thi == phi)
-        result = jnp.where(hit, trow, result)
-        # stop on hit or empty slot; otherwise advance
-        live = live & ~hit & (trow != -1)
-        nxt = ((slot.astype(jnp.uint32) + jnp.uint32(1)) & mask)
-        slot = jnp.where(live, nxt.astype(jnp.int32), slot)
-        return slot, result, live
-
-    slot, result, live = jax.lax.fori_loop(
-        0, max_probes, body, (slot, result, live)
+    tabs, overflow = _insert(
+        sorted_h, entry, vstart, vcnt, bucket * bucket_cap, bucket_cap,
+        num_buckets * bucket_cap,
     )
-    out_ref[:] = result
+    return tabs, perm, overflow | poison_conflict
 
 
-def probe(
-    probe_keys: jnp.ndarray,
-    table,
-    *,
-    block_rows: int = 2048,
-    max_probes: int = 64,
-    interpret: bool = False,
-) -> jnp.ndarray:
-    """Pallas probe: per probe key, the matching build row id or -1.
+# ------------------------------------------------------------ dim probe
 
-    probe_keys length must be a multiple of block_rows (pad with any
-    value; unmatched padding returns -1 naturally unless it collides —
-    callers mask by validity anyway)."""
+# the ONE per-lane gather this Mosaic version lowers: within-row gather
+# along the lane axis of an (8, 128) value, batched over sublanes.
+# jnp.take_along_axis builds the same GatherDimensionNumbers but
+# promotes indices to int64 under jax_enable_x64, which Mosaic rejects —
+# so call lax.gather directly with int32 indices.
+_LANE_GATHER_DNUMS = jax.lax.GatherDimensionNumbers(
+    offset_dims=(),
+    collapsed_slice_dims=(1,),
+    start_index_map=(1,),
+    operand_batching_dims=(0,),
+    start_indices_batching_dims=(0,),
+)
+
+
+def _gather_lanes(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """out[i, j] = x[i, idx[i, j]] for (8, 128) int32 operands."""
+    return jax.lax.gather(
+        x, idx[..., None], _LANE_GATHER_DNUMS, (1, 1),
+        mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS,
+    )
+
+
+def _dim_kernel(plo_ref, phi_ref, tlo_ref, thi_ref, tstart_ref,
+                tcnt_ref, start_ref, cnt_ref, *, tiles: int,
+                groups: int, max_probes: int):
+    for g in range(groups):
+        sl = slice(g * 128, (g + 1) * 128)
+        plo = plo_ref[:, sl]
+        phi = phi_ref[:, sl]
+        h32 = _mix32(plo, phi)
+        tile_k = (
+            ((h32 >> jnp.uint32(7))
+             & jnp.uint32(tiles - 1)).astype(jnp.int32)
+            if tiles > 1 else jnp.zeros(plo.shape, jnp.int32)
+        )
+        slot = (h32 & jnp.uint32(127)).astype(jnp.int32)
+        start = jnp.full(plo.shape, -1, jnp.int32)
+        cnt = jnp.zeros(plo.shape, jnp.int32)
+        live = jnp.ones(plo.shape, jnp.int32)  # int32: bool vector
+        # loop carries crash this Mosaic version (bisected)
+
+        def cond(c):
+            i, slot, start, cnt, live = c
+            # int32 max-reduction: jnp.any's bool reduction trips the
+            # Mosaic squeeze lowering under jax_enable_x64
+            return (i < max_probes) & (jnp.max(live) > 0)
+
+        def body(c):
+            i, slot, start, cnt, live = c
+            live_b = live > 0
+            die = jnp.zeros(plo.shape, jnp.bool_)
+            for t in range(tiles):
+                sel = live_b & (tile_k == t) if tiles > 1 else live_b
+                glo = _gather_lanes(tlo_ref[t], slot)
+                ghi = _gather_lanes(thi_ref[t], slot)
+                gc = _gather_lanes(tcnt_ref[t], slot)
+                occupied = gc > 0
+                hit = sel & occupied & (glo == plo) & (ghi == phi)
+                start = jnp.where(
+                    hit, _gather_lanes(tstart_ref[t], slot), start
+                )
+                cnt = jnp.where(hit, gc, cnt)
+                die = die | (sel & (hit | ~occupied))
+            # jnp.int32(0), not 0: a bare python int becomes an i64
+            # scalar under jax_enable_x64 and Mosaic has no 64-bit
+            live = jnp.where(die, jnp.int32(0), live)
+            slot = jnp.where(live > 0, (slot + 1) & 127, slot)
+            return i + 1, slot, start, cnt, live
+
+        _, slot, start, cnt, live = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), slot, start, cnt, live),
+        )
+        start_ref[:, sl] = start
+        cnt_ref[:, sl] = cnt
+
+
+def _probe_dim(probe_hash, tables, tiles, *, interpret,
+               max_probes: int = _MAX_ITERS + 1):
     from jax.experimental import pallas as pl
 
-    tab_lo, tab_hi, tab_row = table
-    table_cap = tab_lo.shape[0]
-    n = probe_keys.shape[0]
-    assert n % block_rows == 0, (n, block_rows)
-    plo, phi = _split64(probe_keys)
-
-    grid = (n // block_rows,)
-    blk = pl.BlockSpec((block_rows,), lambda i: (i,))
-    whole = pl.BlockSpec((table_cap,), lambda i: (0,))
-    kernel = functools.partial(
-        _probe_kernel, table_cap=table_cap, max_probes=max_probes
-    )
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
-        grid=grid,
-        in_specs=[blk, blk, whole, whole, whole],
-        out_specs=blk,
-        interpret=interpret,
-    )(plo, phi, tab_lo, tab_hi, tab_row)
-
-
-def table_capacity(build_rows: int) -> int:
-    """2x-rows open-addressing capacity, pow2 (load factor <= 0.5)."""
-    return max(16, 1 << (2 * build_rows - 1).bit_length())
-
-
-def probe_any(
-    probe_keys: jnp.ndarray, table, *, interpret: bool = False
-) -> jnp.ndarray:
-    """probe() for ANY input length: Pallas rank-1 blocks must evenly
-    tile the array (multiples of 128 in practice), so inputs are padded
-    to a 2048 multiple and the pad lanes sliced off. Pad keys are zeros;
-    callers mask results by probe validity regardless."""
-    n = probe_keys.shape[0]
-    pad = (-n) % 2048
+    n = probe_hash.shape[0]
+    groups = _DIM_GROUPS
+    block_keys = 8 * 128 * groups
+    if n <= 8 * 128:
+        groups, block_keys = 1, 8 * 128
+    pad = (-n) % block_keys
     if pad:
-        probe_keys = jnp.concatenate(
-            [probe_keys, jnp.zeros((pad,), probe_keys.dtype)]
+        probe_hash = jnp.concatenate(
+            [probe_hash, jnp.zeros((pad,), probe_hash.dtype)]
         )
-    rid = probe(probe_keys, table, block_rows=2048, interpret=interpret)
-    return rid[:n]
+    rows = probe_hash.shape[0] // (128 * groups)
+    plo, phi = _split64(probe_hash)
+    plo2 = plo.reshape(rows, 128 * groups)
+    phi2 = phi.reshape(rows, 128 * groups)
+
+    grid = (rows // 8,)
+    pblk = pl.BlockSpec((8, 128 * groups), lambda j: (j, 0))
+    tblk = pl.BlockSpec((tiles, 8, 128), lambda j: (0, 0, 0))
+    kernel = functools.partial(
+        _dim_kernel, tiles=tiles, groups=groups, max_probes=max_probes
+    )
+
+    def call():
+        return pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((rows, 128 * groups), jnp.int32),
+                jax.ShapeDtypeStruct((rows, 128 * groups), jnp.int32),
+            ),
+            grid=grid,
+            in_specs=[pblk, pblk, tblk, tblk, tblk, tblk],
+            out_specs=(pblk, pblk),
+            interpret=interpret,
+        )(plo2, phi2, *tables)
+
+    if interpret:
+        start, cnt = call()
+    else:
+        # the engine runs with jax_enable_x64 for i64 columns, but x64
+        # tracing breaks Mosaic's loop legalization (bisected on
+        # hardware); the kernel is all-32-bit, so trace it in a local
+        # x64-off context
+        with jax.enable_x64(False):
+            start, cnt = call()
+    return start.reshape(-1)[:n], cnt.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------- radix probe
+
+
+def _radix_kernel(plo_ref, phi_ref, tlo_ref, thi_ref, tstart_ref,
+                  tcnt_ref, start_ref, cnt_ref, *, bucket_cap: int,
+                  log2b: int, max_probes: int):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    plo = plo_ref[:]
+    phi = phi_ref[:]
+    h32 = _mix32(plo, phi)
+    if log2b:
+        live0 = (
+            (h32 >> jnp.uint32(32 - log2b)).astype(jnp.int32) == b
+        )
+    else:
+        live0 = jnp.ones(plo.shape, jnp.bool_)
+    mask = jnp.uint32(bucket_cap - 1)
+    slot = (h32 & mask).astype(jnp.int32)
+    start = jnp.full(plo.shape, -1, dtype=jnp.int32)
+    cnt = jnp.zeros(plo.shape, dtype=jnp.int32)
+    live = live0.astype(jnp.int32)
+
+    def body(_i, carry):
+        slot, start, cnt, live = carry
+        live_b = live > 0
+        tlo = tlo_ref[slot]
+        thi = thi_ref[slot]
+        tc = tcnt_ref[slot]
+        occupied = tc > 0
+        hit = live_b & occupied & (tlo == plo) & (thi == phi)
+        start = jnp.where(hit, tstart_ref[slot], start)
+        cnt = jnp.where(hit, tc, cnt)
+        live = jnp.where(hit | ~occupied, jnp.int32(0), live)
+        nxt = (slot.astype(jnp.uint32) + jnp.uint32(1)) & mask
+        slot = jnp.where(live > 0, nxt.astype(jnp.int32), slot)
+        return slot, start, cnt, live
+
+    slot, start, cnt, live = jax.lax.fori_loop(
+        0, max_probes, body, (slot, start, cnt, live)
+    )
+    start_ref[:] = start
+    cnt_ref[:] = cnt
+
+
+def _probe_radix(probe_hash, tables, num_buckets, bucket_cap, *,
+                 interpret, block_rows: int = 2048,
+                 max_probes: int = _MAX_ITERS + 1):
+    from jax.experimental import pallas as pl
+
+    log2b = (num_buckets - 1).bit_length() if num_buckets > 1 else 0
+    n = probe_hash.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        probe_hash = jnp.concatenate(
+            [probe_hash, jnp.zeros((pad,), probe_hash.dtype)]
+        )
+    np_ = probe_hash.shape[0]
+    plo, phi = _split64(probe_hash)
+
+    nblocks = np_ // block_rows
+    grid = (num_buckets, nblocks)
+    pblk = pl.BlockSpec((block_rows,), lambda b, j: (j,))
+    tblk = pl.BlockSpec((bucket_cap,), lambda b, j: (b,))
+    oblk = pl.BlockSpec((block_rows,), lambda b, j: (b * nblocks + j,))
+    kernel = functools.partial(
+        _radix_kernel, bucket_cap=bucket_cap, log2b=log2b,
+        max_probes=max_probes,
+    )
+    start_b, cnt_b = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((num_buckets * np_,), jnp.int32),
+            jax.ShapeDtypeStruct((num_buckets * np_,), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[pblk, pblk, tblk, tblk, tblk, tblk],
+        out_specs=(oblk, oblk),
+        interpret=interpret,
+    )(plo, phi, *tables)
+    start = jnp.max(start_b.reshape(num_buckets, np_), axis=0)
+    cnt = jnp.max(cnt_b.reshape(num_buckets, np_), axis=0)
+    return start[:n], cnt[:n]
+
+
+def probe_index(probe_hash: jnp.ndarray, tables, layout, *,
+                interpret: bool = False):
+    """Per probe row, the hash-sorted build segment (start, count) of
+    equal-hash valid build rows ((-1, 0) when none)."""
+    kind, spec = layout
+    if kind == "dim":
+        return _probe_dim(probe_hash, tables, spec, interpret=interpret)
+    nb, bc = spec
+    return _probe_radix(probe_hash, tables, nb, bc, interpret=interpret)
+
+
+def layout_lowers_on_tpu(layout) -> bool:
+    """Whether this layout's probe kernel actually lowers through
+    Mosaic on the current toolchain (the dim kernel does; the radix
+    kernel's per-lane table gather does not and must run interpreted —
+    see module docstring)."""
+    return layout[0] == "dim"
+
+
+# ------------------------------------------------------- unique wrapper
 
 
 def join_unique(
@@ -206,13 +476,24 @@ def join_unique(
     *,
     interpret: bool = False,
 ):
-    """End-to-end unique-key inner-join mapping: for each probe row the
-    matching build row id or -1. Returns (row_ids, overflow)."""
+    """Unique-build-key inner-join mapping: per probe row the matching
+    VALID build row id, or -1. Uses the IDENTITY u64 encoding as the
+    hash, so in-kernel (lo, hi) equality IS key equality — callers may
+    extend rows by the returned id without re-verification.
+
+    Returns (row_ids int32, overflow)."""
     nb = int(build_keys.shape[0])
-    table, overflow = build_table(build_keys, build_valid,
-                                  table_capacity(nb))
-    rid = probe_any(probe_keys, table, interpret=interpret)
-    rid = jnp.where(probe_valid, rid, -1)
-    # reject matches onto invalid build rows (valid rows never share slots
-    # with them because invalid rows never settle)
+    layout = plan_layout(nb)
+    tables, perm, overflow = build_index(
+        build_keys.astype(jnp.uint64), build_valid, layout
+    )
+    start, cnt = probe_index(
+        probe_keys.astype(jnp.uint64), tables, layout, interpret=interpret
+    )
+    hit = probe_valid & (cnt > 0)
+    rid = jnp.where(
+        hit,
+        perm[jnp.clip(start, 0, None)].astype(jnp.int32),
+        jnp.int32(-1),
+    )
     return rid, overflow

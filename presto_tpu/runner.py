@@ -145,7 +145,9 @@ class LocalRunner:
         ex.max_build_rows = (
             int(self.session.get("max_join_build_rows")) or None
         )
-        ex.pallas_join = bool(self.session.get("pallas_join_enabled"))
+        pj = self.session.get("pallas_join_enabled")
+        ex.pallas_join = {"auto": "auto", "true": "force",
+                          "false": "off"}[pj]
 
     def estimate_memory(self, sql: str) -> int:
         """Crude peak-HBM estimate for admission control (reference:

@@ -26,6 +26,12 @@ class PropertyMetadata:
 
 
 def _parse_value(prop: PropertyMetadata, value: Any) -> Any:
+    if prop.type is str:
+        # tri-state and enum properties: accept python bools and any
+        # casing ("SET SESSION x = TRUE" arrives as a string either way)
+        if isinstance(value, bool):
+            return "true" if value else "false"
+        return str(value).strip().lower()
     if isinstance(value, str) and prop.type is bool:
         low = value.strip().lower()
         if low in ("true", "1", "on"):
@@ -92,10 +98,12 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
         ),
         PropertyMetadata(
             "pallas_join_enabled",
-            "use the Pallas open-addressing probe kernel for eligible "
-            "joins (single non-string key, build side a scan of a "
-            "connector-declared unique column that fits VMEM)",
-            bool, False,
+            "use the Pallas join kernels (radix-partitioned general "
+            "join + unique-key fast path) for eligible joins; auto = "
+            "on when running on TPU, off elsewhere (the interpreted "
+            "kernels exist for CPU testing, not speed)",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
         ),
         PropertyMetadata(
             "spill_threshold_bytes",

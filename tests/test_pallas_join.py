@@ -56,6 +56,78 @@ def test_probe_colliding_hashes():
     assert np.all(got[64:] == -1)
 
 
+def _ranges_oracle(bhash, bvalid, phash):
+    """(start, count) per probe hash over the poison-sorted build order."""
+    poisoned = np.where(bvalid, bhash, np.uint64(0xFFFFFFFFFFFFFFFF))
+    order = np.argsort(poisoned, kind="stable")
+    sh = poisoned[order]
+    lo = np.searchsorted(sh, phash, side="left")
+    hi = np.searchsorted(sh, phash, side="right")
+    return lo.astype(np.int32), (hi - lo).astype(np.int32), order
+
+
+@pytest.mark.parametrize(
+    "layout", [("radix", (1, 4096)), ("radix", (4, 1024)), ("dim", 16)]
+)
+def test_ranges_match_oracle(layout):
+    # duplicate keys: draws from a small universe so hash segments have
+    # length > 1; multi-bucket/multi-tile layouts exercise the
+    # partitioned tables
+    rng = np.random.default_rng(3)
+    nb, np_ = 1500, 4096
+    bhash = rng.choice(500, size=nb).astype(np.uint64) * np.uint64(
+        0x9E3779B97F4A7C15
+    )
+    bvalid = rng.random(nb) < 0.9
+    phash = np.concatenate([
+        rng.choice(500, size=np_ - 64).astype(np.uint64)
+        * np.uint64(0x9E3779B97F4A7C15),
+        rng.integers(1, 2**63, size=64, dtype=np.uint64),  # misses
+    ])
+    tabs, perm, overflow = PJ.build_index(
+        jnp.asarray(bhash), jnp.asarray(bvalid), layout
+    )
+    assert not bool(overflow)
+    start, cnt = PJ.probe_index(
+        jnp.asarray(phash), tabs, layout, interpret=True
+    )
+    want_lo, want_cnt, want_order = _ranges_oracle(bhash, bvalid, phash)
+    got_start, got_cnt = np.asarray(start), np.asarray(cnt)
+    assert np.array_equal(got_cnt, want_cnt)
+    hit = want_cnt > 0
+    assert np.array_equal(got_start[hit], want_lo[hit])
+    assert np.all(got_start[~hit] == -1)
+    # the index's sorted order groups equal hashes contiguously
+    sh = np.where(bvalid, bhash, np.uint64(0xFFFFFFFFFFFFFFFF))[
+        np.asarray(perm)
+    ]
+    assert np.array_equal(sh, np.sort(sh))
+
+
+def test_poison_hash_conflict_raises_overflow():
+    # a VALID row whose hash equals the poison value (identity-encoded
+    # BIGINT -1, or a 2^-64 real-hash collision) could interleave with
+    # poisoned invalid rows and silently lose matches — build_index
+    # must exclude it and raise the overflow escape so the query
+    # retries on the exact sort join
+    MAXH = np.uint64(0xFFFFFFFFFFFFFFFF)
+    bhash = np.array([MAXH, 5, MAXH, 7], dtype=np.uint64)
+    bvalid = np.array([True, False, True, True])
+    layout = ("radix", (1, 64))
+    tabs, perm, overflow = PJ.build_index(
+        jnp.asarray(bhash), jnp.asarray(bvalid), layout
+    )
+    assert bool(overflow)
+    # the excluded rows are not in the table; ordinary segments intact
+    start, cnt = PJ.probe_index(
+        jnp.asarray(np.array([MAXH, 7, 6], dtype=np.uint64)),
+        tabs, layout, interpret=True,
+    )
+    start, cnt = np.asarray(start), np.asarray(cnt)
+    assert cnt[0] == 0  # MAX-hash rows excluded, not half-returned
+    assert cnt[1] == 1 and cnt[2] == 0
+
+
 def test_big_key_values():
     # full 64-bit keys (hash encodings) round-trip through the lo/hi split
     rng = np.random.default_rng(7)

@@ -1,8 +1,8 @@
-"""Executor wiring of the Pallas unique-key join fast path
-(pallas_join_enabled session property). Reference: the north-star's
-Pallas radix hash join (SURVEY §8.2.2); the kernel itself is covered by
-test_pallas_join.py — these tests cover eligibility selection and
-end-to-end parity with the general sort join."""
+"""Executor wiring of the Pallas join paths — the unique-key fast path
+and the radix-partitioned general join (pallas_join_enabled session
+property). Reference: the north-star's Pallas radix hash join (SURVEY
+§8.2.2); the kernels are covered by test_pallas_join.py — these tests
+cover eligibility selection and end-to-end parity with the sort join."""
 
 import collections
 
@@ -81,3 +81,50 @@ def test_aggregate_over_pallas_join(base, pallas):
          "customer where o_custkey = c_custkey group by c_mktsegment "
          "order by 1")
     assert _same(base.execute(q).rows, pallas.execute(q).rows)
+
+
+# ----------------------------------------------------- radix general join
+
+
+def test_radix_duplicate_key_self_join(base, pallas):
+    # self-join on NON-unique o_custkey: duplicate build keys fan out —
+    # the radix kernel's (start, count) segment ranges, not the unique
+    # fast path
+    q = ("select count(*), sum(o1.o_totalprice) from orders o1, "
+         "orders o2 where o1.o_custkey = o2.o_custkey")
+    before = pallas.executor.pallas_joins_used
+    assert _same(base.execute(q).rows, pallas.execute(q).rows)
+    assert pallas.executor.pallas_joins_used > before
+
+
+def test_radix_multi_key_join(base, pallas):
+    # composite (partkey, suppkey) key: multi-key joins hash-combine
+    # into one 64-bit row hash and verify per-column equality after
+    # expansion
+    q = ("select count(*), sum(ps_availqty) from lineitem, partsupp "
+         "where l_partkey = ps_partkey and l_suppkey = ps_suppkey")
+    before = pallas.executor.pallas_joins_used
+    assert _same(base.execute(q).rows, pallas.execute(q).rows)
+    assert pallas.executor.pallas_joins_used > before
+
+
+def test_radix_outer_join(base, pallas):
+    # unmatched-side emission (right/full) rides the radix match stats
+    q = ("select count(*), count(o_orderkey), count(c_custkey) from "
+         "(select * from orders where o_orderkey < 5000) o right join "
+         "customer on o_custkey = c_custkey")
+    before = pallas.executor.pallas_joins_used
+    a, b = base.execute(q).rows, pallas.execute(q).rows
+    assert _same(a, b)
+    assert pallas.executor.pallas_joins_used > before
+
+
+def test_radix_string_key_join(base, pallas):
+    # dictionary-coded string keys canonicalize through the merged
+    # universe before hashing — eligible for the radix path (the unique
+    # fast path refuses strings)
+    q = ("select count(*), min(n1.n_nationkey) from nation n1, "
+         "nation n2 where n1.n_name = n2.n_name")
+    before = pallas.executor.pallas_joins_used
+    assert _same(base.execute(q).rows, pallas.execute(q).rows)
+    assert pallas.executor.pallas_joins_used > before

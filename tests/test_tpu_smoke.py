@@ -51,3 +51,63 @@ def test_q6_on_real_tpu():
     )
     assert "TPU_SMOKE_OK" in out.stdout, (out.stdout[-500:],
                                           out.stderr[-1500:])
+
+
+PALLAS_SCRIPT = r"""
+import jax
+jax.config.update("jax_compilation_cache_dir", %r)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+assert jax.default_backend() == "tpu", jax.default_backend()
+import jax.numpy as jnp
+import numpy as np
+from presto_tpu.ops import pallas_join as PJ
+
+rng = np.random.default_rng(5)
+nb, np_ = 1800, 100352
+bhash = rng.choice(900, size=nb).astype(np.uint64) * np.uint64(
+    0x9E3779B97F4A7C15)
+bvalid = rng.random(nb) < 0.9
+phash = rng.choice(1100, size=np_).astype(np.uint64) * np.uint64(
+    0x9E3779B97F4A7C15)
+layout = PJ.plan_layout(nb)
+assert PJ.layout_lowers_on_tpu(layout), layout
+tabs, perm, ovf = PJ.build_index(
+    jnp.asarray(bhash), jnp.asarray(bvalid), layout)
+start, cnt = PJ.probe_index(
+    jnp.asarray(phash), tabs, layout, interpret=False)  # REAL Mosaic
+got_s, got_c = np.asarray(start), np.asarray(cnt)
+poisoned = np.where(bvalid, bhash, np.uint64(0xFFFFFFFFFFFFFFFF))
+sh = poisoned[np.argsort(poisoned, kind="stable")]
+lo = np.searchsorted(sh, phash, side="left").astype(np.int32)
+wc = (np.searchsorted(sh, phash, side="right") - lo).astype(np.int32)
+assert np.array_equal(got_c, wc)
+hit = wc > 0
+assert np.array_equal(got_s[hit], lo[hit]) and np.all(got_s[~hit] == -1)
+assert not bool(ovf)
+print("PALLAS_TPU_OK", int(hit.sum()))
+"""
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(
+    os.environ.get("RUN_TPU_SMOKE") != "1",
+    reason="opt-in (RUN_TPU_SMOKE=1): needs the real chip",
+)
+def test_pallas_dim_join_kernel_on_real_tpu():
+    """The dim-layout Pallas join kernel through REAL Mosaic lowering
+    (interpret=False), oracle-checked — the non-interpret parity check
+    VERDICT r2 #4 requires. The general radix layout stays interpreted
+    on this toolchain (no per-lane wide gather; see ops/pallas_join.py
+    module docstring)."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         PALLAS_SCRIPT % os.path.join(REPO, ".jax_cache")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert "PALLAS_TPU_OK" in out.stdout, (out.stdout[-500:],
+                                           out.stderr[-1500:])

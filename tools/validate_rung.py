@@ -40,6 +40,7 @@ def main() -> int:
         "wall_with_decode_s": round(wall, 2),
         "checksum_crc32": csum,
         "capacity_boost": runner.executor._capacity_boost,
+        "pallas_joins_used": runner.executor.pallas_joins_used,
         "head": [str(v)[:24] for v in (result.rows[0] if result.rows
                                        else [])],
     }))
