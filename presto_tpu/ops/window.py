@@ -33,19 +33,25 @@ from presto_tpu.ops.sort import SortKey
 from presto_tpu.page import Block, Page
 
 # functions producing BIGINT positions
-RANKING = ("row_number", "rank", "dense_rank")
+RANKING = ("row_number", "rank", "dense_rank", "ntile")
+# distribution functions producing DOUBLE
+DISTRIBUTION = ("percent_rank", "cume_dist")
 # running/frame aggregates
 AGGREGATES = ("sum", "count", "count_star", "avg", "min", "max")
 # offset/navigation functions
-NAVIGATION = ("lag", "lead", "first_value", "last_value")
+NAVIGATION = ("lag", "lead", "first_value", "last_value", "nth_value")
 
 
 @dataclasses.dataclass(frozen=True)
 class WindowFunc:
     function: str
     arg_channel: Optional[int] = None
-    offset: int = 1  # lag/lead
+    offset: int = 1  # lag/lead offset, ntile bucket count, nth_value n
     default_null: bool = True  # lag/lead default is NULL
+    # explicit frame (unit, (start_kind, n), (end_kind, n)) per
+    # sql/tree/WindowFrame; None = SQL default (RANGE UNBOUNDED
+    # PRECEDING..CURRENT ROW with ORDER BY, whole partition without)
+    frame: Optional[Tuple] = None
 
 
 def result_type(fn: WindowFunc, in_type: Optional[T.SqlType]) -> T.SqlType:
@@ -53,6 +59,8 @@ def result_type(fn: WindowFunc, in_type: Optional[T.SqlType]) -> T.SqlType:
 
     if fn.function in RANKING or fn.function in ("count", "count_star"):
         return T.BIGINT
+    if fn.function in DISTRIBUTION:
+        return T.DOUBLE
     if fn.function in ("sum", "avg", "min", "max"):
         rt = S.result_type(fn.function, in_type)
         if isinstance(rt, T.DecimalType) and not rt.is_short:
@@ -61,7 +69,7 @@ def result_type(fn: WindowFunc, in_type: Optional[T.SqlType]) -> T.SqlType:
             # representation (the grouped-agg path uses 128-bit limbs)
             return T.DecimalType(18, rt.scale)
         return rt
-    return in_type  # lag/lead/first_value/last_value
+    return in_type  # lag/lead/first_value/last_value/nth_value
 
 
 def _scan_max(x: jnp.ndarray) -> jnp.ndarray:
@@ -193,6 +201,48 @@ def window_page(
     return Page(blocks=page.blocks + tuple(out_blocks), valid=page.valid)
 
 
+def _frame_bounds(fn, iota, n, seg_start, seg_end, peer_start, peer_end,
+                  has_order):
+    """Per-row frame [fs, fe] in sorted coordinates (fe < fs = empty).
+
+    Reference: operator/window/FramedWindowFunction + WindowFrame.
+    Default: RANGE UNBOUNDED PRECEDING..CURRENT ROW with ORDER BY
+    (frame end = current peer group end), whole partition without."""
+    if fn.frame is None:
+        return seg_start, (peer_end if has_order else seg_end)
+    unit, (sk, sn), (ek, en) = fn.frame
+
+    def bound(kind, nn):
+        if kind == "unbounded_preceding":
+            return seg_start
+        if kind == "unbounded_following":
+            return seg_end
+        if unit == "range":
+            # planner admits only UNBOUNDED/CURRENT for RANGE frames
+            return peer_start if kind == "current" else peer_end
+        if kind == "current":
+            return iota
+        if kind == "preceding":
+            return iota - int(nn)
+        return iota + int(nn)  # following
+
+    fs = bound(sk, sn)
+    fe = bound(ek, en) if unit == "rows" else bound_end_range(
+        ek, peer_end, seg_start, seg_end
+    )
+    fs = jnp.clip(fs, seg_start, seg_end + 1)
+    fe = jnp.clip(fe, seg_start - 1, seg_end)
+    return fs, fe
+
+
+def bound_end_range(kind, peer_end, seg_start, seg_end):
+    if kind == "unbounded_following":
+        return seg_end
+    if kind == "unbounded_preceding":
+        return seg_start
+    return peer_end  # current row extends to its peers
+
+
 def _one_function(fn, blk, page, perm, inv, svalid, iota, n,
                   seg_start, seg_end, peer_end, peer_start, cum_peer,
                   has_order, out_t):
@@ -206,8 +256,43 @@ def _one_function(fn, blk, page, perm, inv, svalid, iota, n,
     if fn.function == "dense_rank":
         res = cum_peer - cum_peer[jnp.clip(seg_start, 0, n - 1)] + 1
         return res[inv], None, None
+    if fn.function == "ntile":
+        # SQL ntile(b): first (size % b) buckets get ceil(size/b) rows
+        size = seg_end - seg_start + 1
+        k = iota - seg_start  # 0-based row number
+        b = jnp.int64(max(fn.offset, 1))
+        q = size // b
+        r = size % b
+        big = r * (q + 1)
+        res = jnp.where(
+            k < big,
+            k // jnp.maximum(q + 1, 1),
+            r + (k - big) // jnp.maximum(q, 1),
+        ) + 1
+        return res[inv], None, None
+    if fn.function == "percent_rank":
+        size = seg_end - seg_start + 1
+        rank = peer_start - seg_start
+        res = jnp.where(
+            size > 1,
+            rank.astype(jnp.float64)
+            / jnp.maximum(size - 1, 1).astype(jnp.float64),
+            0.0,
+        )
+        return res[inv], None, None
+    if fn.function == "cume_dist":
+        size = seg_end - seg_start + 1
+        res = (peer_end - seg_start + 1).astype(jnp.float64) / size.astype(
+            jnp.float64
+        )
+        return res[inv], None, None
 
-    if fn.function in ("lag", "lead", "first_value", "last_value"):
+    fs, fe = _frame_bounds(
+        fn, iota, n, seg_start, seg_end, peer_start, peer_end, has_order
+    )
+
+    if fn.function in ("lag", "lead", "first_value", "last_value",
+                       "nth_value"):
         data = blk.data
         is_tuple = isinstance(data, tuple)
         snulls = (
@@ -220,11 +305,14 @@ def _one_function(fn, blk, page, perm, inv, svalid, iota, n,
             src = iota + fn.offset
             ok = src <= seg_end
         elif fn.function == "first_value":
-            src = seg_start
-            ok = jnp.ones((n,), jnp.bool_)
-        else:  # last_value over default frame = end of current peer group
-            src = peer_end if has_order else seg_end
-            ok = jnp.ones((n,), jnp.bool_)
+            src = fs
+            ok = fe >= fs
+        elif fn.function == "nth_value":
+            src = fs + fn.offset - 1
+            ok = (src <= fe) & (fe >= fs)
+        else:  # last_value = frame end
+            src = fe
+            ok = fe >= fs
         srcc = jnp.clip(src, 0, n - 1)
 
         def gather(d):
@@ -244,30 +332,22 @@ def _one_function(fn, blk, page, perm, inv, svalid, iota, n,
             out = out[inv]
         return out, nulls[inv], blk.dictionary
 
-    # ---- running / whole-partition aggregates ----
+    # ---- frame aggregates: per-row [fs, fe] in sorted coordinates ----
     contributing = svalid
     if blk is not None and blk.nulls is not None:
         contributing = contributing & ~blk.nulls[perm]
-    # frame end in sorted coordinates: RANGE peers with ORDER BY, whole
-    # partition without
-    f_end = peer_end if has_order else seg_end
 
-    ones = contributing.astype(jnp.int64)
-    cnt_cum = jnp.cumsum(ones)
-    cnt_base = jnp.where(
-        seg_start > 0, cnt_cum[jnp.clip(seg_start - 1, 0, n - 1)], 0
-    )
-    count_to = lambda idx: cnt_cum[jnp.clip(idx, 0, n - 1)] - cnt_base  # noqa: E731
-    frame_count = count_to(f_end)
+    def ranged(cum):
+        """cum[fe] - cum[fs-1] over per-row frames, 0 when empty."""
+        base = jnp.where(fs > 0, cum[jnp.clip(fs - 1, 0, n - 1)], 0)
+        out = cum[jnp.clip(fe, 0, n - 1)] - base
+        return jnp.where(fe >= fs, out, jnp.zeros((), dtype=cum.dtype))
+
+    frame_count = ranged(jnp.cumsum(contributing.astype(jnp.int64)))
 
     if fn.function in ("count", "count_star"):
         if fn.arg_channel is None:
-            valid_ones = svalid.astype(jnp.int64)
-            vc = jnp.cumsum(valid_ones)
-            vb = jnp.where(
-                seg_start > 0, vc[jnp.clip(seg_start - 1, 0, n - 1)], 0
-            )
-            res = vc[jnp.clip(f_end, 0, n - 1)] - vb
+            res = ranged(jnp.cumsum(svalid.astype(jnp.int64)))
         else:
             res = frame_count
         return res[inv], None, None
@@ -292,11 +372,7 @@ def _one_function(fn, blk, page, perm, inv, svalid, iota, n,
             jnp.float64 if jnp.issubdtype(sdata.dtype, jnp.floating)
             else jnp.int64
         )
-        cum = jnp.cumsum(acc)
-        base = jnp.where(
-            seg_start > 0, cum[jnp.clip(seg_start - 1, 0, n - 1)], 0
-        )
-        total = cum[jnp.clip(f_end, 0, n - 1)] - base
+        total = ranged(jnp.cumsum(acc))
         if fn.function == "sum":
             res = total.astype(np.dtype(out_t.numpy_dtype))
             return res[inv], empty[inv], None
@@ -321,10 +397,16 @@ def _one_function(fn, blk, page, perm, inv, svalid, iota, n,
             ident = info.max if fn.function == "min" else info.min
         filled = jnp.where(contributing, sdata,
                            jnp.asarray(ident, dtype=sdata.dtype))
-        # inclusive running value, then extend to the frame end
-        part_boundary = seg_start == iota
-        run = _segmented_scan(op, filled, part_boundary)
-        res = run[jnp.clip(f_end, 0, n - 1)]
+        if fn.frame is None or fn.frame[1][0] == "unbounded_preceding":
+            # prefix frames: inclusive running value to the frame end
+            part_boundary = seg_start == iota
+            run = _segmented_scan(op, filled, part_boundary)
+            res = run[jnp.clip(fe, 0, n - 1)]
+        else:
+            # sliding frames: sparse-table range query (O(n log n)
+            # build, O(1) per row — reference walks the frame per row,
+            # operator/window/AggregateWindowFunction)
+            res = _range_query(op, filled, fs, fe, ident)
         if inv_rank is not None:
             res = inv_rank[jnp.clip(res, 0, inv_rank.shape[0] - 1)].astype(
                 data.dtype
@@ -332,3 +414,30 @@ def _one_function(fn, blk, page, perm, inv, svalid, iota, n,
         res = jnp.where(empty, jnp.zeros((), dtype=res.dtype), res)
         return res[inv], empty[inv], dic
     raise ValueError(f"unknown window function {fn.function!r}")
+
+
+def _range_query(op, filled, fs, fe, ident):
+    """Sparse-table RMQ: per-row op-reduction over [fs, fe] (callers
+    handle empty frames). Levels L[k][i] = op over filled[i : i+2^k);
+    query = op(L[k][fs], L[k][fe-2^k+1]) with k = floor(log2(len))."""
+    n = filled.shape[0]
+    levels = [filled]
+    k = 0
+    while (1 << (k + 1)) <= n:
+        cur = levels[-1]
+        step = 1 << k
+        shifted = jnp.concatenate(
+            [cur[step:], jnp.full((step,), ident, dtype=cur.dtype)]
+        )
+        levels.append(op(cur, shifted))
+        k += 1
+    L = jnp.stack(levels)  # (K, n)
+    length = jnp.maximum(fe - fs + 1, 1)
+    # floor(log2(length)) branch-free: count leading bit positions
+    kk = jnp.zeros(length.shape, jnp.int64)
+    for b in range(1, len(levels)):
+        kk = jnp.where(length >= (1 << b), b, kk)
+    a = L[kk, jnp.clip(fs, 0, n - 1)]
+    b_idx = jnp.clip(fe - (jnp.int64(1) << kk) + 1, 0, n - 1)
+    b = L[kk, b_idx]
+    return op(a, b)

@@ -55,6 +55,12 @@ class LocalRunner:
         from presto_tpu.session import Session
 
         self.session = session or Session(catalog=default_catalog)
+        # (catalog, name) -> view SQL text (reference: ConnectorMetadata
+        # createView storage; ours is engine-level, expanded at analysis)
+        self.views: Dict[tuple, str] = {}
+        # prepared-statement registry (reference: Session prepared
+        # statements, PREPARE/EXECUTE/DEALLOCATE)
+        self.prepared: Dict[str, str] = {}
         if mesh is None:
             self.executor = Executor(catalogs, page_rows=page_rows)
         else:
@@ -80,6 +86,7 @@ class LocalRunner:
             self.catalogs,
             self._current_catalog(),
             scalar_executor=scalar_exec,
+            views=self.views,
         )
 
     def _current_catalog(self) -> str:
@@ -114,11 +121,15 @@ class LocalRunner:
             stmt = stmt.query
         return self._plan_statement_query(stmt)
 
-    def _resolve_write_target(self, parts):
+    def _resolve_catalog(self, parts) -> Tuple[str, str]:
+        """(catalog, object-name) for a possibly-qualified name — the
+        one resolution rule shared by writes and views."""
         if len(parts) >= 2 and parts[0] in self.catalogs:
-            catalog, table = parts[0], parts[-1]
-        else:
-            catalog, table = self._current_catalog(), parts[-1]
+            return parts[0], parts[-1]
+        return self._current_catalog(), parts[-1]
+
+    def _resolve_write_target(self, parts):
+        catalog, table = self._resolve_catalog(parts)
         conn = self.catalogs.get(catalog)
         if conn is None or not hasattr(conn, "create_table"):
             raise ValueError(
@@ -196,6 +207,55 @@ class LocalRunner:
         # (reference: SystemSessionProperties; north-star's
         # tpu_offload_enabled -> compiled XLA vs eager fallback)
         self.apply_session()
+        return self._execute_stmt(stmt)
+
+    def _execute_stmt(self, stmt: N.Node) -> QueryResult:
+        if isinstance(stmt, N.CreateView):
+            catalog, name = self._qualified_view(stmt.parts)
+            if (catalog, name) in self.views and not stmt.replace:
+                raise ValueError(f"view already exists: {name}")
+            # validate now, like the reference's analyzer (names/types
+            # against current metadata); planning alone has no side
+            # effects
+            self._planner().plan_statement(parse(stmt.query_sql))
+            self.views[(catalog, name)] = stmt.query_sql
+            return QueryResult([], [], update_type="CREATE VIEW")
+        if isinstance(stmt, N.DropView):
+            catalog, name = self._qualified_view(stmt.parts)
+            if self.views.pop((catalog, name), None) is None:
+                raise ValueError(f"view not found: {name}")
+            return QueryResult([], [], update_type="DROP VIEW")
+        if isinstance(stmt, N.Prepare):
+            self.prepared[stmt.name] = stmt.statement_sql
+            return QueryResult([], [], update_type="PREPARE")
+        if isinstance(stmt, N.Deallocate):
+            if self.prepared.pop(stmt.name, None) is None:
+                raise ValueError(
+                    f"prepared statement not found: {stmt.name}"
+                )
+            return QueryResult([], [], update_type="DEALLOCATE")
+        if isinstance(stmt, N.ExecutePrepared):
+            text = self.prepared.get(stmt.name)
+            if text is None:
+                raise ValueError(
+                    f"prepared statement not found: {stmt.name}"
+                )
+            inner = parse(text)
+            if isinstance(inner, (N.Delete, N.Update)) and "?" in text:
+                # DML predicates/assignments ride as raw SQL slices the
+                # AST rewrite cannot reach — fail clearly rather than
+                # with an unbound-parameter planning error later
+                raise ValueError(
+                    "parameters in prepared DELETE/UPDATE are not "
+                    "supported; inline the values"
+                )
+            want = _count_parameters(inner)
+            if len(stmt.args) != want:
+                raise ValueError(
+                    f"incorrect number of parameters: statement "
+                    f"expects {want}, EXECUTE supplies {len(stmt.args)}"
+                )
+            return self._execute_stmt(_bind_parameters(inner, stmt.args))
         if isinstance(stmt, N.SetSession):
             self.session.set(stmt.name, stmt.value)
             return QueryResult([], [], update_type="SET SESSION")
@@ -233,7 +293,7 @@ class LocalRunner:
             return QueryResult(["rows"], [(n,)], update_type="INSERT",
                                column_types=["bigint"])
         if isinstance(stmt, N.Explain):
-            out = self.plan(sql)
+            out = self._plan_statement_query(stmt.query)
             if stmt.analyze:
                 _names, _rows, stats = (
                     self.executor.execute_with_stats(out)
@@ -243,10 +303,13 @@ class LocalRunner:
                 text = explain_text(out)
             return QueryResult(["Query Plan"],
                                [(line,) for line in text.splitlines()])
-        out = self.plan(sql)
+        out = self._plan_statement_query(stmt)
         names, rows = self.executor.execute(out)
         types = [str(t) for t in self.executor.output_types(out)]
         return QueryResult(list(names or []), rows, column_types=types)
+
+    def _qualified_view(self, parts) -> tuple:
+        return self._resolve_catalog(parts)
 
     def _execute_dml(self, stmt) -> QueryResult:
         """DELETE/UPDATE as rewrite-through-SELECT + table replace
@@ -429,3 +492,44 @@ def explain_text(node: P.PhysicalNode, indent: int = 0, stats=None) -> str:
     for child in node.children():
         parts.append(explain_text(child, indent + 1, stats=stats))
     return "\n".join(parts)
+
+
+def _count_parameters(node) -> int:
+    """Number of ? placeholders in a statement AST."""
+    if isinstance(node, N.Parameter):
+        return 1
+    if isinstance(node, tuple):
+        return sum(_count_parameters(x) for x in node)
+    if dataclasses.is_dataclass(node) and isinstance(node, N.Node):
+        return sum(
+            _count_parameters(getattr(node, f.name))
+            for f in dataclasses.fields(node)
+        )
+    return 0
+
+
+def _bind_parameters(node, args):
+    """Substitute EXECUTE ... USING argument ASTs for ? placeholders
+    (reference: sql/analyzer ParameterRewriter). Structural rewrite over
+    the frozen AST; arguments may be any constant expression."""
+    if isinstance(node, N.Parameter):
+        if node.index >= len(args):
+            raise ValueError(
+                f"query needs {node.index + 1}+ parameters, "
+                f"{len(args)} given"
+            )
+        return args[node.index]
+    if isinstance(node, tuple):
+        new = tuple(_bind_parameters(x, args) for x in node)
+        return (
+            new if any(a is not b for a, b in zip(new, node)) else node
+        )
+    if dataclasses.is_dataclass(node) and isinstance(node, N.Node):
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = _bind_parameters(v, args)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(node, **changes) if changes else node
+    return node

@@ -524,13 +524,17 @@ class PrestoTpuServer:
                 return self._runner
             # concurrent path: per-query runner/executor so query state
             # (overflow flags, capacity boosts, stream caches) never
-            # crosses queries; compiled kernels are shared
+            # crosses queries; compiled kernels, views, and prepared
+            # statements are server-wide (reference: views live in
+            # connector metadata; prepared statements in the session)
             r = LocalRunner(
                 self.catalogs, default_catalog=self._default_catalog,
                 page_rows=self._page_rows, mesh=self._mesh,
                 session=session,
             )
             r.executor._jit_cache = self._shared_jit_cache
+            r.views = self._runner.views
+            r.prepared = self._runner.prepared
             return r
 
         self.manager = QueryManager(runner_factory,

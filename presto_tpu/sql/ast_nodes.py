@@ -133,12 +133,16 @@ class FunctionCall(Node):
 
 @dataclasses.dataclass(frozen=True)
 class WindowSpec(Node):
-    """OVER clause (reference: sql/tree/Window). Only the default frames
-    are representable: RANGE UNBOUNDED PRECEDING..CURRENT ROW with an
-    ORDER BY, the whole partition without."""
+    """OVER clause (reference: sql/tree/Window + WindowFrame). `frame`
+    is None for the SQL default (RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    with an ORDER BY, the whole partition without), else
+    (unit, start_bound, end_bound) with unit in {"rows", "range"} and
+    each bound ("unbounded_preceding"|"preceding"|"current"|
+    "following"|"unbounded_following", n_or_None)."""
 
     partition_by: Tuple[Node, ...] = ()
     order_by: Tuple["OrderItem", ...] = ()
+    frame: Optional[Tuple] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -281,6 +285,54 @@ class InsertInto(Node):
 @dataclasses.dataclass(frozen=True)
 class DropTable(Node):
     parts: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW name AS query (reference:
+    sql/tree/CreateView). Like the reference, the view is stored as its
+    SQL text and expanded at analysis time (Analyzer view expansion),
+    so it always reflects current base-table data."""
+
+    parts: Tuple[str, ...]
+    query_sql: str
+    replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropView(Node):
+    parts: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    """PREPARE name FROM statement (reference: sql/tree/Prepare; the
+    statement text is stored per session and re-planned at EXECUTE
+    with parameters bound)."""
+
+    name: str
+    statement_sql: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutePrepared(Node):
+    """EXECUTE name [USING expr, ...] (reference: sql/tree/Execute)."""
+
+    name: str
+    args: Tuple[Node, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Parameter(Node):
+    """A ? placeholder (reference: sql/tree/Parameter), bound by
+    EXECUTE ... USING."""
+
+    index: int
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,7 +23,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<dquoted>"(?:[^"]|"")*")
   | (?P<name>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;\[\]])
+  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;?\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -40,6 +40,8 @@ KEYWORDS = {
     "set", "session", "show", "tables", "over", "partition",
     "delete", "update", "grouping", "sets", "rollup", "cube",
     "unnest", "ordinality", "array",
+    "rows", "range", "unbounded", "preceding", "following", "current",
+    "row", "view", "prepare", "execute", "deallocate",
 }
 
 
@@ -164,7 +166,26 @@ class Parser:
             self._finish()
             return N.Explain(q, analyze)
         if self.accept_keyword("create"):
+            replace = False
+            if self.accept_keyword("or"):
+                if self.expect_name() != "replace":
+                    raise SqlSyntaxError("expected REPLACE after OR")
+                replace = True
+            if self.accept_keyword("view"):
+                parts = self._qualified_name()
+                self.expect_keyword("as")
+                start = self.peek().pos
+                self.parse_query()  # validate the definition parses
+                self._finish()
+                return N.CreateView(
+                    parts, self.source[start:].strip().rstrip(";"),
+                    replace,
+                )
             self.expect_keyword("table")
+            if replace:
+                raise SqlSyntaxError(
+                    "CREATE OR REPLACE is supported for views only"
+                )
             parts = self._qualified_name()
             self.expect_keyword("as")
             q = self.parse_query()
@@ -177,10 +198,38 @@ class Parser:
             self._finish()
             return N.InsertInto(parts, q)
         if self.accept_keyword("drop"):
+            if self.accept_keyword("view"):
+                parts = self._qualified_name()
+                self._finish()
+                return N.DropView(parts)
             self.expect_keyword("table")
             parts = self._qualified_name()
             self._finish()
             return N.DropTable(parts)
+        if self.accept_keyword("prepare"):
+            name = self.expect_name()
+            self.expect_keyword("from")
+            start = self.peek().pos
+            text = self.source[start:].strip().rstrip(";")
+            # validate the inner statement parses (parameters allowed)
+            Parser(tokenize(text), source=text).parse_statement()
+            while self.peek().kind != "eof":
+                self.next()
+            return N.Prepare(name, text)
+        if self.accept_keyword("execute"):
+            name = self.expect_name()
+            args: List[N.Node] = []
+            if self.accept_keyword("using"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self._finish()
+            return N.ExecutePrepared(name, tuple(args))
+        if self.accept_keyword("deallocate"):
+            self.accept_keyword("prepare")
+            name = self.expect_name()
+            self._finish()
+            return N.Deallocate(name)
         if self.accept_keyword("delete"):
             # DML rewrites re-plan through SELECT (runner), so the
             # predicate/assignment expressions ride as raw SQL slices
@@ -604,6 +653,11 @@ class Parser:
         if t.kind == "op" and t.value in ("-", "+"):
             self.next()
             return N.UnaryOp(t.value, self.parse_expr(8))
+        if t.kind == "op" and t.value == "?":
+            self.next()
+            idx = getattr(self, "_param_count", 0)
+            self._param_count = idx + 1
+            return N.Parameter(idx)
         if t.kind == "keyword":
             return self.parse_keyword_expr()
         if t.kind == "number":
@@ -753,7 +807,8 @@ class Parser:
         return N.Identifier(tuple(parts))
 
     def _maybe_over(self, call: N.FunctionCall) -> N.Node:
-        """fn(...) [OVER ( [PARTITION BY e,...] [ORDER BY ...] )]"""
+        """fn(...) [OVER ( [PARTITION BY e,...] [ORDER BY ...]
+        [ROWS|RANGE frame] )] (reference: sql/tree/WindowFrame)"""
         if not self.accept_keyword("over"):
             return call
         self.expect_op("(")
@@ -766,13 +821,46 @@ class Parser:
                 partition.append(self.parse_expr())
         if self.at_keyword("order"):
             order = self.parse_order_by()
+        frame = None
+        if self.at_keyword("rows", "range"):
+            unit = self.next().value
+            if self.accept_keyword("between"):
+                start = self._frame_bound()
+                self.expect_keyword("and")
+                end = self._frame_bound()
+            else:
+                start = self._frame_bound()
+                end = ("current", None)
+            frame = (unit, start, end)
         self.expect_op(")")
         import dataclasses as _dc
 
         return _dc.replace(
             call,
-            window=N.WindowSpec(tuple(partition), tuple(order)),
+            window=N.WindowSpec(tuple(partition), tuple(order), frame),
         )
+
+    def _frame_bound(self):
+        """UNBOUNDED PRECEDING|FOLLOWING, CURRENT ROW, or
+        <n> PRECEDING|FOLLOWING."""
+        if self.accept_keyword("unbounded"):
+            if self.accept_keyword("preceding"):
+                return ("unbounded_preceding", None)
+            self.expect_keyword("following")
+            return ("unbounded_following", None)
+        if self.accept_keyword("current"):
+            self.expect_keyword("row")
+            return ("current", None)
+        t = self.next()
+        if t.kind != "number" or "." in str(t.value):
+            raise SqlSyntaxError(
+                f"frame bound must be an integer, got {t.value!r}"
+            )
+        n = int(t.value)
+        if self.accept_keyword("preceding"):
+            return ("preceding", n)
+        self.expect_keyword("following")
+        return ("following", n)
 
 
 def parse(sql: str) -> N.Node:

@@ -381,11 +381,16 @@ class Planner:
         default_catalog: str = "tpch",
         scalar_executor: Optional[Callable[[P.PhysicalNode], list]] = None,
         scalar_cache: Optional[Dict] = None,
+        views: Optional[Dict] = None,
     ):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
         self.scalar_executor = scalar_executor
         self.ctes: Dict[str, RelationPlan] = {}
+        # (catalog, name) -> view SQL text, expanded at analysis like the
+        # reference (Analyzer view expansion over sql/tree/CreateView)
+        self.views: Dict = views if views is not None else {}
+        self._expanding_views: set = set()
         # memoizes executed scalar subqueries per Query node so correlation
         # probes and repeated translation don't re-run them
         self.scalar_cache: Dict = scalar_cache if scalar_cache is not None \
@@ -547,6 +552,9 @@ class Planner:
         catalog = self.default_catalog
         if len(parts) >= 2 and parts[0] in self.catalogs:
             catalog = parts[0]
+        view_sql = self.views.get((catalog, name))
+        if view_sql is not None:
+            return self._expand_view(catalog, name, view_sql)
         conn = self.catalogs.get(catalog)
         if conn is None:
             raise PlanningError(f"unknown catalog: {catalog}")
@@ -560,6 +568,31 @@ class Planner:
             for c in schema.columns
         ]
         return RelationPlan(P.TableScan(catalog, name, cols), fields)
+
+    def _expand_view(self, catalog: str, name: str,
+                     view_sql: str) -> RelationPlan:
+        """Reference: StatementAnalyzer view expansion — the stored SQL
+        re-analyzes against current metadata; cycles are an error. The
+        view body must not see the referencing query's CTEs."""
+        from presto_tpu.sql.parser import parse as _parse
+
+        key = (catalog, name)
+        if key in self._expanding_views:
+            raise PlanningError(f"view cycle detected at {name!r}")
+        self._expanding_views.add(key)
+        saved_ctes = self.ctes
+        self.ctes = {}
+        try:
+            q = _parse(view_sql)
+            rp, names = self.plan_query_named(q, None)
+        finally:
+            self.ctes = saved_ctes
+            self._expanding_views.discard(key)
+        fields = [
+            Field(nm, f.type, frozenset({name}))
+            for nm, f in zip(names, rp.fields)
+        ]
+        return RelationPlan(rp.node, fields)
 
     def _plan_explicit_join(self, rel: N.JoinRelation, outer):
         left = self.plan_relation(rel.left, outer)
@@ -1222,6 +1255,33 @@ class Planner:
         # attaches them to a QuerySpec
         return plan
 
+    @staticmethod
+    def _check_frame(wspec):
+        """Validate an explicit window frame (reference:
+        sql/analyzer/WindowFrameAnalyzer rules): ROWS frames take any
+        bound; RANGE frames only UNBOUNDED/CURRENT (value-range offsets
+        need per-type arithmetic the kernels don't do)."""
+        frame = wspec.frame
+        if frame is None:
+            return None
+        unit, (sk, _sn), (ek, _en) = frame
+        order = {"unbounded_preceding": 0, "preceding": 1, "current": 2,
+                 "following": 3, "unbounded_following": 4}
+        if sk == "unbounded_following" or ek == "unbounded_preceding":
+            raise PlanningError("invalid window frame bounds")
+        if order[sk] > order[ek]:
+            raise PlanningError(
+                "window frame start cannot follow its end"
+            )
+        if unit == "range" and (
+            sk not in ("unbounded_preceding", "current")
+            or ek not in ("current", "unbounded_following")
+        ):
+            raise PlanningError(
+                "RANGE frames support only UNBOUNDED/CURRENT bounds"
+            )
+        return frame
+
     def _plan_windows(self, plan, scope, windows):
         """Plan windowed calls over the FROM/WHERE result: pre-project the
         partition/order/argument expressions, add one Window node per
@@ -1260,11 +1320,21 @@ class Planner:
                 SortKey(chan_for(o.expr), o.ascending, o.nulls_first)
                 for o in wspec.order_by
             )
+            frame = self._check_frame(wspec)
             fns = []
             for call in calls:
                 fname = call.name
                 arg_ch = None
                 offset = 1
+
+                def int_literal(node, what):
+                    if not (isinstance(node, N.Literal)
+                            and node.kind == "long"):
+                        raise PlanningError(
+                            f"{what} must be an integer literal"
+                        )
+                    return int(node.value)
+
                 if fname in ("lag", "lead"):
                     if len(call.args) > 2:
                         raise PlanningError(
@@ -1272,16 +1342,26 @@ class Planner:
                         )
                     arg_ch = chan_for(call.args[0])
                     if len(call.args) == 2:
-                        off = call.args[1]
-                        if not (isinstance(off, N.Literal)
-                                and off.kind == "long"):
-                            raise PlanningError(
-                                "lag/lead offset must be an integer "
-                                "literal"
-                            )
-                        offset = int(off.value)
-                elif fname in ("row_number", "rank", "dense_rank"):
+                        offset = int_literal(call.args[1],
+                                             "lag/lead offset")
+                elif fname in ("row_number", "rank", "dense_rank",
+                               "percent_rank", "cume_dist"):
                     pass
+                elif fname == "ntile":
+                    if len(call.args) != 1:
+                        raise PlanningError("ntile takes one argument")
+                    offset = int_literal(call.args[0], "ntile buckets")
+                    if offset < 1:
+                        raise PlanningError("ntile buckets must be >= 1")
+                elif fname == "nth_value":
+                    if len(call.args) != 2:
+                        raise PlanningError(
+                            "nth_value takes two arguments"
+                        )
+                    arg_ch = chan_for(call.args[0])
+                    offset = int_literal(call.args[1], "nth_value n")
+                    if offset < 1:
+                        raise PlanningError("nth_value n must be >= 1")
                 elif fname in ("count",) and (call.is_star or
                                               not call.args):
                     fname = "count_star"
@@ -1292,7 +1372,8 @@ class Planner:
                     raise PlanningError(
                         f"unsupported window function: {fname}"
                     )
-                fns.append(W.WindowFunc(fname, arg_ch, offset))
+                fns.append(W.WindowFunc(fname, arg_ch, offset,
+                                        frame=frame))
             specs.append((part_chs, order_keys, tuple(fns), calls))
 
         node = plan.node
@@ -1752,6 +1833,11 @@ class ExprTranslator:
             return ir.call(e.name, *[self._tr(a) for a in e.args])
         if isinstance(e, N.ScalarSubquery):
             return self.planner.execute_scalar(e.query)
+        if isinstance(e, N.Parameter):
+            raise PlanningError(
+                f"parameter ?{e.index + 1} is not bound — run via "
+                f"EXECUTE <name> USING <values>"
+            )
         raise PlanningError(f"unsupported expression: {type(e).__name__}")
 
     def _group_probe(self, e: N.Node) -> Optional[ir.RowExpression]:

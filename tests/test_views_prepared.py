@@ -1,0 +1,123 @@
+"""Views (CREATE/DROP VIEW, analysis-time expansion) and prepared
+statements (PREPARE/EXECUTE/DEALLOCATE with ? parameters).
+
+Reference: sql/tree/{CreateView,Prepare,Execute,Deallocate,Parameter} +
+StatementAnalyzer view expansion; Presto stores a view as SQL text and
+re-analyzes it per query, so views always reflect current base data.
+"""
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runner import LocalRunner
+
+
+@pytest.fixture()
+def runner():
+    return LocalRunner(
+        {"tpch": TpchConnector(0.01), "memory": MemoryConnector()},
+        page_rows=1 << 13,
+    )
+
+
+def test_create_query_drop_view(runner):
+    runner.execute(
+        "create view big_nations as "
+        "select n_regionkey, count(*) cnt from nation group by 1"
+    )
+    got = runner.execute(
+        "select * from big_nations order by n_regionkey"
+    ).rows
+    want = runner.execute(
+        "select n_regionkey, count(*) from nation group by 1 order by 1"
+    ).rows
+    assert got == want
+    # views compose with joins and further aggregation
+    j = runner.execute(
+        "select r_name, cnt from region, big_nations "
+        "where r_regionkey = n_regionkey order by 1"
+    ).rows
+    assert len(j) == 5
+    runner.execute("drop view big_nations")
+    with pytest.raises(Exception):
+        runner.execute("select * from big_nations")
+
+
+def test_view_reflects_current_data(runner):
+    runner.execute(
+        "create table memory.t as select 1 as x union all select 2"
+    )
+    runner.execute("create view memory.v as select sum(x) s from memory.t")
+    assert runner.execute("select s from memory.v").rows[0][0] == 3
+    runner.execute("insert into memory.t select 10")
+    # re-expanded at analysis: sees the inserted row (reference
+    # semantics: views are SQL text, not materialized)
+    assert runner.execute("select s from memory.v").rows[0][0] == 13
+
+
+def test_view_replace_duplicate_and_cycle(runner):
+    runner.execute("create view v1 as select 1 as x")
+    with pytest.raises(Exception):
+        runner.execute("create view v1 as select 2 as x")
+    runner.execute("create or replace view v1 as select 2 as x")
+    assert runner.execute("select x from v1").rows == [(2,)]
+    # invalid definitions are rejected at creation (analyzer-style)
+    with pytest.raises(Exception):
+        runner.execute("create view bad as select no_such_col from nation")
+
+
+def test_prepare_execute_deallocate(runner):
+    runner.execute(
+        "prepare q1 from select count(*), sum(o_totalprice) from orders "
+        "where o_custkey < ? and o_orderpriority = ?"
+    )
+    got = runner.execute(
+        "execute q1 using 500, '1-URGENT'"
+    ).rows
+    want = runner.execute(
+        "select count(*), sum(o_totalprice) from orders "
+        "where o_custkey < 500 and o_orderpriority = '1-URGENT'"
+    ).rows
+    assert got == want
+    # rebind with different values, same compiled shapes
+    got2 = runner.execute("execute q1 using 100, '5-LOW'").rows
+    want2 = runner.execute(
+        "select count(*), sum(o_totalprice) from orders "
+        "where o_custkey < 100 and o_orderpriority = '5-LOW'"
+    ).rows
+    assert got2 == want2
+    runner.execute("deallocate prepare q1")
+    with pytest.raises(Exception):
+        runner.execute("execute q1 using 1, 'x'")
+
+
+def test_views_persist_on_concurrent_server():
+    # the arbiter path builds a fresh runner per query — view and
+    # prepared-statement registries must be server-wide, like the
+    # reference's connector-metadata views and session preparation
+    from presto_tpu.client import StatementClient
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    srv = PrestoTpuServer(
+        {"tpch": TpchConnector(0.01)}, port=0, page_rows=1 << 13,
+        memory_budget_bytes=1 << 32,
+    )
+    srv.start()
+    try:
+        c = StatementClient(server=f"http://127.0.0.1:{srv.port}")
+        c.execute("create view sv as select count(*) c from nation")
+        assert int(c.execute("select c from sv").rows[0][0]) == 25
+        c.execute("prepare sp from select ? * 2")
+        assert int(c.execute("execute sp using 21").rows[0][0]) == 42
+    finally:
+        srv.stop()
+
+
+def test_execute_missing_or_unbound(runner):
+    with pytest.raises(Exception):
+        runner.execute("execute nope using 1")
+    runner.execute("prepare p2 from select ? + 1")
+    with pytest.raises(Exception):
+        runner.execute("execute p2")  # parameter not bound
+    assert runner.execute("execute p2 using 41").rows == [(42,)]
