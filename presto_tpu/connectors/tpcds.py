@@ -7,11 +7,13 @@ column is a pure function of the global row index computed on device, so a
 table shards across a mesh by sharding an iota, generation is column-pruned
 and jit-compiled per (table, chunk, column set).
 
-Scope: the 13 tables that cover the BASELINE rung-5 queries (Q17/Q64) and
-most of the store/catalog channel queries — date_dim, item, store,
-customer, customer_address, customer_demographics, household_demographics,
-income_band, promotion, store_sales, store_returns, catalog_sales,
-catalog_returns. web_* channel tables are out of scope this round.
+Scope: the full 24-table census. Store and catalog channels carry the
+spec's structural correlations (below); the web channel
+(web_sales/web_returns, order-structured like catalog), inventory
+(weekly date x item x warehouse cross product), and the remaining
+dimensions (warehouse, ship_mode, reason, time_dim, call_center,
+catalog_page, web_site, web_page) decode arithmetically or draw from
+the same counter-based streams.
 
 Structural fidelity (what query behavior depends on):
   - customer_demographics is the spec's full mixed-radix cross product
@@ -95,6 +97,8 @@ MAX_LINES = 11  # slots per store ticket / catalog order (1..11 live)
 SS_RETURN_PCT = 10  # ~10% of store sale lines are returned (spec ratio)
 CS_RETURN_PCT = 10
 CS_REPURCHASE_PCT = 30  # catalog lines re-purchasing a returned store sale
+WS_RETURN_PCT = 10
+N_INV_WEEKS = 261  # weekly inventory snapshots over the 5 sales years
 
 DEC72 = T.DecimalType(7, 2)
 DEC52 = T.DecimalType(5, 2)
@@ -176,6 +180,34 @@ STORE_NAMES = ["ought", "able", "ese", "anti", "cally", "ation", "eing",
                "n st", "bar", "pri"]
 PROMO_NAMES = ["ought", "able", "ese", "anti", "cally", "ation", "eing",
                "n st", "bar", "pri"]
+SHIP_TYPES = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "LIBRARY"]
+SHIP_CODES = ["AIR", "SURFACE", "SEA"]
+SHIP_CARRIERS = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS", "ZHOU",
+                 "LATVIAN", "UDEN", "GREAT EASTERN", "DIAMOND", "RUPEKSA",
+                 "ORIENTAL", "BOXBUNDLES", "ALLIANCE", "HARMSTORF",
+                 "PRIVATECARRIER", "GERMA", "MSC", "BARIAN"]
+REASON_DESCS = ["Package was damaged", "Stopped working",
+                "Did not get it on time", "Not the product that was "
+                "ordred", "Parts missing", "Does not work with a product "
+                "that I have", "Gift exchange", "Did not like the color",
+                "Did not like the model", "Did not like the make",
+                "Did not like the warranty", "No service location in my "
+                "area", "Found a better price in a store",
+                "Found a better extended warranty in a store",
+                "Not working any more", "reason 16", "reason 17",
+                "reason 18", "reason 19", "reason 20"]
+CC_NAMES = ["NY Metro", "Mid Atlantic", "California", "Pacific Northwest",
+            "North Midwest", "Central"]
+WP_TYPES = ["ad", "dynamic", "feedback", "general", "order", "protected",
+            "welcome"]
+AM_PM = ["AM", "PM"]
+SHIFTS = ["first", "second", "third"]
+SUB_SHIFTS = ["morning", "afternoon", "evening", "night"]
+MEAL_TIMES = ["", "breakfast", "lunch", "dinner"]
+CC_CLASSES = ["small", "medium", "large"]
+CP_TYPES = ["bi-annual", "quarterly", "monthly"]
+WEB_NAMES = ["site_0", "site_1", "site_2", "site_3", "site_4", "site_5"]
+WEB_COMPANIES = ["pri", "unusual", "able", "ese", "anti", "cally"]
 DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
              "Saturday", "Sunday"]  # 1900-01-01 was a Monday
 HOURS = ["8AM-4PM", "8AM-8PM", "8AM-12AM"]
@@ -274,6 +306,15 @@ class TpcdsConnector(GeneratorConnector, Connector):
         # 480k tickets x avg 6 live lines = spec's ~2.88M rows at SF1
         self.n_ticket = max(int(480_000 * scale), 64)
         self.n_corder = max(int(240_000 * scale), 32)
+        # web channel + remaining dims (round 3: full 24-table census)
+        self.n_worder = max(int(120_000 * scale), 16)
+        self.n_warehouse = max(int(5 * scale ** 0.5), 2)
+        self.n_ship_mode = 20
+        self.n_reason = max(int(35 * scale ** 0.25), 5)
+        self.n_call_center = max(int(6 * scale ** 0.5), 2)
+        self.n_catalog_page = max(int(11_718 * scale ** 0.5), 64)
+        self.n_web_site = max(int(30 * scale ** 0.25), 2)
+        self.n_web_page = max(int(60 * scale ** 0.5), 4)
         self._schemas = _build_schemas()
         self._gen_cache: Dict = {}
         self._dicts = self._build_dictionaries()
@@ -305,11 +346,22 @@ class TpcdsConnector(GeneratorConnector, Connector):
             "store_returns": self.n_ticket * MAX_LINES,
             "catalog_sales": self.n_corder * MAX_LINES,
             "catalog_returns": self.n_corder * MAX_LINES,
+            "web_sales": self.n_worder * MAX_LINES,
+            "web_returns": self.n_worder * MAX_LINES,
+            "warehouse": self.n_warehouse,
+            "ship_mode": self.n_ship_mode,
+            "reason": self.n_reason,
+            "time_dim": 86_400,
+            "call_center": self.n_call_center,
+            "catalog_page": self.n_catalog_page,
+            "web_site": self.n_web_site,
+            "web_page": self.n_web_page,
+            "inventory": N_INV_WEEKS * self.n_item * self.n_warehouse,
         }[table]
 
     def splits(self, table: str, target_rows: int) -> List[Split]:
         if table in ("store_sales", "store_returns", "catalog_sales",
-                     "catalog_returns"):
+                     "catalog_returns", "web_sales", "web_returns"):
             # align split boundaries to whole tickets/orders
             target_rows = max(
                 (target_rows // MAX_LINES) * MAX_LINES, MAX_LINES
@@ -327,6 +379,14 @@ class TpcdsConnector(GeneratorConnector, Connector):
             "household_demographics": frozenset({"hd_demo_sk"}),
             "income_band": frozenset({"ib_income_band_sk"}),
             "promotion": frozenset({"p_promo_sk"}),
+            "warehouse": frozenset({"w_warehouse_sk"}),
+            "ship_mode": frozenset({"sm_ship_mode_sk"}),
+            "reason": frozenset({"r_reason_sk"}),
+            "time_dim": frozenset({"t_time_sk"}),
+            "call_center": frozenset({"cc_call_center_sk"}),
+            "catalog_page": frozenset({"cp_catalog_page_sk"}),
+            "web_site": frozenset({"web_site_sk"}),
+            "web_page": frozenset({"wp_web_page_sk"}),
         }.get(table, frozenset())
 
     def monotonic_row_bound(self, table: str, column: str):
@@ -351,6 +411,23 @@ class TpcdsConnector(GeneratorConnector, Connector):
                 lambda v: (v - 1) * MAX_LINES,
             ("catalog_returns", "cr_order_number"):
                 lambda v: (v - 1) * MAX_LINES,
+            ("web_sales", "ws_order_number"):
+                lambda v: (v - 1) * MAX_LINES,
+            ("web_returns", "wr_order_number"):
+                lambda v: (v - 1) * MAX_LINES,
+            ("warehouse", "w_warehouse_sk"): lambda v: v - 1,
+            ("ship_mode", "sm_ship_mode_sk"): lambda v: v - 1,
+            ("reason", "r_reason_sk"): lambda v: v - 1,
+            ("time_dim", "t_time_sk"): lambda v: v,
+            ("call_center", "cc_call_center_sk"): lambda v: v - 1,
+            ("catalog_page", "cp_catalog_page_sk"): lambda v: v - 1,
+            ("web_site", "web_site_sk"): lambda v: v - 1,
+            ("web_page", "wp_web_page_sk"): lambda v: v - 1,
+            # inventory is week-major: value = base + 7 * (row // stride)
+            ("inventory", "inv_date_sk"): lambda v: (
+                -(-(v - JULIAN_BASE - SALES_START) // 7)
+                * self.n_item * self.n_warehouse
+            ),
         }
         return simple.get((table, column))
 
@@ -417,6 +494,66 @@ class TpcdsConnector(GeneratorConnector, Connector):
                 "p_channel_dmail": Dictionary(["N", "Y"]),
                 "p_channel_email": Dictionary(["N", "Y"]),
                 "p_channel_tv": Dictionary(["N", "Y"]),
+            },
+            "warehouse": {
+                "w_warehouse_id": PatternDictionary(
+                    "WH", self.n_warehouse),
+                "w_warehouse_name": _word_pool_dictionary(1024, 71),
+                "w_city": _word_pool_dictionary(1024, 47),
+                "w_county": _word_pool_dictionary(64, 53),
+                "w_state": Dictionary(STATES),
+                "w_zip": _zip_dictionary(),
+                "w_country": Dictionary(["United States"]),
+            },
+            "ship_mode": {
+                "sm_ship_mode_id": PatternDictionary(
+                    "SM", self.n_ship_mode),
+                "sm_type": Dictionary(SHIP_TYPES),
+                "sm_code": Dictionary(SHIP_CODES),
+                "sm_carrier": Dictionary(SHIP_CARRIERS),
+                "sm_contract": _word_pool_dictionary(1024, 73),
+            },
+            "reason": {
+                "r_reason_id": PatternDictionary("REASON", self.n_reason),
+                "r_reason_desc": Dictionary(REASON_DESCS),
+            },
+            "time_dim": {
+                "t_time_id": PatternDictionary("TIME", 86_400, offset=0),
+                "t_am_pm": Dictionary(AM_PM),
+                "t_shift": Dictionary(SHIFTS),
+                "t_sub_shift": Dictionary(SUB_SHIFTS),
+                "t_meal_time": Dictionary(MEAL_TIMES),
+            },
+            "call_center": {
+                "cc_call_center_id": PatternDictionary(
+                    "CC", self.n_call_center),
+                "cc_name": Dictionary(CC_NAMES),
+                "cc_class": Dictionary(CC_CLASSES),
+                "cc_hours": Dictionary(HOURS),
+                "cc_manager": _name_dictionary(512, 43),
+                "cc_county": _word_pool_dictionary(64, 53),
+                "cc_state": Dictionary(STATES),
+            },
+            "catalog_page": {
+                "cp_catalog_page_id": PatternDictionary(
+                    "CP", self.n_catalog_page),
+                "cp_department": Dictionary(["DEPARTMENT"]),
+                "cp_description": _desc_dictionary(),
+                "cp_type": Dictionary(CP_TYPES),
+            },
+            "web_site": {
+                "web_site_id": PatternDictionary(
+                    "WEB", self.n_web_site),
+                "web_name": Dictionary(WEB_NAMES),
+                "web_manager": _name_dictionary(512, 79),
+                "web_company_name": Dictionary(WEB_COMPANIES),
+            },
+            "web_page": {
+                "wp_web_page_id": PatternDictionary(
+                    "WP", self.n_web_page),
+                "wp_autogen_flag": Dictionary(["N", "Y"]),
+                "wp_url": Dictionary(["http://www.foo.com"]),
+                "wp_type": Dictionary(WP_TYPES),
             },
         }
 
@@ -685,6 +822,26 @@ class TpcdsConnector(GeneratorConnector, Connector):
             nlines=_unif(ticket, "store_sales", "nlines", 1, MAX_LINES),
         )
 
+    @staticmethod
+    def _line_money(stream: str, key: jnp.ndarray):
+        """The per-line pricing model every sales channel shares
+        (wholesale -> markup list price -> discounted sale price -> tax),
+        drawn from the channel's own RNG streams. net_paid here has no
+        coupon; the store channel overlays its coupon on top."""
+        qty = _unif(key, stream, "qty", 1, 100)
+        whole = _unif(key, stream, "wholesale", 100, 10_000)
+        markup = _unif(key, stream, "markup", 100, 300)
+        lst = whole * markup // jnp.int64(100)
+        disc = _unif(key, stream, "disc", 0, 100)
+        sprice = lst * (jnp.int64(100) - disc) // jnp.int64(100)
+        taxp = _unif(key, stream, "taxp", 0, 9)
+        ext_sales = qty * sprice
+        ext_tax = ext_sales * taxp // jnp.int64(100)
+        return dict(
+            qty=qty, whole=whole, lst=lst, sprice=sprice, taxp=taxp,
+            ext_sales=ext_sales, net_paid=ext_sales, ext_tax=ext_tax,
+        )
+
     def _ss_values(self, slot: jnp.ndarray):
         """Per-slot store_sales values: pure functions of the global slot
         index (ticket * MAX_LINES + line-1); shared by store_returns and
@@ -693,16 +850,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
         line = slot % MAX_LINES + 1
         tv = self._ticket_values(ticket)
         key = slot
-        qty = _unif(key, "store_sales", "qty", 1, 100)
-        whole = _unif(key, "store_sales", "wholesale", 100, 10_000)
-        markup = _unif(key, "store_sales", "markup", 100, 300)
-        lst = whole * markup // jnp.int64(100)
-        disc = _unif(key, "store_sales", "disc", 0, 100)
-        sprice = lst * (jnp.int64(100) - disc) // jnp.int64(100)
-        taxp = _unif(key, "store_sales", "taxp", 0, 9)
+        m = self._line_money("store_sales", key)
+        qty, sprice, taxp = m["qty"], m["sprice"], m["taxp"]
         has_coupon = _unif(key, "store_sales", "hascoup", 0, 9) < 2
         cfrac = _unif(key, "store_sales", "cfrac", 0, 50)
-        ext_sales = qty * sprice
+        ext_sales = m["ext_sales"]
         coupon = jnp.where(has_coupon, ext_sales * cfrac // 100, 0)
         net_paid = ext_sales - coupon
         ext_tax = net_paid * taxp // jnp.int64(100)
@@ -711,13 +863,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
             _unif(key, "store_returns", "flag", 0, 99) < SS_RETURN_PCT
         )
         return dict(
-            ticket=ticket, line=line, key=key, valid=valid,
+            m, ticket=ticket, line=line, key=key, valid=valid,
             returned=returned,
             item=_unif(key, "store_sales", "item", 1, self.n_item),
             promo=_unif(key, "store_sales", "promo", 1, self.n_promo),
-            qty=qty, whole=whole, lst=lst, sprice=sprice, taxp=taxp,
-            ext_sales=ext_sales, coupon=coupon, net_paid=net_paid,
-            ext_tax=ext_tax, **tv,
+            coupon=coupon, net_paid=net_paid, ext_tax=ext_tax, **tv,
         )
 
     def _gen_store_sales(self, start, n: int) -> _Lazy:
@@ -811,7 +961,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("sr_addr_sk", lambda: sv()["addr"])
         lz.put("sr_store_sk", lambda: sv()["store"])
         lz.put("sr_reason_sk", lambda: _unif(
-            slot, "store_returns", "reason", 1, 35))
+            slot, "store_returns", "reason", 1, self.n_reason))
         lz.put("sr_ticket_number", lambda: sv()["ticket"] + 1)
         lz.put("sr_return_quantity",
                lambda: rv()["rqty"].astype(jnp.int32))
@@ -867,28 +1017,17 @@ class TpcdsConnector(GeneratorConnector, Connector):
             ),
             SALES_START, SALES_END,
         )
-        qty = _unif(key, "catalog_sales", "qty", 1, 100)
-        whole = _unif(key, "catalog_sales", "wholesale", 100, 10_000)
-        markup = _unif(key, "catalog_sales", "markup", 100, 300)
-        lst = whole * markup // jnp.int64(100)
-        disc = _unif(key, "catalog_sales", "disc", 0, 100)
-        sprice = lst * (jnp.int64(100) - disc) // jnp.int64(100)
-        taxp = _unif(key, "catalog_sales", "taxp", 0, 9)
-        ext_sales = qty * sprice
-        net_paid = ext_sales
-        ext_tax = net_paid * taxp // jnp.int64(100)
+        m = self._line_money("catalog_sales", key)
         returned = valid & (
             _unif(key, "catalog_returns", "flag", 0, 99) < CS_RETURN_PCT
         )
         return dict(
-            order=order, line=line, key=key, valid=valid,
+            m, order=order, line=line, key=key, valid=valid,
             returned=returned, customer=customer, item=item, day=day,
             cdemo=_unif(order, "catalog_sales", "cdemo", 1, self.n_cdemo),
             hdemo=_unif(order, "catalog_sales", "hdemo", 1, self.n_hdemo),
             addr=_unif(order, "catalog_sales", "addr", 1, self.n_addr),
             promo=_unif(key, "catalog_sales", "promo", 1, self.n_promo),
-            qty=qty, whole=whole, lst=lst, sprice=sprice, taxp=taxp,
-            ext_sales=ext_sales, net_paid=net_paid, ext_tax=ext_tax,
         )
 
     def _gen_catalog_sales(self, start, n: int) -> _Lazy:
@@ -970,7 +1109,331 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("cr_store_credit", lambda: rv()["credit"])
         lz.put("cr_net_loss", lambda: (
             rv()["fee"] + rv()["ship"] + rv()["rtax"]))
+        lz.put("cr_reason_sk", lambda: _unif(
+            cv()["key"], "catalog_returns", "reason", 1, self.n_reason))
         lz.put("__valid__", lambda: cv()["returned"])
+        return lz
+
+    # ------------------------------------------------- remaining dims
+    # (round 3: the 24-table census — web channel, inventory, and the
+    # small dimensions the long-tail queries touch)
+
+    def _gen_warehouse(self, start, n: int) -> _Lazy:
+        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        lz = _Lazy()
+        lz.put("w_warehouse_sk", lambda: sk)
+        lz.put("w_warehouse_id", lambda: (sk - 1).astype(jnp.int32))
+        lz.put("w_warehouse_name", lambda: _unif(
+            sk, "warehouse", "name", 0, 1023).astype(jnp.int32))
+        lz.put("w_warehouse_sq_ft", lambda: _unif(
+            sk, "warehouse", "sqft", 50_000, 1_000_000).astype(jnp.int32))
+        lz.put("w_city", lambda: _unif(
+            sk, "warehouse", "city", 0, 1023).astype(jnp.int32))
+        lz.put("w_county", lambda: _unif(
+            sk, "warehouse", "county", 0, 63).astype(jnp.int32))
+        lz.put("w_state", lambda: _unif(
+            sk, "warehouse", "state", 0, len(STATES) - 1
+        ).astype(jnp.int32))
+        lz.put("w_zip", lambda: _unif(
+            sk, "warehouse", "zip", 0, 4095).astype(jnp.int32))
+        lz.put("w_country", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("w_gmt_offset", lambda: -jnp.int64(100) * _unif(
+            sk, "warehouse", "gmt", 5, 8))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_ship_mode(self, start, n: int) -> _Lazy:
+        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        x = sk - 1
+        lz = _Lazy()
+        lz.put("sm_ship_mode_sk", lambda: sk)
+        lz.put("sm_ship_mode_id", lambda: x.astype(jnp.int32))
+        lz.put("sm_type", lambda: (
+            x % len(SHIP_TYPES)).astype(jnp.int32))
+        lz.put("sm_code", lambda: (
+            (x // len(SHIP_TYPES)) % len(SHIP_CODES)).astype(jnp.int32))
+        lz.put("sm_carrier", lambda: (
+            x % len(SHIP_CARRIERS)).astype(jnp.int32))
+        lz.put("sm_contract", lambda: _unif(
+            sk, "ship_mode", "contract", 0, 1023).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_reason(self, start, n: int) -> _Lazy:
+        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        lz = _Lazy()
+        lz.put("r_reason_sk", lambda: sk)
+        lz.put("r_reason_id", lambda: (sk - 1).astype(jnp.int32))
+        lz.put("r_reason_desc", lambda: (
+            (sk - 1) % len(REASON_DESCS)).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_time_dim(self, start, n: int) -> _Lazy:
+        """86,400 rows, one per second of day; every column decodes
+        arithmetically from t_time_sk (like date_dim from the day
+        index)."""
+        sk = start + jnp.arange(n, dtype=jnp.int64)
+        hour = sk // 3600
+        lz = _Lazy()
+        lz.put("t_time_sk", lambda: sk)
+        lz.put("t_time_id", lambda: sk.astype(jnp.int32))
+        lz.put("t_time", lambda: sk.astype(jnp.int32))
+        lz.put("t_hour", lambda: hour.astype(jnp.int32))
+        lz.put("t_minute", lambda: ((sk // 60) % 60).astype(jnp.int32))
+        lz.put("t_second", lambda: (sk % 60).astype(jnp.int32))
+        lz.put("t_am_pm", lambda: (hour >= 12).astype(jnp.int32))
+        lz.put("t_shift", lambda: (hour // 8).astype(jnp.int32))
+        lz.put("t_sub_shift", lambda: jnp.clip(
+            (hour - 4) // 6, 0, 3).astype(jnp.int32))
+        lz.put("t_meal_time", lambda: jnp.where(
+            (hour >= 6) & (hour <= 8), 1,
+            jnp.where((hour >= 11) & (hour <= 13), 2,
+                      jnp.where((hour >= 17) & (hour <= 19), 3, 0)),
+        ).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_call_center(self, start, n: int) -> _Lazy:
+        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        lz = _Lazy()
+        lz.put("cc_call_center_sk", lambda: sk)
+        lz.put("cc_call_center_id", lambda: (sk - 1).astype(jnp.int32))
+        lz.put("cc_name", lambda: (
+            (sk - 1) % len(CC_NAMES)).astype(jnp.int32))
+        lz.put("cc_class", lambda: (
+            (sk - 1) % 3).astype(jnp.int32))
+        lz.put("cc_employees", lambda: _unif(
+            sk, "call_center", "emp", 10, 700).astype(jnp.int32))
+        lz.put("cc_sq_ft", lambda: _unif(
+            sk, "call_center", "sqft", 1_000, 50_000).astype(jnp.int32))
+        lz.put("cc_hours", lambda: (
+            (sk - 1) % len(HOURS)).astype(jnp.int32))
+        lz.put("cc_manager", lambda: _unif(
+            sk, "call_center", "mgr", 0, 511).astype(jnp.int32))
+        lz.put("cc_market_id", lambda: _unif(
+            sk, "call_center", "mkt", 1, 6).astype(jnp.int32))
+        lz.put("cc_county", lambda: _unif(
+            sk, "call_center", "county", 0, 63).astype(jnp.int32))
+        lz.put("cc_state", lambda: _unif(
+            sk, "call_center", "state", 0, len(STATES) - 1
+        ).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_catalog_page(self, start, n: int) -> _Lazy:
+        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        pages_per_cat = 108  # spec: ~108 pages per catalog number
+        lz = _Lazy()
+        lz.put("cp_catalog_page_sk", lambda: sk)
+        lz.put("cp_catalog_page_id", lambda: (sk - 1).astype(jnp.int32))
+        lz.put("cp_start_date_sk", lambda: jnp.int64(JULIAN_BASE)
+               + SALES_START + ((sk - 1) // pages_per_cat) * 30)
+        lz.put("cp_end_date_sk", lambda: jnp.int64(JULIAN_BASE)
+               + SALES_START + ((sk - 1) // pages_per_cat) * 30 + 90)
+        lz.put("cp_department", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("cp_catalog_number", lambda: (
+            (sk - 1) // pages_per_cat + 1).astype(jnp.int32))
+        lz.put("cp_catalog_page_number", lambda: (
+            (sk - 1) % pages_per_cat + 1).astype(jnp.int32))
+        lz.put("cp_description", lambda: _unif(
+            sk, "catalog_page", "desc", 0, 4095).astype(jnp.int32))
+        lz.put("cp_type", lambda: (
+            (sk - 1) % 3).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_web_site(self, start, n: int) -> _Lazy:
+        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        lz = _Lazy()
+        lz.put("web_site_sk", lambda: sk)
+        lz.put("web_site_id", lambda: (sk - 1).astype(jnp.int32))
+        lz.put("web_name", lambda: (
+            (sk - 1) % 6).astype(jnp.int32))
+        lz.put("web_open_date_sk", lambda: jnp.int64(JULIAN_BASE)
+               + SALES_START - _unif(sk, "web_site", "open", 100, 2000))
+        lz.put("web_manager", lambda: _unif(
+            sk, "web_site", "mgr", 0, 511).astype(jnp.int32))
+        lz.put("web_market_id", lambda: _unif(
+            sk, "web_site", "mkt", 1, 6).astype(jnp.int32))
+        lz.put("web_company_id", lambda: (
+            (sk - 1) % 6 + 1).astype(jnp.int32))
+        lz.put("web_company_name", lambda: (
+            (sk - 1) % 6).astype(jnp.int32))
+        lz.put("web_gmt_offset", lambda: -jnp.int64(100) * _unif(
+            sk, "web_site", "gmt", 5, 8))
+        lz.put("web_tax_percentage", lambda: _unif(
+            sk, "web_site", "tax", 0, 12))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_web_page(self, start, n: int) -> _Lazy:
+        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        lz = _Lazy()
+        lz.put("wp_web_page_sk", lambda: sk)
+        lz.put("wp_web_page_id", lambda: (sk - 1).astype(jnp.int32))
+        lz.put("wp_creation_date_sk", lambda: jnp.int64(JULIAN_BASE)
+               + SALES_START - _unif(sk, "web_page", "created", 1, 1000))
+        lz.put("wp_access_date_sk", lambda: jnp.int64(JULIAN_BASE)
+               + SALES_START + _unif(sk, "web_page", "access", 0, 100))
+        lz.put("wp_autogen_flag", lambda: _unif(
+            sk, "web_page", "autogen", 0, 1).astype(jnp.int32))
+        lz.put("wp_customer_sk", lambda: _unif(
+            sk, "web_page", "cust", 1, self.n_customer))
+        lz.put("wp_url", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("wp_type", lambda: (
+            (sk - 1) % len(WP_TYPES)).astype(jnp.int32))
+        lz.put("wp_char_count", lambda: _unif(
+            sk, "web_page", "chars", 100, 8_000).astype(jnp.int32))
+        lz.put("wp_link_count", lambda: _unif(
+            sk, "web_page", "links", 2, 25).astype(jnp.int32))
+        lz.put("wp_image_count", lambda: _unif(
+            sk, "web_page", "images", 1, 7).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    def _gen_inventory(self, start, n: int) -> _Lazy:
+        """Weekly (date x item x warehouse) cross product, decoded
+        mixed-radix from the row index — the spec's weekly snapshots."""
+        idx = start + jnp.arange(n, dtype=jnp.int64)
+        wh = idx % self.n_warehouse
+        rest = idx // self.n_warehouse
+        item = rest % self.n_item
+        week = rest // self.n_item
+        lz = _Lazy()
+        lz.put("inv_date_sk", lambda: jnp.int64(JULIAN_BASE)
+               + SALES_START + week * 7)
+        lz.put("inv_item_sk", lambda: item + 1)
+        lz.put("inv_warehouse_sk", lambda: wh + 1)
+        lz.put("inv_quantity_on_hand", lambda: _unif(
+            idx, "inventory", "qoh", 0, 1_000).astype(jnp.int32))
+        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        return lz
+
+    # ------------------------------------------------------ web channel
+
+    def _ws_values(self, slot: jnp.ndarray):
+        """Per-slot web_sales values; order-structured like the catalog
+        channel (order = one customer session, 1..11 live lines)."""
+        order = slot // MAX_LINES
+        line = slot % MAX_LINES + 1
+        key = slot
+        nlines = _unif(order, "web_sales", "nlines", 1, MAX_LINES)
+        valid = line <= nlines
+        m = self._line_money("web_sales", key)
+        returned = valid & (
+            _unif(key, "web_returns", "flag", 0, 99) < WS_RETURN_PCT
+        )
+        return dict(
+            m, order=order, line=line, key=key, valid=valid,
+            returned=returned,
+            customer=_unif(order, "web_sales", "customer",
+                           1, self.n_customer),
+            cdemo=_unif(order, "web_sales", "cdemo", 1, self.n_cdemo),
+            hdemo=_unif(order, "web_sales", "hdemo", 1, self.n_hdemo),
+            addr=_unif(order, "web_sales", "addr", 1, self.n_addr),
+            site=_unif(order, "web_sales", "site", 1, self.n_web_site),
+            page=_unif(order, "web_sales", "page", 1, self.n_web_page),
+            day=_unif(order, "web_sales", "day",
+                      SALES_START, SALES_END),
+            tod=_unif(order, "web_sales", "tod", 0, 86_399),
+            warehouse=_unif(key, "web_sales", "wh",
+                            1, self.n_warehouse),
+            ship_mode=_unif(key, "web_sales", "sm",
+                            1, self.n_ship_mode),
+            item=_unif(key, "web_sales", "item", 1, self.n_item),
+            promo=_unif(key, "web_sales", "promo", 1, self.n_promo),
+        )
+
+    def _gen_web_sales(self, start, n: int) -> _Lazy:
+        slot = start + jnp.arange(n, dtype=jnp.int64)
+        lz = _Lazy()
+
+        @functools.lru_cache(maxsize=1)
+        def wv():
+            return self._ws_values(slot)
+
+        lz.put("ws_sold_date_sk",
+               lambda: wv()["day"] + jnp.int64(JULIAN_BASE))
+        lz.put("ws_sold_time_sk", lambda: wv()["tod"])
+        lz.put("ws_ship_date_sk", lambda: (
+            # up to 120 days so Q62's 30/60/90+ buckets all populate
+            wv()["day"] + _unif(slot, "web_sales", "shiplag", 2, 120)
+            + jnp.int64(JULIAN_BASE)))
+        lz.put("ws_bill_customer_sk", lambda: wv()["customer"])
+        lz.put("ws_bill_cdemo_sk", lambda: wv()["cdemo"])
+        lz.put("ws_bill_hdemo_sk", lambda: wv()["hdemo"])
+        lz.put("ws_bill_addr_sk", lambda: wv()["addr"])
+        lz.put("ws_ship_customer_sk", lambda: wv()["customer"])
+        lz.put("ws_ship_addr_sk", lambda: wv()["addr"])
+        lz.put("ws_web_site_sk", lambda: wv()["site"])
+        lz.put("ws_web_page_sk", lambda: wv()["page"])
+        lz.put("ws_warehouse_sk", lambda: wv()["warehouse"])
+        lz.put("ws_ship_mode_sk", lambda: wv()["ship_mode"])
+        lz.put("ws_item_sk", lambda: wv()["item"])
+        lz.put("ws_promo_sk", lambda: wv()["promo"])
+        lz.put("ws_order_number", lambda: wv()["order"] + 1)
+        lz.put("ws_quantity", lambda: wv()["qty"].astype(jnp.int32))
+        lz.put("ws_wholesale_cost", lambda: wv()["whole"])
+        lz.put("ws_list_price", lambda: wv()["lst"])
+        lz.put("ws_sales_price", lambda: wv()["sprice"])
+        lz.put("ws_ext_discount_amt",
+               lambda: wv()["qty"] * (wv()["lst"] - wv()["sprice"]))
+        lz.put("ws_ext_sales_price", lambda: wv()["ext_sales"])
+        lz.put("ws_ext_wholesale_cost",
+               lambda: wv()["qty"] * wv()["whole"])
+        lz.put("ws_ext_list_price", lambda: wv()["qty"] * wv()["lst"])
+        lz.put("ws_ext_tax", lambda: wv()["ext_tax"])
+        lz.put("ws_coupon_amt", lambda: jnp.zeros((n,), dtype=jnp.int64))
+        lz.put("ws_ext_ship_cost", lambda: _unif(
+            slot, "web_sales", "shipcost", 0, 5_000))
+        lz.put("ws_net_paid", lambda: wv()["net_paid"])
+        lz.put("ws_net_paid_inc_tax",
+               lambda: wv()["net_paid"] + wv()["ext_tax"])
+        lz.put("ws_net_profit", lambda: (
+            wv()["net_paid"] - wv()["qty"] * wv()["whole"]))
+        lz.put("__valid__", lambda: wv()["valid"])
+        return lz
+
+    def _gen_web_returns(self, start, n: int) -> _Lazy:
+        slot = start + jnp.arange(n, dtype=jnp.int64)
+        lz = _Lazy()
+
+        @functools.lru_cache(maxsize=1)
+        def wv():
+            return self._ws_values(slot)
+
+        @functools.lru_cache(maxsize=1)
+        def rv():
+            w = wv()
+            return self._return_money(
+                "web_returns", w["key"], w["qty"], w["sprice"],
+                w["taxp"], w["day"],
+            )
+
+        lz.put("wr_returned_date_sk",
+               lambda: rv()["rday"] + jnp.int64(JULIAN_BASE))
+        lz.put("wr_item_sk", lambda: wv()["item"])
+        lz.put("wr_refunded_customer_sk", lambda: wv()["customer"])
+        lz.put("wr_returning_customer_sk", lambda: wv()["customer"])
+        lz.put("wr_web_page_sk", lambda: wv()["page"])
+        lz.put("wr_order_number", lambda: wv()["order"] + 1)
+        lz.put("wr_reason_sk", lambda: _unif(
+            wv()["key"], "web_returns", "reason", 1, self.n_reason))
+        lz.put("wr_return_quantity",
+               lambda: rv()["rqty"].astype(jnp.int32))
+        lz.put("wr_return_amt", lambda: rv()["ramt"])
+        lz.put("wr_return_tax", lambda: rv()["rtax"])
+        lz.put("wr_return_amt_inc_tax",
+               lambda: rv()["ramt"] + rv()["rtax"])
+        lz.put("wr_fee", lambda: rv()["fee"])
+        lz.put("wr_return_ship_cost", lambda: rv()["ship"])
+        lz.put("wr_refunded_cash", lambda: rv()["refunded"])
+        lz.put("wr_reversed_charge", lambda: rv()["reversed_c"])
+        lz.put("wr_account_credit", lambda: rv()["credit"])
+        lz.put("wr_net_loss", lambda: (
+            rv()["fee"] + rv()["ship"] + rv()["rtax"]))
+        lz.put("__valid__", lambda: wv()["returned"])
         return lz
 
 
@@ -1088,6 +1551,82 @@ def _build_schemas() -> Dict[str, TableSchema]:
                 ("cr_return_ship_cost", DEC72),
                 ("cr_refunded_cash", DEC72),
                 ("cr_reversed_charge", DEC72),
-                ("cr_store_credit", DEC72), ("cr_net_loss", DEC72)),
+                ("cr_store_credit", DEC72), ("cr_net_loss", DEC72),
+                ("cr_reason_sk", B)),
+            # ---- round 3: web channel + remaining dims (24 tables)
+            tbl("warehouse",
+                ("w_warehouse_sk", B), ("w_warehouse_id", V),
+                ("w_warehouse_name", V), ("w_warehouse_sq_ft", I),
+                ("w_city", V), ("w_county", V), ("w_state", V),
+                ("w_zip", V), ("w_country", V),
+                ("w_gmt_offset", DEC52)),
+            tbl("ship_mode",
+                ("sm_ship_mode_sk", B), ("sm_ship_mode_id", V),
+                ("sm_type", V), ("sm_code", V), ("sm_carrier", V),
+                ("sm_contract", V)),
+            tbl("reason",
+                ("r_reason_sk", B), ("r_reason_id", V),
+                ("r_reason_desc", V)),
+            tbl("time_dim",
+                ("t_time_sk", B), ("t_time_id", V), ("t_time", I),
+                ("t_hour", I), ("t_minute", I), ("t_second", I),
+                ("t_am_pm", V), ("t_shift", V), ("t_sub_shift", V),
+                ("t_meal_time", V)),
+            tbl("call_center",
+                ("cc_call_center_sk", B), ("cc_call_center_id", V),
+                ("cc_name", V), ("cc_class", V), ("cc_employees", I),
+                ("cc_sq_ft", I), ("cc_hours", V), ("cc_manager", V),
+                ("cc_market_id", I), ("cc_county", V), ("cc_state", V)),
+            tbl("catalog_page",
+                ("cp_catalog_page_sk", B), ("cp_catalog_page_id", V),
+                ("cp_start_date_sk", B), ("cp_end_date_sk", B),
+                ("cp_department", V), ("cp_catalog_number", I),
+                ("cp_catalog_page_number", I), ("cp_description", V),
+                ("cp_type", V)),
+            tbl("web_site",
+                ("web_site_sk", B), ("web_site_id", V), ("web_name", V),
+                ("web_open_date_sk", B), ("web_manager", V),
+                ("web_market_id", I), ("web_company_id", I),
+                ("web_company_name", V), ("web_gmt_offset", DEC52),
+                ("web_tax_percentage", DEC52)),
+            tbl("web_page",
+                ("wp_web_page_sk", B), ("wp_web_page_id", V),
+                ("wp_creation_date_sk", B), ("wp_access_date_sk", B),
+                ("wp_autogen_flag", V), ("wp_customer_sk", B),
+                ("wp_url", V), ("wp_type", V), ("wp_char_count", I),
+                ("wp_link_count", I), ("wp_image_count", I)),
+            tbl("inventory",
+                ("inv_date_sk", B), ("inv_item_sk", B),
+                ("inv_warehouse_sk", B), ("inv_quantity_on_hand", I)),
+            tbl("web_sales",
+                ("ws_sold_date_sk", B), ("ws_sold_time_sk", B),
+                ("ws_ship_date_sk", B), ("ws_bill_customer_sk", B),
+                ("ws_bill_cdemo_sk", B), ("ws_bill_hdemo_sk", B),
+                ("ws_bill_addr_sk", B), ("ws_ship_customer_sk", B),
+                ("ws_ship_addr_sk", B), ("ws_web_site_sk", B),
+                ("ws_web_page_sk", B), ("ws_warehouse_sk", B),
+                ("ws_ship_mode_sk", B), ("ws_item_sk", B),
+                ("ws_promo_sk", B), ("ws_order_number", B),
+                ("ws_quantity", I), ("ws_wholesale_cost", DEC72),
+                ("ws_list_price", DEC72), ("ws_sales_price", DEC72),
+                ("ws_ext_discount_amt", DEC72),
+                ("ws_ext_sales_price", DEC72),
+                ("ws_ext_wholesale_cost", DEC72),
+                ("ws_ext_list_price", DEC72), ("ws_ext_tax", DEC72),
+                ("ws_coupon_amt", DEC72), ("ws_ext_ship_cost", DEC72),
+                ("ws_net_paid", DEC72), ("ws_net_paid_inc_tax", DEC72),
+                ("ws_net_profit", DEC72)),
+            tbl("web_returns",
+                ("wr_returned_date_sk", B), ("wr_item_sk", B),
+                ("wr_refunded_customer_sk", B),
+                ("wr_returning_customer_sk", B), ("wr_web_page_sk", B),
+                ("wr_order_number", B), ("wr_reason_sk", B),
+                ("wr_return_quantity", I), ("wr_return_amt", DEC72),
+                ("wr_return_tax", DEC72),
+                ("wr_return_amt_inc_tax", DEC72), ("wr_fee", DEC72),
+                ("wr_return_ship_cost", DEC72),
+                ("wr_refunded_cash", DEC72),
+                ("wr_reversed_charge", DEC72),
+                ("wr_account_credit", DEC72), ("wr_net_loss", DEC72)),
         ]
     }

@@ -127,4 +127,80 @@ order by cs1.product_name, cs1.store_name, cs2.cnt,
          cs1.b_street_name, cs1.c_street_name, cs1.cnt
 """
 
-QUERIES = {17: Q17, 64: Q64}
+# ---- round 3: web channel + remaining-dimension queries. Same
+# reconstruction discipline; deviations (applied to both engines):
+#   - Q93's template comma-joins reason against a LEFT join's null-able
+#     sr_ columns, which the WHERE collapses to inner — written as the
+#     equivalent inner joins.
+#   - Q82 filters inventory weeks by inv_date_sk range instead of
+#     d_date + INTERVAL arithmetic (sqlite has no INTERVAL).
+#   - Qualification substitutions target this generator's value ranges
+#     (month_seq 1176-87 = calendar 1998; reason/hour/price bands).
+
+Q62 = """
+select substr(w_warehouse_name, 1, 20) wh, sm_type, web_name,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk <= 30
+                then 1 else 0 end) as d30,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 30
+                 and ws_ship_date_sk - ws_sold_date_sk <= 60
+                then 1 else 0 end) as d60,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 60
+                then 1 else 0 end) as dmore
+from web_sales, warehouse, ship_mode, web_site, date_dim
+where d_month_seq between 1176 and 1187
+  and ws_ship_date_sk = d_date_sk
+  and ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk
+  and ws_web_site_sk = web_site_sk
+group by substr(w_warehouse_name, 1, 20), sm_type, web_name
+order by wh, sm_type, web_name
+limit 100
+"""
+
+Q82 = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 62 and 92
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and inv_date_sk between 2450994 and 2451054
+  and i_manufact_id in (129, 270, 821, 423)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id, i_item_desc, i_current_price
+limit 100
+"""
+
+Q93 = """
+select ss_customer_sk, sum(act_sales) sumsales
+from (select ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity)
+                       * ss_sales_price
+                  else ss_quantity * ss_sales_price end act_sales
+      from store_sales
+           join store_returns on sr_item_sk = ss_item_sk
+                             and sr_ticket_number = ss_ticket_number
+           join reason on sr_reason_sk = r_reason_sk
+      where r_reason_desc = 'Stopped working') t
+group by ss_customer_sk
+order by sumsales, ss_customer_sk
+limit 100
+"""
+
+Q96 = """
+select count(*) cnt
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = t_time_sk
+  and ss_hdemo_sk = hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and t_hour = 20
+  and t_minute >= 30
+  and hd_dep_count = 7
+  and s_store_name = 'able'
+order by cnt
+limit 100
+"""
+
+QUERIES = {17: Q17, 62: Q62, 64: Q64, 82: Q82, 93: Q93, 96: Q96}
