@@ -187,6 +187,9 @@ def main() -> int:
             r["result_rows"] = info["rows"]
             r["checksum_crc32"] = info["checksum_crc32"]
             r["capacity_boost"] = info.get("capacity_boost", 1)
+            # observability: >0 means the Pallas dim-join kernel ran
+            # (auto mode engages it for real on TPU; VERDICT r2 #4)
+            r["pallas_joins_used"] = info.get("pallas_joins_used", 0)
         # capacity_boost == 1 certifies the timed runs too: the
         # validator re-executes the same plan at the same initial
         # capacities, so no boost there means no overflow here

@@ -1,0 +1,181 @@
+"""System catalog: live engine state queryable as SQL tables.
+
+Reference: presto-main's SystemConnector (system.runtime.queries,
+system.runtime.nodes), the information_schema metadata tables, and the
+presto-jmx connector's "SQL over the engine's own metrics" — SURVEY
+§6.5 names keeping that dogfood loop a build goal. Tables materialize
+from registered provider callables at scan time, so every query sees
+current state; pages stage host->device exactly like the memory
+connector.
+
+Built-in tables (providers wired by LocalRunner / PrestoTpuServer):
+  catalogs            catalog_name, connector_name
+  tables              table_catalog, table_name
+  columns             table_catalog, table_name, column_name,
+                      data_type, ordinal_position
+  session_properties  name, value, default_value, type, description
+  functions           function_name
+  runtime_queries     query_id, state, user, query, elapsed_ms,
+                      result_rows        (server only)
+  nodes               uri, state, is_coordinator (server only)
+  metrics             name, value        (server counters)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import (
+    ColumnSchema,
+    Connector,
+    Split,
+    TableSchema,
+)
+from presto_tpu.page import Page
+
+
+class SystemConnector(Connector):
+    name = "system"
+
+    def __init__(self):
+        self._schemas: Dict[str, TableSchema] = {}
+        self._providers: Dict[str, Callable[[], List[tuple]]] = {}
+        # per-table snapshot taken at split planning so row_count and
+        # the subsequent page scans see one consistent row set;
+        # THREAD-local because concurrent queries (the server's memory-
+        # arbiter path) share this connector and each plans+scans on
+        # its own thread
+        self._local = threading.local()
+
+    def register(
+        self,
+        table: str,
+        columns: Sequence,
+        provider: Callable[[], List[tuple]],
+    ) -> None:
+        """columns: (name, SqlType) pairs; provider returns current rows
+        (reference: SystemTable.cursor building rows per query)."""
+        self._schemas[table] = TableSchema(
+            table, tuple(ColumnSchema(n, t) for n, t in columns)
+        )
+        self._providers[table] = provider
+
+    # ---------------------------------------------------------- metadata
+    def tables(self) -> List[str]:
+        return list(self._schemas)
+
+    def table_schema(self, table: str) -> TableSchema:
+        try:
+            return self._schemas[table]
+        except KeyError:
+            raise KeyError(f"system has no table {table!r}")
+
+    def row_count(self, table: str) -> int:
+        rows = self._providers[table]()
+        if not hasattr(self._local, "snapshots"):
+            self._local.snapshots = {}
+        self._local.snapshots[table] = rows
+        return max(len(rows), 1)
+
+    # -------------------------------------------------------------- scan
+    def page_for_split(
+        self, split: Split, columns: Optional[Sequence[str]] = None
+    ) -> Page:
+        schema = self._schemas[split.table]
+        rows = getattr(self._local, "snapshots", {}).get(split.table)
+        if rows is None:
+            rows = self._providers[split.table]()
+        names = (
+            tuple(columns) if columns is not None
+            else tuple(schema.column_names())
+        )
+        lo, hi = split.start_row, split.start_row + split.row_count
+        rows = rows[lo:hi]
+        cols, types, dicts = [], [], []
+        from presto_tpu.page import Dictionary
+
+        for nm in names:
+            idx = schema.column_index(nm)
+            col = [r[idx] for r in rows]
+            t = schema.columns[idx].type
+            cols.append(col)
+            types.append(t)
+            if t.is_dictionary_encoded:
+                dicts.append(
+                    Dictionary(sorted({v for v in col if v is not None}))
+                )
+            else:
+                dicts.append(None)
+        return Page.from_arrays(cols, types, dictionaries=dicts)
+
+
+def install_standard_tables(sys_conn: SystemConnector, runner) -> None:
+    """The metadata tables every engine entry point gets (reference:
+    information_schema + SHOW-command backing tables)."""
+    V, B = T.VARCHAR, T.BIGINT
+
+    def catalogs():
+        return sorted(
+            (name, type(conn).__name__)
+            for name, conn in runner.catalogs.items()
+        )
+
+    def tables():
+        out = []
+        for cat, conn in sorted(runner.catalogs.items()):
+            try:
+                for t in conn.tables():
+                    out.append((cat, t))
+            except Exception:
+                continue
+        return out
+
+    def columns():
+        out = []
+        for cat, conn in sorted(runner.catalogs.items()):
+            try:
+                names = conn.tables()
+            except Exception:
+                continue
+            for t in names:
+                schema = conn.table_schema(t)
+                for i, c in enumerate(schema.columns):
+                    out.append((cat, t, c.name, str(c.type), i + 1))
+        return out
+
+    def session_properties():
+        # the QUERYING runner's session, not the bootstrap runner's —
+        # the server's concurrent path builds a runner per query but
+        # shares this connector (reference: session properties are
+        # per-session state surfaced by SHOW SESSION)
+        from presto_tpu.runner import current_session
+
+        session = current_session() or runner.session
+        return session.rows()
+
+    def functions():
+        from presto_tpu.expr import functions as F
+
+        return sorted((n,) for n in F.registered_names())
+
+    sys_conn.register(
+        "catalogs", [("catalog_name", V), ("connector_name", V)], catalogs
+    )
+    sys_conn.register(
+        "tables", [("table_catalog", V), ("table_name", V)], tables
+    )
+    sys_conn.register(
+        "columns",
+        [("table_catalog", V), ("table_name", V), ("column_name", V),
+         ("data_type", V), ("ordinal_position", B)],
+        columns,
+    )
+    sys_conn.register(
+        "session_properties",
+        [("name", V), ("value", V), ("default_value", V), ("type", V),
+         ("description", V)],
+        session_properties,
+    )
+    sys_conn.register("functions", [("function_name", V)], functions)

@@ -64,6 +64,12 @@ def register(name: str, resolve, impl, propagate_nulls: bool = True):
     _REGISTRY[name] = FunctionDef(name, resolve, impl, propagate_nulls)
 
 
+def registered_names() -> list:
+    """All installed scalar functions (system.functions backing;
+    reference: FunctionRegistry.list())."""
+    return list(_REGISTRY)
+
+
 def lookup(name: str) -> FunctionDef:
     fn = _REGISTRY.get(name)
     if fn is None:

@@ -55,6 +55,17 @@ class LocalRunner:
         from presto_tpu.session import Session
 
         self.session = session or Session(catalog=default_catalog)
+        if "system" not in self.catalogs:
+            # live engine state as SQL (reference: SystemConnector +
+            # information_schema; SURVEY §6.5's SQL-over-own-metrics)
+            from presto_tpu.connectors.system import (
+                SystemConnector,
+                install_standard_tables,
+            )
+
+            sys_conn = SystemConnector()
+            install_standard_tables(sys_conn, self)
+            self.catalogs["system"] = sys_conn
         # (catalog, name) -> view SQL text (reference: ConnectorMetadata
         # createView storage; ours is engine-level, expanded at analysis)
         self.views: Dict[tuple, str] = {}
@@ -207,7 +218,11 @@ class LocalRunner:
         # (reference: SystemSessionProperties; north-star's
         # tpu_offload_enabled -> compiled XLA vs eager fallback)
         self.apply_session()
-        return self._execute_stmt(stmt)
+        token = _ACTIVE_SESSION.set(self.session)
+        try:
+            return self._execute_stmt(stmt)
+        finally:
+            _ACTIVE_SESSION.reset(token)
 
     def _execute_stmt(self, stmt: N.Node) -> QueryResult:
         if isinstance(stmt, N.CreateView):
@@ -492,6 +507,20 @@ def explain_text(node: P.PhysicalNode, indent: int = 0, stats=None) -> str:
     for child in node.children():
         parts.append(explain_text(child, indent + 1, stats=stats))
     return "\n".join(parts)
+
+
+# the session of the query being executed on this thread/context —
+# system.session_properties resolves through this so shared providers
+# see the querying session, not the runner they were registered on
+import contextvars
+
+_ACTIVE_SESSION: contextvars.ContextVar = contextvars.ContextVar(
+    "presto_tpu_active_session", default=None
+)
+
+
+def current_session():
+    return _ACTIVE_SESSION.get()
 
 
 def _count_parameters(node) -> int:

@@ -541,8 +541,72 @@ class PrestoTpuServer:
                                     listeners=event_listeners,
                                     resource_groups=resource_groups,
                                     memory_arbiter=memory_arbiter)
+        self._install_runtime_tables()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def _install_runtime_tables(self) -> None:
+        """system.runtime_queries / nodes / metrics over live server
+        state (reference: system.runtime.* tables + the jmx connector's
+        SQL-over-metrics)."""
+        sys_conn = self.catalogs.get("system")
+        if sys_conn is None or not hasattr(sys_conn, "register"):
+            return
+        V, B = T.VARCHAR, T.BIGINT
+        mgr = self.manager
+
+        def runtime_queries():
+            out = []
+            with mgr._lock:
+                queries = list(mgr._queries.values())
+            for q in queries:
+                info = q.info()
+                out.append((
+                    q.id, q.state, q.session.user, q.sql,
+                    info["elapsedTimeMillis"], len(q.rows),
+                ))
+            return sorted(out)
+
+        def nodes():
+            me = (f"http://127.0.0.1:{self.port}", "active", 1)
+            peers = []
+            fd = self.failure_detector
+            if fd is not None:
+                for info in fd.snapshot():
+                    # one vocabulary with the coordinator row:
+                    # active / failed
+                    alive = info.get("state") == "ALIVE"
+                    peers.append((
+                        info.get("uri"),
+                        "active" if alive else "failed",
+                        0,
+                    ))
+            return [me] + sorted(peers)
+
+        def metrics():
+            with mgr._lock:
+                out = [
+                    ("rows_returned_total", mgr.rows_returned_total),
+                    ("query_wall_ms_total", mgr.query_wall_ms_total),
+                ]
+                by_state = dict(mgr.completed_by_state)
+            for state, n in sorted(by_state.items()):
+                out.append((f"queries_completed_{state.lower()}", n))
+            return out
+
+        sys_conn.register(
+            "runtime_queries",
+            [("query_id", V), ("state", V), ("user", V), ("query", V),
+             ("elapsed_ms", B), ("result_rows", B)],
+            runtime_queries,
+        )
+        sys_conn.register(
+            "nodes",
+            [("uri", V), ("state", V), ("is_coordinator", B)], nodes,
+        )
+        sys_conn.register(
+            "metrics", [("name", V), ("value", B)], metrics,
+        )
 
     def start(self) -> int:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)

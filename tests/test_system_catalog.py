@@ -1,0 +1,108 @@
+"""The system catalog: live engine state as SQL tables.
+
+Reference: presto-main SystemConnector (system.runtime.*),
+information_schema, and the jmx connector's SQL-over-metrics (SURVEY
+§6.5 keeps "SQL over the engine's own metrics" a build goal).
+"""
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runner import LocalRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalRunner({"tpch": TpchConnector(0.01)}, page_rows=1 << 13)
+
+
+def test_metadata_tables(runner):
+    cats = runner.execute(
+        "select catalog_name from system.catalogs order by 1"
+    ).rows
+    assert [c[0] for c in cats] == ["system", "tpch"]
+    tabs = runner.execute(
+        "select count(*) from system.tables where table_catalog = 'tpch'"
+    ).rows
+    assert tabs[0][0] == 8  # the 8 TPC-H tables
+    cols = runner.execute(
+        "select column_name, ordinal_position from system.columns "
+        "where table_name = 'region' order by 2"
+    ).rows
+    assert [c[0] for c in cols] == [
+        "r_regionkey", "r_name", "r_comment"
+    ]
+
+
+def test_session_and_functions_tables(runner):
+    v = runner.execute(
+        "select value from system.session_properties "
+        "where name = 'tpu_offload_enabled'"
+    ).rows
+    assert v == [("true",)]
+    n = runner.execute(
+        "select count(*) from system.functions"
+    ).rows[0][0]
+    assert n >= 90  # the builtin registry
+
+
+def test_joins_and_aggregation_over_system(runner):
+    # the engine's own operators run over system pages (host staging)
+    got = runner.execute(
+        "select t.table_name, count(*) c from system.tables t, "
+        "system.columns c where t.table_name = c.table_name "
+        "and t.table_catalog = 'tpch' and c.table_catalog = 'tpch' "
+        "group by 1 order by 2 desc, 1 limit 2"
+    ).rows
+    assert got[0][0] == "lineitem" and got[0][1] == 16
+
+
+def test_session_properties_track_client_session():
+    # the concurrent (memory-arbiter) path builds a runner per query
+    # but shares the system connector — the table must show the
+    # QUERYING client's session, not the bootstrap runner's
+    from presto_tpu.client import StatementClient
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    srv = PrestoTpuServer({"tpch": TpchConnector(0.01)}, port=0,
+                          page_rows=1 << 13,
+                          memory_budget_bytes=1 << 32)
+    srv.start()
+    try:
+        c = StatementClient(server=f"http://127.0.0.1:{srv.port}")
+        c.session_properties["spill_threshold_bytes"] = "12345"
+        got = c.execute(
+            "select value from system.session_properties "
+            "where name = 'spill_threshold_bytes'"
+        ).rows
+        assert got == [["12345"]] or got == [("12345",)], got
+    finally:
+        srv.stop()
+
+
+def test_server_runtime_tables():
+    from presto_tpu.client import StatementClient
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    srv = PrestoTpuServer({"tpch": TpchConnector(0.01)}, port=0,
+                          page_rows=1 << 13)
+    srv.start()
+    try:
+        c = StatementClient(server=f"http://127.0.0.1:{srv.port}")
+        c.execute("select 1")
+        rows = c.execute(
+            "select state, count(*) from system.runtime_queries "
+            "group by 1 order by 1"
+        ).rows
+        states = {r[0] for r in rows}
+        assert "FINISHED" in states or "RUNNING" in states, rows
+        nodes = c.execute("select uri, is_coordinator from system.nodes"
+                          ).rows
+        assert len(nodes) == 1 and int(nodes[0][1]) == 1
+        m = c.execute(
+            "select value from system.metrics "
+            "where name = 'rows_returned_total'"
+        ).rows
+        assert int(m[0][0]) >= 1
+    finally:
+        srv.stop()
