@@ -122,12 +122,28 @@ def install_standard_tables(sys_conn: SystemConnector, runner) -> None:
             for name, conn in runner.catalogs.items()
         )
 
+    def _visible(cat: str, table: str) -> bool:
+        """Listings hide tables the user cannot select (reference:
+        AccessControl.filterTables/filterColumns over
+        information_schema)."""
+        from presto_tpu.runner import current_session
+        from presto_tpu.security import AccessDeniedError
+
+        session = current_session()
+        user = session.user if session else runner.session.user
+        try:
+            runner.access_control.check_can_select(user, cat, table, ())
+        except AccessDeniedError:
+            return False
+        return True
+
     def tables():
         out = []
         for cat, conn in sorted(runner.catalogs.items()):
             try:
                 for t in conn.tables():
-                    out.append((cat, t))
+                    if _visible(cat, t):
+                        out.append((cat, t))
             except Exception:
                 continue
         return out
@@ -140,6 +156,8 @@ def install_standard_tables(sys_conn: SystemConnector, runner) -> None:
             except Exception:
                 continue
             for t in names:
+                if not _visible(cat, t):
+                    continue
                 schema = conn.table_schema(t)
                 for i, c in enumerate(schema.columns):
                     out.append((cat, t, c.name, str(c.type), i + 1))

@@ -94,6 +94,18 @@ class Plugin:
         """Reference: getEventListenerFactories."""
         return []
 
+    def types(self) -> Dict[str, object]:
+        """Named types to register (reference: getTypes): name ->
+        SqlType instance; they then resolve in CAST/DDL like
+        builtins."""
+        return {}
+
+    def access_control(self):
+        """An AccessControl to install (reference:
+        getSystemAccessControlFactories); None = contribute none. At
+        most one plugin in a process may contribute one."""
+        return None
+
 
 def _as_spec(item) -> ScalarFunctionSpec:
     if isinstance(item, ScalarFunctionSpec):
@@ -153,6 +165,8 @@ def install(plugin: Plugin, catalogs: Optional[Dict] = None) -> Plugin:
         from presto_tpu.exec import agg_states as AS
 
         AS.register_aggregate(agg)
+    for name, t in plugin.types().items():
+        T.register_type(name, t)
     if catalogs is not None:
         for name, conn in plugin.connectors().items():
             if name in catalogs:

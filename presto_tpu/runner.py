@@ -43,11 +43,21 @@ class LocalRunner:
         plugins=(),
     ):
         self.catalogs = dict(catalogs)
+        from presto_tpu.security import ALLOW_ALL
+
+        self.access_control = ALLOW_ALL
         if plugins:
             from presto_tpu.plugin import install
 
             for p in plugins:
                 install(p, self.catalogs)
+                ac = p.access_control()
+                if ac is not None:
+                    if self.access_control is not ALLOW_ALL:
+                        raise ValueError(
+                            "multiple plugins contribute access control"
+                        )
+                    self.access_control = ac
         catalogs = self.catalogs
         self.default_catalog = default_catalog
         self.mesh = mesh
@@ -83,8 +93,11 @@ class LocalRunner:
 
     def _planner(self) -> Planner:
         def scalar_exec(node):
-            # plan-time scalar subqueries must also be fragmented before
-            # they hit a distributed executor
+            # plan-time scalar subqueries execute during planning, so
+            # they get their own access check
+            self._check_plan_access(node)
+            # ...and must be fragmented before they hit a distributed
+            # executor
             if self.mesh is not None:
                 from presto_tpu.dist.fragmenter import add_exchanges
 
@@ -218,6 +231,9 @@ class LocalRunner:
         # (reference: SystemSessionProperties; north-star's
         # tpu_offload_enabled -> compiled XLA vs eager fallback)
         self.apply_session()
+        self.access_control.check_can_execute_query(
+            self.session.user, sql
+        )
         token = _ACTIVE_SESSION.set(self.session)
         try:
             return self._execute_stmt(stmt)
@@ -227,6 +243,9 @@ class LocalRunner:
     def _execute_stmt(self, stmt: N.Node) -> QueryResult:
         if isinstance(stmt, N.CreateView):
             catalog, name = self._qualified_view(stmt.parts)
+            self.access_control.check_can_create_view(
+                self.session.user, catalog, name
+            )
             if (catalog, name) in self.views and not stmt.replace:
                 raise ValueError(f"view already exists: {name}")
             # validate now, like the reference's analyzer (names/types
@@ -237,6 +256,9 @@ class LocalRunner:
             return QueryResult([], [], update_type="CREATE VIEW")
         if isinstance(stmt, N.DropView):
             catalog, name = self._qualified_view(stmt.parts)
+            self.access_control.check_can_drop_view(
+                self.session.user, catalog, name
+            )
             if self.views.pop((catalog, name), None) is None:
                 raise ValueError(f"view not found: {name}")
             return QueryResult([], [], update_type="DROP VIEW")
@@ -272,6 +294,9 @@ class LocalRunner:
                 )
             return self._execute_stmt(_bind_parameters(inner, stmt.args))
         if isinstance(stmt, N.SetSession):
+            self.access_control.check_can_set_session(
+                self.session.user, stmt.name
+            )
             self.session.set(stmt.name, stmt.value)
             return QueryResult([], [], update_type="SET SESSION")
         if isinstance(stmt, N.ShowSession):
@@ -288,16 +313,34 @@ class LocalRunner:
                 ["table"], [(t,) for t in conn.tables()]
             )
         if isinstance(stmt, N.DropTable):
-            conn, _cat, table = self._resolve_write_target(stmt.parts)
+            conn, cat, table = self._resolve_write_target(stmt.parts)
+            self.access_control.check_can_drop_table(
+                self.session.user, cat, table
+            )
             conn.drop_table(table)
             return QueryResult([], [], update_type="DROP TABLE")
         if isinstance(stmt, (N.Delete, N.Update)):
+            _conn, cat, table = self._resolve_write_target(stmt.parts)
+            check = (
+                self.access_control.check_can_delete
+                if isinstance(stmt, N.Delete)
+                else self.access_control.check_can_update
+            )
+            check(self.session.user, cat, table)
             return self._execute_dml(stmt)
         if isinstance(stmt, (N.CreateTableAs, N.InsertInto)):
+            conn, cat, table = self._resolve_write_target(stmt.parts)
+            if isinstance(stmt, N.CreateTableAs):
+                self.access_control.check_can_create_table(
+                    self.session.user, cat, table
+                )
+            else:
+                self.access_control.check_can_insert(
+                    self.session.user, cat, table
+                )
             inner_plan = self._plan_statement_query(stmt.query)
             types = self.executor.output_types(inner_plan)
             names, rows = self.executor.execute(inner_plan)
-            conn, _cat, table = self._resolve_write_target(stmt.parts)
             if isinstance(stmt, N.CreateTableAs):
                 n = conn.create_table(table, names or [], types, rows)
                 return QueryResult(
@@ -412,6 +455,7 @@ class LocalRunner:
         from presto_tpu.exec.pushdown import push_scan_constraints
 
         out = self._planner().plan_statement(query)
+        self._check_plan_access(out)
         out = prune_plan(out, self.catalogs)
         out = push_scan_constraints(out)
         if self.mesh is not None:
@@ -421,6 +465,22 @@ class LocalRunner:
                 out, self.catalogs, **self._session_dist_options()
             )
         return out
+
+    def _check_plan_access(self, plan) -> None:
+        """checkCanSelect over every scanned table (reference:
+        AccessControlManager consulted by the analyzer; ours walks the
+        planned scans — the set the query actually reads, after view
+        expansion)."""
+        ac = self.access_control
+        user = self.session.user
+
+        def walk(n):
+            if isinstance(n, P.TableScan):
+                ac.check_can_select(user, n.catalog, n.table, n.columns)
+            for c in n.children():
+                walk(c)
+
+        walk(plan)
 
 
 def _sql_has_subquery(expr_sql: str) -> bool:

@@ -320,15 +320,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _session_from_headers(self) -> Session:
         props = {}
+        user = self.headers.get("X-Presto-User", "presto")
+        access = self.app._runner.access_control
         hdr = self.headers.get("X-Presto-Session", "")
         for part in hdr.split(","):
             part = part.strip()
             if part and "=" in part:
                 k, v = part.split("=", 1)
                 if k.strip() in SYSTEM_SESSION_PROPERTIES:
+                    # header overrides pass the same choke point as
+                    # SET SESSION statements (reference:
+                    # checkCanSetSystemSessionProperty runs for header-
+                    # carried properties too)
+                    access.check_can_set_session(user, k.strip())
                     props[k.strip()] = v.strip()
         return Session(
-            user=self.headers.get("X-Presto-User", "presto"),
+            user=user,
             catalog=self.headers.get("X-Presto-Catalog"),
             schema=self.headers.get("X-Presto-Schema", "default"),
             properties=props,
@@ -343,6 +350,8 @@ class _Handler(BaseHTTPRequestHandler):
         sql = self.rfile.read(length).decode()
         from presto_tpu.server.resource_groups import QueryQueueFullError
 
+        from presto_tpu.security import AccessDeniedError
+
         try:
             q = self.app.manager.submit(
                 sql, self._session_from_headers()
@@ -353,6 +362,13 @@ class _Handler(BaseHTTPRequestHandler):
                           "errorName": "QUERY_QUEUE_FULL"},
                 "stats": {"state": "FAILED"},
             }, 429)
+            return
+        except AccessDeniedError as e:
+            self._send_json({
+                "error": {"message": str(e),
+                          "errorName": "PERMISSION_DENIED"},
+                "stats": {"state": "FAILED"},
+            }, 403)
             return
         # brief wait so fast statements (SET SESSION, DDL) answer in one
         # round trip with their headers (reference: ~100ms initial wait)
@@ -535,6 +551,7 @@ class PrestoTpuServer:
             r.executor._jit_cache = self._shared_jit_cache
             r.views = self._runner.views
             r.prepared = self._runner.prepared
+            r.access_control = self._runner.access_control
             return r
 
         self.manager = QueryManager(runner_factory,

@@ -478,7 +478,33 @@ def parse_type(text: str) -> SqlType:
         )
     if base == "row":
         return RowType(tuple(parse_type(a) for a in args))
+    if base in _PLUGIN_TYPES:
+        if args:
+            raise ValueError(f"type {base} takes no parameters: {text!r}")
+        return _PLUGIN_TYPES[base]
     raise ValueError(f"unknown type: {text!r}")
+
+
+# type plugin SPI (reference: spi/Plugin.getTypes + TypeRegistry.addType):
+# plugins contribute named types that then resolve in CAST expressions
+# and DDL like any builtin
+_PLUGIN_TYPES: dict = {}
+
+
+def register_type(name: str, t: SqlType) -> None:
+    key = name.strip().lower()
+    if key in _PLUGIN_TYPES and _PLUGIN_TYPES[key] != t:
+        raise ValueError(f"type already registered: {name}")
+    if key not in _PLUGIN_TYPES:
+        try:
+            parse_type(key)
+        except ValueError:
+            pass
+        else:
+            # parse_type resolves builtins first, so a shadowing
+            # registration would be silently unreachable — reject it
+            raise ValueError(f"type name shadows a builtin: {name}")
+    _PLUGIN_TYPES[key] = t
 
 
 def common_super_type(a: SqlType, b: SqlType) -> Optional[SqlType]:

@@ -167,3 +167,115 @@ def test_plugin_aggregate_function():
         sum(math.log(k + 1) for k in range(25)) / 25
     )
     assert abs(got - want) / want < 1e-9
+
+
+def test_plugin_type_registration():
+    """Type plugin SPI (reference: spi/Plugin.getTypes +
+    TypeRegistry.addType): a contributed named type resolves in CAST."""
+    from presto_tpu import types as T
+    from presto_tpu.plugin import Plugin
+
+    class _TypePlugin(Plugin):
+        name = "types"
+
+        def types(self):
+            # an alias type: resolves by name to an existing SqlType
+            return {"money": T.DecimalType(18, 2)}
+
+    r = LocalRunner(
+        {"tpch": TpchConnector(0.01)}, plugins=[_TypePlugin()],
+        page_rows=1 << 12,
+    )
+    got = r.execute(
+        "select cast(o_totalprice as money) from orders "
+        "where o_orderkey = 1"
+    ).rows
+    assert len(got) == 1
+    assert T.parse_type("money") == T.DecimalType(18, 2)
+
+
+def test_access_control_plugin():
+    """Access control SPI (reference: spi/security/SystemAccessControl;
+    denials raise AccessDeniedException): select, write, and session
+    checks enforced at the reference's choke points."""
+    import pytest as _pytest
+
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.plugin import Plugin
+    from presto_tpu.security import AccessControl, AccessDeniedError
+
+    class _Restrictive(AccessControl):
+        def check_can_select(self, user, catalog, table, columns):
+            if table == "customer" and user != "admin":
+                self.deny(f"select from {table}")
+
+        def check_can_drop_table(self, user, catalog, table):
+            self.deny(f"drop {table}")
+
+        def check_can_set_session(self, user, name):
+            if name == "tpu_offload_enabled":
+                self.deny(f"set {name}")
+
+    class _SecPlugin(Plugin):
+        name = "security"
+
+        def access_control(self):
+            return _Restrictive()
+
+    r = LocalRunner(
+        {"tpch": TpchConnector(0.01), "memory": MemoryConnector()},
+        plugins=[_SecPlugin()], page_rows=1 << 12,
+    )
+    # allowed table passes
+    assert r.execute("select count(*) from nation").rows[0][0] == 25
+    # denied table fails, including when buried in a subquery/join
+    with _pytest.raises(AccessDeniedError):
+        r.execute("select count(*) from customer")
+    with _pytest.raises(AccessDeniedError):
+        r.execute(
+            "select count(*) from orders where o_custkey in "
+            "(select c_custkey from customer)"
+        )
+    # write checks
+    r.execute("create table memory.t1 as select 1 as x")
+    with _pytest.raises(AccessDeniedError):
+        r.execute("drop table memory.t1")
+    # session check
+    with _pytest.raises(AccessDeniedError):
+        r.execute("set session tpu_offload_enabled = false")
+    # metadata listings hide denied tables (reference: filterTables)
+    listed = {
+        t[0] for t in r.execute(
+            "select table_name from system.tables "
+            "where table_catalog = 'tpch'"
+        ).rows
+    }
+    assert "customer" not in listed and "nation" in listed
+    # view DDL checks are symmetric: create checked earlier, drop too
+    r.execute("create view v_ok as select 1 as x")
+
+    class _NoDrop(_Restrictive):
+        def check_can_drop_view(self, user, catalog, name):
+            self.deny(f"drop view {name}")
+
+    r.access_control = _NoDrop()
+    with _pytest.raises(AccessDeniedError):
+        r.execute("drop view v_ok")
+    r.access_control = _Restrictive()
+    # user-sensitive allow: admin can read customer
+    r.session.user = "admin"
+    assert r.execute("select count(*) from customer").rows[0][0] > 0
+
+
+def test_type_plugin_cannot_shadow_builtin():
+    from presto_tpu import types as T
+    from presto_tpu.plugin import Plugin
+
+    class _Shadow(Plugin):
+        def types(self):
+            return {"decimal": T.DecimalType(10, 0)}
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        LocalRunner({"tpch": TpchConnector(0.01)}, plugins=[_Shadow()])
