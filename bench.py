@@ -151,26 +151,31 @@ def _run_child(args, timeout, env=None):
 
 
 def main() -> int:
-    # ---- phase 1: timing child (exclusive chip, no D2H until done).
+    # ---- phase 1: timing, ONE BOUNDED CHILD PER RUNG — a rung that
+    # faults the device (axon >=4M-row line) or hangs loses only
+    # itself, not the rest of the ladder (observed: a q3_sf10 fault
+    # used to kill the q5/q17 timings queued behind it).
     # Stale results must not survive an early child crash: start clean.
     if os.path.exists(DETAILS_PATH):
         os.remove(DETAILS_PATH)
-    info, err = _run_child(
-        [sys.executable, __file__, "--time-child"], timeout=3600
-    )
+    for name, *_rest in RUNGS:
+        info, err = _run_child(
+            [sys.executable, __file__, "--time-child", name],
+            timeout=1800,
+        )
+        if info is None:
+            details = _read_details()
+            details["rungs"].setdefault(name, {})["time_error"] = err
+            _write_details(details)
+            print(f"# timing {name} failed: {err}", file=sys.stderr)
     details = _read_details()
-    if not details.get("rungs"):
+    if not any("steady_s" in r for r in details.get("rungs", {}).values()):
         print(json.dumps({
             "metric": "bench_failed", "value": 0, "unit": "s",
             "vs_baseline": 0.0,
         }))
-        print(f"# timing child failed: {err}", file=sys.stderr)
+        print("# all timing children failed", file=sys.stderr)
         return 1
-    if info is None:
-        # timings are written progressively; a child that died late
-        # (e.g. during the slow deferred overflow reads) only loses the
-        # overflow fields — keep going with what's on disk
-        print(f"# timing child incomplete: {err}", file=sys.stderr)
 
     # ---- phase 2: per-rung validation children
     for name, suite, qid, sf, props in RUNGS:
@@ -178,7 +183,9 @@ def main() -> int:
             [sys.executable,
              os.path.join(REPO, "tools", "validate_rung.py"),
              suite, str(qid), str(sf), *props],
-            timeout=1800,
+            # 15 min: D2H decode on the tunnel can be glacial but a
+            # rung needing more than this is unusable either way
+            timeout=900,
         )
         r = details["rungs"].setdefault(name, {})
         if info is None:
@@ -263,10 +270,10 @@ def _col_byte_width(t) -> int:
         return 8
 
 
-def time_child() -> int:
-    """Compile + timed device runs for every rung; ZERO device->host
-    reads until all timing is written, then the deferred overflow flags
-    are read (slow/hung reads can no longer hurt the numbers).
+def time_child(only: str = None) -> int:
+    """Compile + timed device runs for the selected rung (all rungs
+    when None — the orchestrator passes one rung per child so faults
+    stay contained); ZERO device->host reads while timing.
 
     Attribution per rung (VERDICT r2 #3): gen_s times the on-device
     generation of exactly the columns the query touches (scan==generate
@@ -282,8 +289,10 @@ def time_child() -> int:
     from tools._common import configure_jax, make_runner, queries
 
     jax = configure_jax()
-    details = {"rungs": {}, "backend": jax.default_backend(),
-               "device": str(jax.devices()[0])}
+    # merge into what earlier per-rung children wrote
+    details = _read_details()
+    details["backend"] = jax.default_backend()
+    details["device"] = str(jax.devices()[0])
     runners = {}
 
     def runner_for(suite, sf, props):
@@ -298,6 +307,8 @@ def time_child() -> int:
     )
 
     for name, suite, qid, sf, props in RUNGS:
+        if only is not None and name != only:
+            continue
         runner = runner_for(suite, sf, props)
         ex = runner.executor
         plan = runner.plan(queries(suite)[qid])
@@ -584,7 +595,13 @@ def sqlite_child() -> int:
 
 if __name__ == "__main__":
     if "--time-child" in sys.argv:
-        sys.exit(time_child())
+        i = sys.argv.index("--time-child")
+        only = (
+            sys.argv[i + 1]
+            if len(sys.argv) > i + 1
+            and not sys.argv[i + 1].startswith("-") else None
+        )
+        sys.exit(time_child(only))
     if "--oracle-child" in sys.argv:
         sys.exit(oracle_child())
     if "--sqlite-child" in sys.argv:
