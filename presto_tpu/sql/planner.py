@@ -605,7 +605,7 @@ class Planner:
         scope = Scope(left.fields + right.fields, outer)
         nleft = left.channels
         if isinstance(on, tuple) and on[0] == "using":
-            raise PlanningError("USING joins not supported yet")
+            return self._plan_using_join(rel, left, right, on[1])
         conjuncts = split_conjuncts(on)
         tr = ExprTranslator(self, scope)
         left_keys: List[int] = []
@@ -681,6 +681,65 @@ class Planner:
                 )
             rp = RelationPlan(P.Filter(rp.node, _and_ir(residual)), rp.fields)
         return rp
+
+    def _plan_using_join(self, rel, left, right, names) -> RelationPlan:
+        """JOIN ... USING (c1, ...): equi-join on same-named columns;
+        the output carries ONE copy of each using column (unqualified),
+        coalescing the sides for FULL joins, then the remaining columns
+        of both sides in order (reference: StatementAnalyzer USING
+        output scope rules)."""
+        jt = rel.join_type
+
+        def chan(fields, name, side):
+            hits = [
+                i for i, f in enumerate(fields) if f.name == name
+            ]
+            if not hits:
+                raise PlanningError(
+                    f"USING column {name!r} not on the {side} side"
+                )
+            if len(hits) > 1:
+                raise PlanningError(
+                    f"USING column {name!r} is ambiguous on the "
+                    f"{side} side"
+                )
+            return hits[0]
+
+        left_keys = tuple(
+            chan(left.fields, n, "left") for n in names
+        )
+        right_keys = tuple(
+            chan(right.fields, n, "right") for n in names
+        )
+        node = P.HashJoin(
+            left.node, right.node, left_keys, right_keys, join_type=jt,
+        )
+        nleft = left.channels
+        joined = left.fields + right.fields
+        exprs: List[ir.RowExpression] = []
+        fields: List[Field] = []
+        for n, lk, rk in zip(names, left_keys, right_keys):
+            lt = left.fields[lk].type
+            rt = right.fields[rk].type
+            t = T.common_super_type(lt, rt) or lt
+            lref = ir.InputRef(lk, lt)
+            rref = ir.InputRef(nleft + rk, rt)
+            if jt == "full":
+                e = ir.coalesce(lref, rref)
+            elif jt == "right":
+                e = rref
+            else:
+                e = lref
+            exprs.append(e)
+            fields.append(Field(n, t, frozenset()))
+        skip_l = set(left_keys)
+        skip_r = {nleft + rk for rk in right_keys}
+        for i, f in enumerate(joined):
+            if i in skip_l or i in skip_r:
+                continue
+            exprs.append(ir.InputRef(i, f.type))
+            fields.append(f)
+        return RelationPlan(P.Project(node, tuple(exprs)), fields)
 
     # ------------------------------------------------------------ costing
     def estimate(self, node: P.PhysicalNode) -> float:

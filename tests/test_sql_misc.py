@@ -295,3 +295,59 @@ class TestDistinctAggregates:
             "select sum(distinct n_regionkey), count(*) from nation"
         ).rows
         assert got == [(0 + 1 + 2 + 3 + 4, 25)]
+
+
+class TestUsingJoins:
+    """JOIN ... USING (reference: StatementAnalyzer's USING scope
+    rules): one unqualified copy of each using column, coalesced for
+    FULL joins, then the remaining columns of both sides."""
+
+    def test_inner_using_matches_on(self, runner):
+        a = runner.execute(
+            "select k, count(*), sum(l_extendedprice) from "
+            "(select o_orderkey k, o_totalprice from orders) "
+            "join (select l_orderkey k, l_extendedprice from lineitem) "
+            "using (k) group by k order by k limit 5"
+        ).rows
+        b = runner.execute(
+            "select a.k, count(*), sum(l_extendedprice) from "
+            "(select o_orderkey k, o_totalprice from orders) a "
+            "join (select l_orderkey k, l_extendedprice from lineitem) b "
+            "on a.k = b.k group by a.k order by a.k limit 5"
+        ).rows
+        assert a == b and len(a) == 5
+
+    def test_using_output_shape(self, runner):
+        res = runner.execute(
+            "select * from (select n_nationkey k, n_name from nation) "
+            "join (select r_regionkey k, r_name from region) using (k) "
+            "order by k limit 2"
+        )
+        # one k column, then n_name, then r_name
+        assert res.column_names == ["k", "n_name", "r_name"]
+        assert res.rows[0][0] == 0
+
+    def test_left_and_full_using_coalesce(self, runner):
+        left = runner.execute(
+            "select k, r_name from "
+            "(select n_nationkey k, n_name from nation) "
+            "left join (select r_regionkey k, r_name from region) "
+            "using (k) order by k"
+        ).rows
+        assert len(left) == 25
+        # keys 0..4 match regions; 5..24 null-extended
+        assert left[0][1] is not None and left[10][1] is None
+        full = runner.execute(
+            "select k from "
+            "(select r_regionkey k from region) "
+            "full join (select n_nationkey k from nation where "
+            "n_nationkey >= 3) using (k) order by k"
+        ).rows
+        # coalesced key: 0..2 from left only, 3,4 both, 5..24 right only
+        assert [r[0] for r in full] == list(range(25))
+
+    def test_using_missing_column_errors(self, runner):
+        with pytest.raises(PlanningError):
+            runner.execute(
+                "select * from nation join region using (nope)"
+            )
