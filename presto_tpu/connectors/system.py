@@ -23,7 +23,7 @@ Built-in tables (providers wired by LocalRunner / PrestoTpuServer):
 
 from __future__ import annotations
 
-import threading
+import dataclasses
 from typing import Callable, Dict, List, Optional, Sequence
 
 from presto_tpu import types as T
@@ -36,18 +36,23 @@ from presto_tpu.connectors.base import (
 from presto_tpu.page import Page
 
 
+@dataclasses.dataclass(frozen=True)
+class _SystemSplit(Split):
+    """Split carrying the row snapshot taken at planning time, so every
+    scan of one query sees one consistent row set for live tables
+    (e.g. runtime_queries) no matter which thread executes it
+    (reference: SystemTable cursors materialize per query, not per
+    page)."""
+
+    rows: tuple = ()
+
+
 class SystemConnector(Connector):
     name = "system"
 
     def __init__(self):
         self._schemas: Dict[str, TableSchema] = {}
         self._providers: Dict[str, Callable[[], List[tuple]]] = {}
-        # per-table snapshot taken at split planning so row_count and
-        # the subsequent page scans see one consistent row set;
-        # THREAD-local because concurrent queries (the server's memory-
-        # arbiter path) share this connector and each plans+scans on
-        # its own thread
-        self._local = threading.local()
 
     def register(
         self,
@@ -73,19 +78,29 @@ class SystemConnector(Connector):
             raise KeyError(f"system has no table {table!r}")
 
     def row_count(self, table: str) -> int:
-        rows = self._providers[table]()
-        if not hasattr(self._local, "snapshots"):
-            self._local.snapshots = {}
-        self._local.snapshots[table] = rows
-        return max(len(rows), 1)
+        return max(len(self._providers[table]()), 1)
+
+    def splits(self, table: str, target_rows: int) -> List[Split]:
+        """Snapshot the provider ONCE at split planning; the snapshot
+        rides on the splits so all page scans of this query agree."""
+        rows = tuple(self._providers[table]())
+        total = max(len(rows), 1)
+        out: List[Split] = []
+        start = 0
+        while start < total:
+            n = min(target_rows, total - start)
+            out.append(_SystemSplit(table, start, n, rows=rows))
+            start += n
+        return out
 
     # -------------------------------------------------------------- scan
     def page_for_split(
         self, split: Split, columns: Optional[Sequence[str]] = None
     ) -> Page:
         schema = self._schemas[split.table]
-        rows = getattr(self._local, "snapshots", {}).get(split.table)
-        if rows is None:
+        if isinstance(split, _SystemSplit):
+            rows = split.rows
+        else:  # direct page_for_split callers (tests/tools)
             rows = self._providers[split.table]()
         names = (
             tuple(columns) if columns is not None

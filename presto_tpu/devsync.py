@@ -1,0 +1,24 @@
+"""Forced device synchronization for honest timing on the axon runtime.
+
+Round-4 discovery (see bench.py docstring): on axon,
+``jax.block_until_ready`` returns at dispatch — it does NOT wait for
+device completion, and queued work drains only when a device->host read
+forces it. Every timing path in the tree (bench group children, the
+executor's EXPLAIN ANALYZE stats_drain mode, tools/microbench.py) must
+use THIS helper so a future protocol correction lands in one place.
+"""
+
+from __future__ import annotations
+
+
+def drain(tree) -> None:
+    """Force REAL completion of all device work queued before ``tree``
+    was produced: reads one element of the last leaf; FIFO execution
+    order means everything queued earlier has truly finished. Costs
+    ~0.1s on an empty queue; dispatch+drain cycles are repeatable."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if leaves and hasattr(leaves[-1], "ravel") and leaves[-1].size:
+        np.asarray(leaves[-1].ravel()[:1])

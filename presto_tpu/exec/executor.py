@@ -216,6 +216,10 @@ class Executor:
         self._pending_overflow: List[jnp.ndarray] = []
         self._capacity_boost = 1
         self._collect_stats = None  # id(node) -> NodeStats when ANALYZE
+        # EXPLAIN ANALYZE wall honesty on axon: drain the device queue
+        # after every page so per-node wall_s is real device time (costs
+        # ~6ms/page of sync overhead; off by default)
+        self.stats_drain = False
         # memory accounting (reference: OperatorContext->QueryContext
         # hierarchy + query.max-memory enforcement): page footprints are
         # computed from STATIC shapes (host arithmetic, never a device
@@ -370,6 +374,15 @@ class Executor:
             t0 = _time.perf_counter()
             try:
                 page = next(impl)
+                if self.stats_drain:
+                    # force real completion so wall_s is device time,
+                    # not dispatch time (axon: block_until_ready returns
+                    # at dispatch; only a D2H read drains the queue).
+                    # Every next() ends drained, so the time measured
+                    # here is exactly this node's own marginal work.
+                    from presto_tpu.devsync import drain as _drain
+
+                    _drain(page)
             except StopIteration:
                 st.wall_s += _time.perf_counter() - t0
                 break
@@ -1630,7 +1643,8 @@ def _group_ids(group_channels, page: Page, cap: int, max_iters: int = 64):
             # downstream segment ops scale with the group capacity (XLA:TPU
             # expands them to dense [n, cap] one-hot products)
             return A.compute_groups_dense(
-                gid, page.valid, space, out_capacity=_next_pow2(space)
+                gid, page.valid, space, out_capacity=_next_pow2(space),
+                sizes=tuple(sizes),
             )
     key_cols, key_nulls = K.block_key_columns(key_blocks)
     if page.valid.shape[0] >= (1 << 22):
@@ -1731,16 +1745,43 @@ def _hll_contributing(groups, blk: Optional[Block]):
     return contributing
 
 
+def _dense_keys_page(src: Page, group_channels, groups) -> Page:
+    """Synthesize group-key columns arithmetically from the mixed-radix
+    group id (dense path): avoids the rep_index scatter+gather, which
+    XLA then dead-code-eliminates from the program."""
+    out_cap = groups.group_valid.shape[0]
+    gid = jnp.arange(out_cap, dtype=jnp.int64)
+    codes = []
+    for s in reversed(groups.dense_sizes):
+        codes.append(gid % s)
+        gid = gid // s
+    codes.reverse()
+    blocks = []
+    for c, code in zip(group_channels, codes):
+        b = src.block(c)
+        blocks.append(
+            Block(data=code.astype(b.data.dtype), type=b.type,
+                  nulls=None, dictionary=b.dictionary)
+        )
+    return Page(blocks=tuple(blocks), valid=groups.group_valid)
+
+
+def _agg_keys_page(src: Page, group_channels, groups) -> Page:
+    if groups.dense_sizes is not None:
+        return _dense_keys_page(src, group_channels, groups)
+    return gather_rows(
+        src.select_channels(group_channels),
+        groups.rep_index,
+        groups.group_valid,
+    )
+
+
 def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
                       cap: int, max_iters: int = 64):
     groups = _group_ids(group_channels, page, cap, max_iters)
     # dense fast path may size output below cap (see _group_ids)
     out_cap = groups.group_valid.shape[0]
-    keys_page = gather_rows(
-        page.select_channels(group_channels),
-        groups.rep_index,
-        groups.group_valid,
-    )
+    keys_page = _agg_keys_page(page, group_channels, groups)
     state_blocks: List[Block] = []
     for spec, layout in zip(aggregates, layouts):
         blk = None if spec.channel is None else page.block(spec.channel)
@@ -1781,11 +1822,7 @@ def _merge_partials_page(aggregates, layouts, nkeys, merged: Page,
     key_channels = tuple(range(nkeys))
     groups = _group_ids(key_channels, merged, cap, max_iters)
     out_cap = groups.group_valid.shape[0]
-    keys_page = gather_rows(
-        merged.select_channels(key_channels),
-        groups.rep_index,
-        groups.group_valid,
-    )
+    keys_page = _agg_keys_page(merged, key_channels, groups)
     out_blocks: List[Block] = []
     ch = nkeys
     for spec, layout in zip(aggregates, layouts):
@@ -1825,11 +1862,7 @@ def _final_agg_page(group_channels, aggregates, layouts, in_types,
     key_channels = tuple(range(nkeys))
     groups = _group_ids(key_channels, merged, cap, max_iters)
     out_cap = groups.group_valid.shape[0]
-    keys_page = gather_rows(
-        merged.select_channels(key_channels),
-        groups.rep_index,
-        groups.group_valid,
-    )
+    keys_page = _agg_keys_page(merged, key_channels, groups)
     out_blocks: List[Block] = []
     ch = nkeys
     for spec, layout, in_t in zip(aggregates, layouts, in_types):

@@ -83,10 +83,16 @@ def resolve_type(name: str, arg_types: Sequence[T.SqlType]) -> T.SqlType:
 
 def eval_call(ctx: Ctx, name: str, result_type: T.SqlType, vals: List[Val]):
     fn = lookup(name)
-    vals = [broadcast_val(ctx.xp, v, ctx.capacity) for v in vals]
+    vals = [
+        v if not isinstance(v, Val) else broadcast_val(
+            ctx.xp, v, ctx.capacity)
+        for v in vals  # non-Val args are ir.Lambda nodes, passed as-is
+    ]
     out = fn.impl(ctx, result_type, vals)
     if fn.propagate_nulls:
-        extra = union_nulls(ctx.xp, *(v.nulls for v in vals))
+        extra = union_nulls(
+            ctx.xp, *(v.nulls for v in vals if isinstance(v, Val))
+        )
         out = Val(
             out.data,
             union_nulls(ctx.xp, out.nulls, extra),
@@ -1386,3 +1392,8 @@ def _impl_row_ctor(ctx: Ctx, rt, vals: List[Val]) -> Val:
 
 
 register("row", lambda a: T.RowType(tuple(a)), _impl_row_ctor)
+
+
+# extended builtin families (JSON, TRY/TRY_CAST, bitwise, URL, array/map
+# utilities) register themselves on import — see functions_ext.py
+from presto_tpu.expr import functions_ext  # noqa: E402,F401  isort:skip

@@ -1,0 +1,1179 @@
+"""Extended scalar builtins: JSON, TRY/TRY_CAST, bitwise, URL, array/map
+utilities, and misc string/date functions.
+
+Reference: presto-main operator/scalar/* — JsonFunctions + JsonExtract,
+TryCastFunction / the TRY special form, BitwiseFunctions, UrlFunctions,
+ArrayFunctions (array_distinct/array_sort/array_join/slice/sequence...),
+MapFunctions. Same evaluation model as presto_tpu/expr/functions.py:
+value-level work happens once per distinct dictionary entry on the host
+at trace time, vectorized gathers apply it per row.
+
+Divergences (documented):
+- JSON is canonicalized varchar, not a distinct type: json_parse
+  validates + canonicalizes; json functions accept any varchar JSON.
+- TRY is an identity pass-through: this engine already follows the
+  masked-eval policy (value-dependent errors produce NULL instead of
+  raising — see functions.py module docstring), so TRY(x) == x. It is
+  registered so reference SQL runs unchanged.
+- CAST from varchar parses per distinct value; unparsable values yield
+  NULL under both cast and try_cast (the reference raises for cast).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.parse
+from typing import List, Optional
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.expr.functions import (
+    Ctx,
+    _elem_result_val,
+    lookup,
+    register,
+)
+from presto_tpu.expr.functions import _dict_of as _base_dict_of
+from presto_tpu.expr.values import Val, rescale_decimal, union_nulls
+from presto_tpu.page import Dictionary
+
+
+def _dict_of(val: Val) -> Dictionary:
+    """Like functions._dict_of, but accepts string constants too: a
+    literal becomes a one-entry dictionary (its broadcast codes are
+    zeros, which index entry 0)."""
+    if (val.dictionary is None and val.is_const
+            and val.py_value is not None):
+        return Dictionary([val.py_value])
+    return _base_dict_of(val)
+
+
+def _codes(ctx: Ctx, col: Val, n: int):
+    return ctx.xp.clip(col.data, 0, max(n - 1, 0))
+
+
+def _varchar_results(ctx: Ctx, col: Val, results: List[Optional[str]],
+                     rt=T.VARCHAR) -> Val:
+    """Per-distinct string-or-None results -> varchar Val (new
+    dictionary + null lut)."""
+    return _elem_result_val(ctx, col, results, rt)
+
+
+def _require_const(val: Val, what: str):
+    if not val.is_const:
+        raise TypeError(f"{what} must be a constant")
+    return val.py_value
+
+
+# ------------------------------------------------------------------ JSON
+
+def _json_canon(v) -> str:
+    return json.dumps(v, separators=(",", ":"), ensure_ascii=False)
+
+
+def _parse_json(s):
+    try:
+        return json.loads(s)
+    except Exception:
+        return _JSON_BAD
+
+
+_JSON_BAD = object()
+
+
+_JSON_PATH_RE = re.compile(
+    r"""\.(?P<key>[A-Za-z_][A-Za-z0-9_]*)  # .key
+      | \[\s*(?P<index>\d+)\s*\]           # [0]
+      | \[\s*"(?P<qkey>[^"]*)"\s*\]        # ["key"]
+      | \[\s*'(?P<sqkey>[^']*)'\s*\]       # ['key']
+    """,
+    re.VERBOSE,
+)
+
+
+def _json_path_steps(path: str):
+    """Parse the $.a[0].b JSONPath subset (reference: JsonExtract's
+    non-script paths). Returns None for unsupported paths."""
+    if not path.startswith("$"):
+        return None
+    pos, steps = 1, []
+    while pos < len(path):
+        m = _JSON_PATH_RE.match(path, pos)
+        if m is None:
+            return None
+        if m.group("key") is not None:
+            steps.append(m.group("key"))
+        elif m.group("index") is not None:
+            steps.append(int(m.group("index")))
+        else:
+            steps.append(m.group("qkey") or m.group("sqkey") or "")
+        pos = m.end()
+    return steps
+
+
+def _json_walk(doc, steps):
+    cur = doc
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(cur, list) or s >= len(cur):
+                return _JSON_BAD
+            cur = cur[s]
+        else:
+            if not isinstance(cur, dict) or s not in cur:
+                return _JSON_BAD
+            cur = cur[s]
+    return cur
+
+
+def _json_extract_impl(scalar_only: bool):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        col = vals[0]
+        path = _require_const(vals[1], "json path")
+        steps = _json_path_steps(str(path))
+        d = _dict_of(col)
+
+        def one(v):
+            if steps is None:
+                return None
+            doc = _parse_json(str(v))
+            if doc is _JSON_BAD:
+                return None
+            out = _json_walk(doc, steps)
+            if out is _JSON_BAD:
+                return None
+            if scalar_only:
+                if isinstance(out, (dict, list)):
+                    return None
+                if out is None:
+                    return None
+                if isinstance(out, bool):
+                    return "true" if out else "false"
+                return str(out)
+            return _json_canon(out)
+
+        return _varchar_results(ctx, col, [one(v) for v in d.values])
+
+    return impl
+
+
+def _str_resolve(args):
+    if not T.is_string(args[0]):
+        raise TypeError(f"expected varchar, got {args[0]}")
+    return T.VARCHAR
+
+
+register("json_extract", _str_resolve, _json_extract_impl(False))
+register("json_extract_scalar", _str_resolve, _json_extract_impl(True))
+
+
+def _impl_json_parse(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    d = _dict_of(col)
+
+    def one(v):
+        doc = _parse_json(str(v))
+        return None if doc is _JSON_BAD else _json_canon(doc)
+
+    return _varchar_results(ctx, col, [one(v) for v in d.values])
+
+
+register("json_parse", _str_resolve, _impl_json_parse)
+# json_format(json) renders the canonical text — identity over our
+# canonicalized-varchar JSON representation
+register("json_format", _str_resolve,
+         lambda ctx, rt, vals: vals[0])
+
+
+def _impl_json_array_length(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    d = _dict_of(col)
+
+    def one(v):
+        doc = _parse_json(str(v))
+        return len(doc) if isinstance(doc, list) else None
+
+    return _elem_result_val(ctx, col, [one(v) for v in d.values],
+                            T.BIGINT)
+
+
+register("json_array_length", lambda a: T.BIGINT,
+         _impl_json_array_length)
+
+
+def _impl_json_size(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    path = _require_const(vals[1], "json path")
+    steps = _json_path_steps(str(path))
+    d = _dict_of(col)
+
+    def one(v):
+        if steps is None:
+            return None
+        doc = _parse_json(str(v))
+        if doc is _JSON_BAD:
+            return None
+        out = _json_walk(doc, steps)
+        if out is _JSON_BAD:
+            return None
+        return len(out) if isinstance(out, (dict, list)) else 0
+
+    return _elem_result_val(ctx, col, [one(v) for v in d.values],
+                            T.BIGINT)
+
+
+register("json_size", lambda a: T.BIGINT, _impl_json_size)
+
+
+def _impl_json_array_contains(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    want = _require_const(vals[1], "json_array_contains value")
+    d = _dict_of(col)
+
+    def one(v):
+        doc = _parse_json(str(v))
+        if not isinstance(doc, list):
+            return None
+        if isinstance(want, bool) or not isinstance(want, (int, float)):
+            return any(type(x) is type(want) and x == want for x in doc)
+        return any(
+            isinstance(x, (int, float)) and not isinstance(x, bool)
+            and float(x) == float(want)
+            for x in doc
+        )
+
+    return _elem_result_val(ctx, col, [one(v) for v in d.values],
+                            T.BOOLEAN)
+
+
+register("json_array_contains", lambda a: T.BOOLEAN,
+         _impl_json_array_contains)
+
+
+# ----------------------------------------------------------- TRY / casts
+
+# TRY(x) == x under the masked-eval policy (module docstring)
+register("try", lambda a: a[0], lambda ctx, rt, vals: vals[0],
+         propagate_nulls=False)
+
+
+def _parse_scalar(s: str, to: T.SqlType):
+    s = s.strip()
+    if T.is_integral(to):
+        return int(s)
+    if T.is_floating(to):
+        return float(s)
+    if isinstance(to, T.BooleanType):
+        low = s.lower()
+        if low in ("true", "t", "1"):
+            return True
+        if low in ("false", "f", "0"):
+            return False
+        raise ValueError(s)
+    if isinstance(to, T.DecimalType):
+        from decimal import Decimal
+
+        q = Decimal(s).scaleb(to.scale)
+        return int(q.to_integral_value(rounding="ROUND_HALF_UP"))
+    if isinstance(to, T.DateType):
+        import datetime
+
+        return (datetime.date.fromisoformat(s)
+                - datetime.date(1970, 1, 1)).days
+    if isinstance(to, T.TimestampType):
+        import datetime
+
+        dt = datetime.datetime.fromisoformat(s)
+        epoch = datetime.datetime(1970, 1, 1)
+        return int((dt - epoch).total_seconds() * 1_000_000)
+    raise TypeError(f"cannot parse varchar as {to}")
+
+
+def _string_cast_val(ctx: Ctx, col: Val, to: T.SqlType) -> Val:
+    if col.dictionary is None and col.is_const:
+        # string literal: parse once on the host
+        try:
+            r = _parse_scalar(str(col.py_value), to)
+        except Exception:
+            r = None
+        if r is None:
+            return Val(
+                ctx.xp.zeros((ctx.capacity,),
+                             dtype=np.dtype(to.numpy_dtype)),
+                ctx.xp.ones((ctx.capacity,), dtype=bool), to,
+            )
+        return Val(
+            ctx.xp.asarray(np.asarray(r, np.dtype(to.numpy_dtype))),
+            None, to, py_value=r,
+        )
+    d = _dict_of(col)
+
+    def one(v):
+        try:
+            return _parse_scalar(str(v), to)
+        except Exception:
+            return None
+
+    return _elem_result_val(ctx, col, [one(v) for v in d.values], to)
+
+
+def _impl_try_cast(ctx: Ctx, rt: T.SqlType, vals: List[Val]) -> Val:
+    from presto_tpu.expr.values import cast_data
+
+    v = vals[0]
+    if T.is_string(v.type) and not T.is_string(rt):
+        return _string_cast_val(ctx, v, rt)
+    try:
+        data, nulls = cast_data(ctx.xp, v, rt, ctx.capacity)
+        return Val(data, nulls, rt, v.dictionary if T.is_string(rt)
+                   else None)
+    except TypeError:
+        return Val(
+            ctx.xp.zeros((ctx.capacity,),
+                         dtype=np.dtype(rt.numpy_dtype)),
+            ctx.xp.ones((ctx.capacity,), dtype=bool),
+            rt,
+        )
+
+
+register("try_cast", lambda a: a[0], _impl_try_cast)
+
+
+def _install_string_source_cast() -> None:
+    """Teach plain CAST to parse varchar sources (per distinct value;
+    unparsable -> NULL, the masked-eval divergence)."""
+    base = lookup("cast")
+    base_impl = base.impl
+
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        v = vals[0]
+        if T.is_string(v.type) and not T.is_string(rt):
+            return _string_cast_val(ctx, v, rt)
+        return base_impl(ctx, rt, vals)
+
+    register("cast", base.resolve, impl, base.propagate_nulls)
+
+
+_install_string_source_cast()
+
+
+# ---------------------------------------------------------------- bitwise
+
+def _bitwise_resolve(args):
+    for a in args:
+        if not T.is_integral(a):
+            raise TypeError(f"bitwise function over {a}")
+    return T.BIGINT
+
+
+def _impl_bitwise(op):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        a = vals[0].data.astype(np.int64)
+        if op == "not":
+            return Val(~a, None, T.BIGINT)
+        b = vals[1].data.astype(np.int64)
+        if op == "and":
+            return Val(a & b, None, T.BIGINT)
+        if op == "or":
+            return Val(a | b, None, T.BIGINT)
+        return Val(a ^ b, None, T.BIGINT)
+
+    return impl
+
+
+register("bitwise_and", _bitwise_resolve, _impl_bitwise("and"))
+register("bitwise_or", _bitwise_resolve, _impl_bitwise("or"))
+register("bitwise_xor", _bitwise_resolve, _impl_bitwise("xor"))
+register("bitwise_not", _bitwise_resolve, _impl_bitwise("not"))
+
+
+def _impl_bit_count(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    bits = 64
+    if len(vals) > 1:
+        bits = int(_require_const(vals[1], "bit_count bits"))
+    u = vals[0].data.astype(np.int64)
+    if bits < 64:
+        u = u & np.int64((1 << bits) - 1)
+    # SWAR popcount over int64 (no gathers, vector-unit friendly)
+    x = u - ((u >> np.int64(1)) & np.int64(0x5555555555555555))
+    x = ((x >> np.int64(2)) & np.int64(0x3333333333333333)) + (
+        x & np.int64(0x3333333333333333))
+    x = (x + (x >> np.int64(4))) & np.int64(0x0F0F0F0F0F0F0F0F)
+    c = ctx.xp.zeros_like(u)
+    for k in range(8):
+        c = c + ((x >> np.int64(8 * k)) & np.int64(0xFF))
+    return Val(c, None, T.BIGINT)
+
+
+register("bit_count", lambda a: T.BIGINT, _impl_bit_count)
+
+
+# -------------------------------------------------------------------- URL
+
+def _url_part(part: str):
+    def one(v):
+        try:
+            u = urllib.parse.urlsplit(str(v))
+        except Exception:
+            return None
+        if part == "protocol":
+            return u.scheme or None
+        if part == "host":
+            return u.hostname or None
+        if part == "port":
+            return u.port
+        if part == "path":
+            return u.path
+        if part == "query":
+            return u.query
+        if part == "fragment":
+            return u.fragment
+        raise ValueError(part)
+
+    return one
+
+
+for _p in ("protocol", "host", "path", "query", "fragment"):
+    register(
+        f"url_extract_{_p}", _str_resolve,
+        (lambda p: lambda ctx, rt, vals: _varchar_results(
+            ctx, vals[0],
+            [_url_part(p)(v) for v in _dict_of(vals[0]).values]
+        ))(_p),
+    )
+register(
+    "url_extract_port", lambda a: T.BIGINT,
+    lambda ctx, rt, vals: _elem_result_val(
+        ctx, vals[0],
+        [_url_part("port")(v) for v in _dict_of(vals[0]).values],
+        T.BIGINT,
+    ),
+)
+
+
+def _impl_url_extract_parameter(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    name = str(_require_const(vals[1], "parameter name"))
+
+    def one(v):
+        try:
+            q = urllib.parse.urlsplit(str(v)).query
+            params = urllib.parse.parse_qs(q, keep_blank_values=True)
+        except Exception:
+            return None
+        vs = params.get(name)
+        return vs[0] if vs else None
+
+    return _varchar_results(
+        ctx, col, [one(v) for v in _dict_of(col).values]
+    )
+
+
+register("url_extract_parameter", _str_resolve,
+         _impl_url_extract_parameter)
+register(
+    "url_encode", _str_resolve,
+    lambda ctx, rt, vals: _varchar_results(
+        ctx, vals[0],
+        [urllib.parse.quote(str(v), safe="") for v in
+         _dict_of(vals[0]).values],
+    ),
+)
+register(
+    "url_decode", _str_resolve,
+    lambda ctx, rt, vals: _varchar_results(
+        ctx, vals[0],
+        [urllib.parse.unquote(str(v)) for v in
+         _dict_of(vals[0]).values],
+    ),
+)
+
+
+# ----------------------------------------------------------- array / map
+
+def _array_resolve_same(args):
+    if not isinstance(args[0], T.ArrayType):
+        raise TypeError(f"expected array, got {args[0]}")
+    return args[0]
+
+
+def _array_map(ctx: Ctx, col: Val, fn, rt) -> Val:
+    d = _dict_of(col)
+    return _elem_result_val(
+        ctx, col, [fn(tuple(v)) for v in d.values], rt
+    )
+
+
+register(
+    "array_distinct", _array_resolve_same,
+    lambda ctx, rt, vals: _array_map(
+        ctx, vals[0], lambda v: tuple(dict.fromkeys(v)), rt
+    ),
+)
+
+
+def _sort_key(x):
+    return (x is None, x)
+
+
+register(
+    "array_sort", _array_resolve_same,
+    lambda ctx, rt, vals: _array_map(
+        ctx, vals[0],
+        lambda v: tuple(sorted(v, key=_sort_key)), rt
+    ),
+)
+
+
+def _impl_array_join(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    delim = str(_require_const(vals[1], "array_join delimiter"))
+    null_rep = None
+    if len(vals) > 2:
+        null_rep = str(_require_const(vals[2], "null replacement"))
+
+    def one(v):
+        parts = []
+        for x in v:
+            if x is None:
+                if null_rep is None:
+                    continue
+                parts.append(null_rep)
+            elif isinstance(x, bool):
+                parts.append("true" if x else "false")
+            else:
+                parts.append(str(x))
+        return delim.join(parts)
+
+    return _varchar_results(
+        ctx, col, [one(tuple(v)) for v in _dict_of(col).values]
+    )
+
+
+register("array_join", lambda a: T.VARCHAR, _impl_array_join)
+
+
+def _impl_array_position(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    want = _require_const(vals[1], "array_position value")
+
+    def one(v):
+        for i, x in enumerate(v):
+            if x == want:
+                return i + 1
+        return 0
+
+    return _elem_result_val(
+        ctx, col, [one(tuple(v)) for v in _dict_of(col).values],
+        T.BIGINT,
+    )
+
+
+register("array_position", lambda a: T.BIGINT, _impl_array_position)
+def _impl_array_remove(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    want = _require_const(vals[1], "array_remove value")
+    return _array_map(
+        ctx, vals[0],
+        lambda v: tuple(x for x in v if x != want), rt,
+    )
+
+
+register("array_remove", _array_resolve_same, _impl_array_remove)
+
+
+def _impl_slice(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    start = int(_require_const(vals[1], "slice start"))
+    length = int(_require_const(vals[2], "slice length"))
+
+    def one(v):
+        if start > 0:
+            i = start - 1
+        elif start < 0:
+            i = max(len(v) + start, 0)
+        else:
+            return None  # slice(x, 0, n) is an error in the reference
+        return tuple(v[i:i + max(length, 0)])
+
+    return _array_map(ctx, vals[0], lambda v: one(v), rt)
+
+
+register("slice", _array_resolve_same, _impl_slice)
+
+
+def _impl_flatten(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    def one(v):
+        out = []
+        for x in v:
+            if x is not None:
+                out.extend(x)
+        return tuple(out)
+
+    return _array_map(ctx, vals[0], one, rt)
+
+
+def _flatten_resolve(args):
+    t = args[0]
+    if not (isinstance(t, T.ArrayType)
+            and isinstance(t.element, T.ArrayType)):
+        raise TypeError(f"flatten over {t}")
+    return t.element
+
+
+register("flatten", _flatten_resolve, _impl_flatten)
+
+
+def _impl_sequence(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    a = int(_require_const(vals[0], "sequence start"))
+    b = int(_require_const(vals[1], "sequence stop"))
+    step = (int(_require_const(vals[2], "sequence step"))
+            if len(vals) > 2 else (1 if b >= a else -1))
+    if step == 0:
+        raise ValueError("sequence step cannot be zero")
+    val = tuple(range(a, b + (1 if step > 0 else -1), step))
+    return Val(
+        ctx.xp.zeros((ctx.capacity,), dtype=np.int32), None,
+        T.ArrayType(T.BIGINT), Dictionary([val]), py_value=val,
+    )
+
+
+register("sequence", lambda a: T.ArrayType(T.BIGINT), _impl_sequence)
+
+
+def _impl_repeat(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    n = int(_require_const(vals[1], "repeat count"))
+    el = vals[0]
+    if not el.is_const:
+        raise TypeError("repeat element must be a constant")
+    val = tuple([el.py_value] * max(n, 0))
+    return Val(
+        ctx.xp.zeros((ctx.capacity,), dtype=np.int32), None,
+        T.ArrayType(el.type), Dictionary([val]), py_value=val,
+    )
+
+
+register("repeat", lambda a: T.ArrayType(a[0]), _impl_repeat)
+
+
+def _install_reverse_for_arrays() -> None:
+    base = lookup("reverse")
+    base_impl = base.impl
+
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        if isinstance(vals[0].type, T.ArrayType):
+            return _array_map(
+                ctx, vals[0], lambda v: tuple(reversed(v)),
+                vals[0].type,
+            )
+        return base_impl(ctx, rt, vals)
+
+    def resolve(args):
+        if isinstance(args[0], T.ArrayType):
+            return args[0]
+        return base.resolve(args)
+
+    register("reverse", resolve, impl, base.propagate_nulls)
+
+
+_install_reverse_for_arrays()
+
+
+def _impl_split(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    delim = str(_require_const(vals[1], "split delimiter"))
+    limit = (int(_require_const(vals[2], "split limit"))
+             if len(vals) > 2 else None)
+
+    def one(v):
+        s = str(v)
+        parts = (s.split(delim, limit - 1)
+                 if limit is not None else s.split(delim))
+        return tuple(parts)
+
+    return _elem_result_val(
+        ctx, col, [one(v) for v in _dict_of(col).values],
+        T.ArrayType(T.VARCHAR),
+    )
+
+
+register("split", lambda a: T.ArrayType(T.VARCHAR), _impl_split)
+
+
+def _map_resolve(args):
+    if not isinstance(args[0], T.MapType):
+        raise TypeError(f"expected map, got {args[0]}")
+    return args[0]
+
+
+def _impl_map_entries(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    t = vals[0].type
+    return _elem_result_val(
+        ctx, vals[0],
+        [tuple(tuple(kv) for kv in v)
+         for v in _dict_of(vals[0]).values],
+        T.ArrayType(T.RowType((t.key, t.value))),
+    )
+
+
+register(
+    "map_entries",
+    lambda a: T.ArrayType(T.RowType((a[0].key, a[0].value)))
+    if isinstance(a[0], T.MapType) else T.UNKNOWN,
+    _impl_map_entries,
+)
+
+
+def _impl_typeof(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    name = str(vals[0].type)
+    return Val(
+        ctx.xp.zeros((ctx.capacity,), dtype=np.int32), None,
+        T.VARCHAR, Dictionary([name]), py_value=name,
+    )
+
+
+register("typeof", lambda a: T.VARCHAR, _impl_typeof,
+         propagate_nulls=False)
+
+
+# ------------------------------------------------------------------ misc
+
+def _impl_chr(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    v = vals[0]
+    n = _require_const(v, "chr codepoint")
+    s = chr(int(n))
+    return Val(
+        ctx.xp.zeros((ctx.capacity,), dtype=np.int32), None,
+        T.VARCHAR, Dictionary([s]), py_value=s,
+    )
+
+
+register("chr", lambda a: T.VARCHAR, _impl_chr)
+
+
+def _impl_last_day_of_month(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    from presto_tpu.expr.values import (
+        civil_from_days,
+        days_from_civil,
+        days_in_month,
+    )
+
+    xp = ctx.xp
+    v = vals[0]
+    days = v.data
+    if isinstance(v.type, T.TimestampType):
+        days = (days // np.int64(86_400_000_000)).astype(np.int32)
+    y, m, _d = civil_from_days(xp, days)
+    last = days_in_month(xp, y, m)
+    return Val(
+        days_from_civil(xp, y, m, last).astype(np.int32), None, T.DATE
+    )
+
+
+register(
+    "last_day_of_month",
+    lambda a: T.DATE,
+    _impl_last_day_of_month,
+)
+
+
+def _impl_date_parse(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    """date_parse(varchar, mysql-format) -> timestamp (reference:
+    MySQL-compatible DateTimeFunctions.dateParse). Supported
+    specifiers: %Y %y %m %c %d %e %H %k %i %s %f."""
+    import datetime
+
+    col = vals[0]
+    fmt = str(_require_const(vals[1], "date_parse format"))
+    pyfmt = (fmt.replace("%c", "%m").replace("%e", "%d")
+             .replace("%k", "%H").replace("%i", "%M")
+             .replace("%s", "%S").replace("%f", "%f"))
+
+    def one(v):
+        try:
+            dt = datetime.datetime.strptime(str(v), pyfmt)
+        except Exception:
+            return None
+        epoch = datetime.datetime(1970, 1, 1)
+        return int((dt - epoch).total_seconds() * 1_000_000)
+
+    return _elem_result_val(
+        ctx, col, [one(v) for v in _dict_of(col).values],
+        T.TIMESTAMP,
+    )
+
+
+register("date_parse", lambda a: T.TIMESTAMP, _impl_date_parse)
+
+
+def _impl_to_hex_from(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+    d = _dict_of(col)
+    return _varchar_results(
+        ctx, col,
+        [str(v).encode("utf-8").hex().upper() for v in d.values],
+    )
+
+
+register("to_hex", _str_resolve, _impl_to_hex_from)
+
+
+def _impl_from_hex(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    col = vals[0]
+
+    def one(v):
+        try:
+            return bytes.fromhex(str(v)).decode("utf-8")
+        except Exception:
+            return None
+
+    return _varchar_results(
+        ctx, col, [one(v) for v in _dict_of(col).values]
+    )
+
+
+register("from_hex", _str_resolve, _impl_from_hex)
+
+
+def _impl_hash_fn(algo):
+    import hashlib
+
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        col = vals[0]
+        return _varchar_results(
+            ctx, col,
+            [hashlib.new(algo, str(v).encode("utf-8")).hexdigest()
+             for v in _dict_of(col).values],
+        )
+
+    return impl
+
+
+# hex-digest flavors of the reference's varbinary md5/sha256 (varbinary
+# payloads stay host-side in this engine — see types.py docstring)
+register("md5", _str_resolve, _impl_hash_fn("md5"))
+register("sha256", _str_resolve, _impl_hash_fn("sha256"))
+register("sha1", _str_resolve, _impl_hash_fn("sha1"))
+
+
+def _impl_to_base64(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    import base64
+
+    return _varchar_results(
+        ctx, vals[0],
+        [base64.b64encode(str(v).encode("utf-8")).decode("ascii")
+         for v in _dict_of(vals[0]).values],
+    )
+
+
+def _impl_from_base64(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    import base64
+
+    def one(v):
+        try:
+            return base64.b64decode(str(v)).decode("utf-8")
+        except Exception:
+            return None
+
+    return _varchar_results(
+        ctx, vals[0], [one(v) for v in _dict_of(vals[0]).values]
+    )
+
+
+register("to_base64", _str_resolve, _impl_to_base64)
+register("from_base64", _str_resolve, _impl_from_base64)
+
+
+def _impl_normalize(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    import unicodedata
+
+    form = "NFC"
+    if len(vals) > 1:
+        form = str(_require_const(vals[1], "normalize form")).upper()
+
+    return _varchar_results(
+        ctx, vals[0],
+        [unicodedata.normalize(form, str(v))
+         for v in _dict_of(vals[0]).values],
+    )
+
+
+register("normalize", _str_resolve, _impl_normalize)
+
+
+def _impl_starts_with(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    prefix = str(_require_const(vals[1], "starts_with prefix"))
+    return _elem_result_val(
+        ctx, vals[0],
+        [str(v).startswith(prefix)
+         for v in _dict_of(vals[0]).values],
+        T.BOOLEAN,
+    )
+
+
+register("starts_with", lambda a: T.BOOLEAN, _impl_starts_with)
+
+
+# ------------------------------------------------- higher-order (lambdas)
+
+def _infer_elem_type(vals_, declared):
+    if declared is not None and not isinstance(declared, T.UnknownType):
+        return declared
+    for v in vals_:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return T.BOOLEAN
+        if isinstance(v, int):
+            return T.BIGINT
+        if isinstance(v, float):
+            return T.DOUBLE
+        if isinstance(v, str):
+            return T.VARCHAR
+        if isinstance(v, (list, tuple)):
+            return T.ArrayType(T.UNKNOWN)
+    return T.BIGINT
+
+
+def _host_block(vals_, t):
+    from presto_tpu.page import Block
+
+    n = len(vals_)
+    isnull = np.array([v is None for v in vals_], bool)
+    has_null = bool(isnull.any())
+    if t.is_dictionary_encoded:
+        uniq: dict = {}
+        codes = np.zeros(n, np.int32)
+        for i, v in enumerate(vals_):
+            if v is None:
+                continue
+            codes[i] = uniq.setdefault(v, len(uniq))
+        return Block(
+            data=codes, type=t,
+            nulls=isnull if has_null else None,
+            dictionary=Dictionary(list(uniq)),
+        )
+    data = np.zeros(n, np.dtype(t.numpy_dtype))
+    for i, v in enumerate(vals_):
+        if v is not None:
+            data[i] = v
+    return Block(data=data, type=t,
+                 nulls=isnull if has_null else None)
+
+
+def _val_to_pylist(val: Val, n: int) -> list:
+    data = val.data
+    if isinstance(data, tuple):
+        raise TypeError("lambda bodies over long decimals unsupported")
+    arr = np.asarray(data)
+    if arr.ndim == 0:
+        arr = np.broadcast_to(arr, (n,))
+    nulls = (np.asarray(val.nulls) if val.nulls is not None
+             else np.zeros(n, bool))
+    if nulls.ndim == 0:
+        nulls = np.broadcast_to(nulls, (n,))
+    scale = (val.type.scale
+             if isinstance(val.type, T.DecimalType) else None)
+    out = []
+    for i in range(n):
+        if nulls[i]:
+            out.append(None)
+        elif val.dictionary is not None:
+            out.append(
+                val.dictionary.values[
+                    int(np.clip(arr[i], 0, len(val.dictionary) - 1))
+                ]
+            )
+        else:
+            v = arr[i]
+            v = v.item() if hasattr(v, "item") else v
+            if scale is not None:
+                # unscaled decimal -> exact Decimal value
+                from decimal import Decimal
+
+                v = Decimal(v).scaleb(-scale)
+            out.append(v)
+    return out
+
+
+def _run_lambda(lam, columns, param_types) -> list:
+    """Evaluate a lambda body over parallel element columns on the host
+    (numpy xp) — the per-distinct-value translation of the reference's
+    per-row lambda invocation. Returns body results (None = NULL)."""
+    from presto_tpu.expr.eval import evaluate
+    from presto_tpu.page import Page
+
+    n = len(columns[0]) if columns else 0
+    if n == 0:
+        return []
+    blocks = tuple(
+        _host_block(c, _infer_elem_type(c, t))
+        for c, t in zip(columns, param_types)
+    )
+    page = Page(blocks=blocks, valid=np.ones(n, bool))
+    return _val_to_pylist(evaluate(lam.body, page, np), n)
+
+
+def _lam_of(vals: List, i: int):
+    from presto_tpu.expr import ir
+
+    if not isinstance(vals[i], ir.Lambda):
+        raise TypeError("expected a lambda argument")
+    return vals[i]
+
+
+def _impl_transform(ctx: Ctx, rt, vals: List) -> Val:
+    col, lam = vals[0], _lam_of(vals, 1)
+    elem_t = (col.type.element if isinstance(col.type, T.ArrayType)
+              else T.UNKNOWN)
+    outs = [
+        tuple(_run_lambda(lam, [list(v)], [elem_t]))
+        for v in _dict_of(col).values
+    ]
+    return _elem_result_val(ctx, col, outs, rt)
+
+
+def _impl_filter(ctx: Ctx, rt, vals: List) -> Val:
+    col, lam = vals[0], _lam_of(vals, 1)
+    elem_t = (col.type.element if isinstance(col.type, T.ArrayType)
+              else T.UNKNOWN)
+    outs = []
+    for v in _dict_of(col).values:
+        v = tuple(v)
+        keep = _run_lambda(lam, [list(v)], [elem_t])
+        outs.append(tuple(x for x, k in zip(v, keep) if k is True
+                          or k == 1 and k is not None))
+    return _elem_result_val(ctx, col, outs, rt)
+
+
+def _match_impl(mode: str):
+    def impl(ctx: Ctx, rt, vals: List) -> Val:
+        col, lam = vals[0], _lam_of(vals, 1)
+        elem_t = (col.type.element
+                  if isinstance(col.type, T.ArrayType) else T.UNKNOWN)
+        outs = []
+        for v in _dict_of(col).values:
+            res = _run_lambda(lam, [list(v)], [elem_t])
+            trues = sum(1 for r in res if r)
+            has_null = any(r is None for r in res)
+            if mode == "any":
+                out = True if trues else (None if has_null else False)
+            elif mode == "all":
+                out = (False if any(r is False or r == 0 and r is not None
+                                    for r in res)
+                       else (None if has_null else True))
+            else:  # none
+                out = False if trues else (None if has_null else True)
+            outs.append(out)
+        return _elem_result_val(ctx, col, outs, T.BOOLEAN)
+
+    return impl
+
+
+def _hof_array_resolve_elem(args):
+    if not isinstance(args[0], T.ArrayType):
+        raise TypeError(f"expected array, got {args[0]}")
+    return T.ArrayType(args[1])
+
+
+register("transform", _hof_array_resolve_elem, _impl_transform)
+register("filter", lambda a: a[0], _impl_filter)
+register("any_match", lambda a: T.BOOLEAN, _match_impl("any"))
+register("all_match", lambda a: T.BOOLEAN, _match_impl("all"))
+register("none_match", lambda a: T.BOOLEAN, _match_impl("none"))
+
+
+def _map_kv_columns(v):
+    ks = [kv[0] for kv in v]
+    vs_ = [kv[1] for kv in v]
+    return ks, vs_
+
+
+def _impl_transform_values(ctx: Ctx, rt, vals: List) -> Val:
+    col, lam = vals[0], _lam_of(vals, 1)
+    t = col.type
+    outs = []
+    for v in _dict_of(col).values:
+        v = tuple(tuple(kv) for kv in v)
+        ks, vs_ = _map_kv_columns(v)
+        if lam.n_params == 1:
+            res = _run_lambda(lam, [vs_], [t.value])
+        else:
+            res = _run_lambda(lam, [ks, vs_], [t.key, t.value])
+        outs.append(tuple(zip(ks, res)))
+    return _elem_result_val(ctx, col, outs, rt)
+
+
+def _impl_transform_keys(ctx: Ctx, rt, vals: List) -> Val:
+    col, lam = vals[0], _lam_of(vals, 1)
+    t = col.type
+    outs = []
+    for v in _dict_of(col).values:
+        v = tuple(tuple(kv) for kv in v)
+        ks, vs_ = _map_kv_columns(v)
+        if lam.n_params == 1:
+            res = _run_lambda(lam, [ks], [t.key])
+        else:
+            res = _run_lambda(lam, [ks, vs_], [t.key, t.value])
+        outs.append(tuple(zip(res, vs_)))
+    return _elem_result_val(ctx, col, outs, rt)
+
+
+def _impl_map_filter(ctx: Ctx, rt, vals: List) -> Val:
+    col, lam = vals[0], _lam_of(vals, 1)
+    t = col.type
+    outs = []
+    for v in _dict_of(col).values:
+        v = tuple(tuple(kv) for kv in v)
+        ks, vs_ = _map_kv_columns(v)
+        keep = _run_lambda(lam, [ks, vs_], [t.key, t.value])
+        outs.append(tuple(kv for kv, k in zip(v, keep) if k))
+    return _elem_result_val(ctx, col, outs, rt)
+
+
+def _map_hof_resolve(kind):
+    def resolve(args):
+        t = args[0]
+        if not isinstance(t, T.MapType):
+            raise TypeError(f"expected map, got {t}")
+        if kind == "values":
+            return T.MapType(t.key, args[1])
+        if kind == "keys":
+            return T.MapType(args[1], t.value)
+        return t
+
+    return resolve
+
+
+register("transform_values", _map_hof_resolve("values"),
+         _impl_transform_values)
+register("transform_keys", _map_hof_resolve("keys"),
+         _impl_transform_keys)
+register("map_filter", _map_hof_resolve("filter"), _impl_map_filter)
+
+
+def _impl_reduce(ctx: Ctx, rt, vals: List) -> Val:
+    """reduce(array, init, (acc, x) -> acc', acc -> out): host fold per
+    distinct value (init must be a constant)."""
+    from presto_tpu.expr import ir
+
+    col = vals[0]
+    init = _require_const(vals[1], "reduce initial state")
+    combine = _lam_of(vals, 2)
+    output = vals[3] if len(vals) > 3 else None
+    elem_t = (col.type.element if isinstance(col.type, T.ArrayType)
+              else T.UNKNOWN)
+    outs = []
+    for v in _dict_of(col).values:
+        acc = init
+        for x in tuple(v):
+            r = _run_lambda(combine, [[acc], [x]], [None, elem_t])
+            acc = r[0] if r else None
+        if output is not None and isinstance(output, ir.Lambda):
+            r = _run_lambda(output, [[acc]], [None])
+            acc = r[0] if r else None
+        outs.append(acc)
+    res_t = _infer_elem_type(outs, None)
+    return _elem_result_val(ctx, col, outs, res_t)
+
+
+register("reduce", lambda a: a[-1] if a else T.UNKNOWN, _impl_reduce)

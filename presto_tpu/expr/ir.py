@@ -72,6 +72,41 @@ class Call(RowExpression):
         return f"{self.name}({inner})"
 
 
+@dataclasses.dataclass(frozen=True)
+class ParamRef(RowExpression):
+    """Lambda parameter reference (reference: sql/relational
+    VariableReferenceExpression inside LambdaDefinitionExpression).
+    Distinct from InputRef so plan-level channel rewrites (pruning,
+    pushdown) can never confuse a lambda parameter with a page
+    channel."""
+
+    index: int
+    type: T.SqlType = dataclasses.field(default_factory=T.UnknownType)
+
+    def __repr__(self) -> str:
+        return f"$lambda{self.index}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Lambda(RowExpression):
+    """Lambda argument to a higher-order function (reference:
+    LambdaDefinitionExpression). Parameters appear in the body as
+    ParamRef(0..n_params-1); ``type`` is the body's result type. The
+    body must be capture-free (enforced at planning) so it can be
+    evaluated per distinct dictionary value on the host."""
+
+    n_params: int
+    body: RowExpression
+    type: T.SqlType = dataclasses.field(default_factory=T.UnknownType)
+
+    def children(self) -> Tuple[RowExpression, ...]:
+        return (self.body,)
+
+    def __repr__(self) -> str:
+        ps = ", ".join(f"$lambda{i}" for i in range(self.n_params))
+        return f"({ps}) -> {self.body!r}"
+
+
 # SpecialForm kinds (reference: SpecialFormExpression.Form)
 AND = "and"
 OR = "or"

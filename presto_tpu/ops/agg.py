@@ -91,6 +91,12 @@ class GroupbyResult:
     group_valid: jnp.ndarray  # bool[out_cap]
     num_groups: jnp.ndarray  # traced scalar
     overflow: jnp.ndarray  # traced bool
+    # dense path only: per-key-column code-space sizes. Group id is the
+    # mixed-radix encoding of the key codes, so callers can synthesize
+    # key columns arithmetically from arange(out_cap) instead of
+    # gathering through rep_index — XLA then dead-code-eliminates the
+    # rep scatter entirely.
+    dense_sizes: Optional[Tuple[int, ...]] = None
 
 
 def compute_groups_sorted(
@@ -162,6 +168,7 @@ def compute_groups_dense(
     valid: jnp.ndarray,
     num_groups: int,
     out_capacity: Optional[int] = None,
+    sizes: Optional[Tuple[int, ...]] = None,
 ) -> GroupbyResult:
     """Group ids already computed arithmetically (e.g. from dictionary codes:
     gid = code_a * |dict_b| + code_b). Static group count, no sort, no hash
@@ -171,16 +178,18 @@ def compute_groups_dense(
     """
     cap = out_capacity or num_groups
     assert cap >= num_groups
-    # Segment ops run over num_groups+1 segments, NOT cap+1: XLA:TPU expands
-    # small-segment scatters into a dense [n, num_segments] one-hot product,
-    # so segment count must match the true key space (6 for Q1), never the
-    # caller's generic capacity (4096 would materialize gigabytes per op).
+    # Segment ops (the rep scatter below) run over num_groups+1 segments,
+    # NOT cap+1: segment count must match the true key space (6 for Q1),
+    # never the caller's generic capacity.
     ids = jnp.where(valid, group_ids.astype(jnp.int64), num_groups)
-    counts = jax.ops.segment_sum(
-        jnp.ones(valid.shape, dtype=jnp.int64),
-        ids,
-        num_segments=num_groups + 1,
-    )[:num_groups]
+    if _mm_backend_ok() and num_groups <= MATMUL_AGG_MAX_GROUPS:
+        counts = _mm_count(ids, num_groups)
+    else:
+        counts = jax.ops.segment_sum(
+            jnp.ones(valid.shape, dtype=jnp.int64),
+            ids,
+            num_segments=num_groups + 1,
+        )[:num_groups]
     pad = cap - num_groups
     group_valid = jnp.pad(counts > 0, (0, pad))
     # representative row per group: min input index holding that gid
@@ -198,6 +207,7 @@ def compute_groups_dense(
         group_valid=group_valid,
         num_groups=jnp.sum(group_valid.astype(jnp.int64)),
         overflow=jnp.asarray(False),
+        dense_sizes=sizes,
     )
 
 
@@ -306,6 +316,96 @@ def compute_groups_hashed(
     )
 
 
+# Above this group capacity the one-hot matmul aggregation falls back to
+# XLA scatter. n x G int8 MACs are effectively free on the MXU up to here
+# (measured: G=4096 over 256k rows adds < 1ms to a launch; scatter costs
+# ~80ms per 1M rows regardless of G).
+MATMUL_AGG_MAX_GROUPS = 4096
+
+
+def _onehot(ids: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """n x G int8 one-hot of group ids. Rows whose id is outside
+    [0, num_groups) are all-zero — callers route invalid/null rows to
+    id == num_groups so they drop out of every matmul for free. XLA
+    fuses the compare into the dot; the n x G matrix never hits HBM."""
+    return (
+        ids[:, None] == jnp.arange(num_groups, dtype=ids.dtype)[None, :]
+    ).astype(jnp.int8)
+
+
+def _mm_count(ids: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """Per-group row count as an MXU matmul: ones-vector x one-hot with
+    int32 accumulation (exact for any page <= 2^31 rows)."""
+    ones = jnp.ones((1, ids.shape[0]), dtype=jnp.int8)
+    acc = jax.lax.dot_general(
+        ones, _onehot(ids, num_groups), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )[0]
+    return acc.astype(jnp.int64)
+
+
+def _mm_sum_int(
+    data: jnp.ndarray, ids: jnp.ndarray, num_groups: int
+) -> jnp.ndarray:
+    """Exact int64 per-group sum on the MXU (the scatter replacement
+    that makes hash aggregation MXU-bound instead of scatter-bound).
+
+    Decompose each value into 16 unsigned 4-bit limbs of its u64 bit
+    pattern, matmul all limbs against the one-hot in one s8xs8->s32
+    dot (per-limb group sums <= 15 * n < 2^31 for any n <= 2^27), then
+    recombine with wrapping u64 shifts — addition mod 2^64 distributes
+    over the limb decomposition, so the result equals the two's-
+    complement int64 sum exactly, negatives included."""
+    u = jax.lax.bitcast_convert_type(data.astype(jnp.int64), jnp.uint64)
+    limbs = jnp.stack(
+        [((u >> jnp.uint64(4 * k)) & jnp.uint64(0xF)).astype(jnp.int8)
+         for k in range(16)]
+    )  # (16, n)
+    acc = jax.lax.dot_general(
+        limbs, _onehot(ids, num_groups), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (16, G)
+    shifts = (jnp.uint64(1) << (jnp.uint64(4)
+                                * jnp.arange(16, dtype=jnp.uint64)))
+    total = jnp.sum(
+        acc.astype(jnp.uint64) * shifts[:, None], axis=0,
+        dtype=jnp.uint64,
+    )
+    return jax.lax.bitcast_convert_type(total, jnp.int64)
+
+
+_MM_BACKEND: Optional[bool] = None
+
+
+def _mm_backend_ok() -> bool:
+    """One-hot matmul aggregation only where the compiler fuses the
+    n x G one-hot into the dot (MXU path). XLA:CPU materializes it —
+    gigabytes at bench shapes — so CPU (tests, oracle children) keeps
+    the scatter path, which computes identical results.
+    PRESTO_TPU_MM_AGG=1/0 overrides (CPU parity tests force it on
+    tiny shapes)."""
+    global _MM_BACKEND
+    if _MM_BACKEND is None:
+        import os
+
+        v = os.environ.get("PRESTO_TPU_MM_AGG")
+        if v is not None:
+            _MM_BACKEND = v == "1"
+        else:
+            _MM_BACKEND = jax.default_backend() == "tpu"
+    return _MM_BACKEND
+
+
+def _mm_eligible(kind: str, num_groups: int, data) -> bool:
+    if num_groups > MATMUL_AGG_MAX_GROUPS or not _mm_backend_ok():
+        return False
+    if kind in (COUNT, COUNT_STAR, BOOL_OR, BOOL_AND):
+        return True
+    return kind == SUM and data is not None and jnp.issubdtype(
+        data.dtype, jnp.integer
+    )
+
+
 def _minmax_identity(dtype, is_min: bool):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(jnp.inf if is_min else -jnp.inf, dtype=dtype)
@@ -329,8 +429,11 @@ def aggregate(
     """
     ids = jnp.where(groups.row_valid, groups.group_ids, out_capacity)
     nseg = out_capacity + 1
+    mm = _mm_eligible(kind, out_capacity, data)
 
     if kind == COUNT_STAR:
+        if mm:
+            return _mm_count(ids, out_capacity), None
         ones = jnp.ones(groups.row_valid.shape, dtype=jnp.int64)
         out = jax.ops.segment_sum(ones, ids, num_segments=nseg)[:out_capacity]
         return out, None
@@ -340,21 +443,39 @@ def aggregate(
     if nulls is not None:
         contributing = contributing & ~nulls
     cids = jnp.where(contributing, groups.group_ids, out_capacity)
-    ncontrib = jax.ops.segment_sum(
-        jnp.ones(contributing.shape, dtype=jnp.int64),
-        cids,
-        num_segments=nseg,
-    )[:out_capacity]
+    if mm:
+        ncontrib = _mm_count(cids, out_capacity)
+    else:
+        ncontrib = jax.ops.segment_sum(
+            jnp.ones(contributing.shape, dtype=jnp.int64),
+            cids,
+            num_segments=nseg,
+        )[:out_capacity]
     empty = ncontrib == 0
 
     if kind == COUNT:
         return ncontrib, None
     if kind == SUM:
+        if mm:
+            out = _mm_sum_int(data, cids, out_capacity)
+            return out.astype(data.dtype), empty
         zero = jnp.zeros((), dtype=data.dtype)
         out = jax.ops.segment_sum(
             jnp.where(contributing, data, zero), cids, num_segments=nseg
         )[:out_capacity]
         return out, empty
+    if kind == BOOL_OR and mm:
+        trues = _mm_count(
+            jnp.where(data.astype(jnp.bool_), cids, out_capacity),
+            out_capacity,
+        )
+        return (trues > 0), empty
+    if kind == BOOL_AND and mm:
+        trues = _mm_count(
+            jnp.where(data.astype(jnp.bool_), cids, out_capacity),
+            out_capacity,
+        )
+        return (trues == ncontrib) & ~empty, empty
     if kind in (MIN, MAX):
         ident = _minmax_identity(data.dtype, kind == MIN)
         filled = jnp.where(contributing, data, ident)

@@ -129,8 +129,11 @@ def hash_join_match(
     pvalid = probe_valid & ~p_null_out
     phash = H.hash_columns(pcols, [None] * len(pcols))
 
-    lo = jnp.searchsorted(sorted_hash, phash, side="left")
-    hi = jnp.searchsorted(sorted_hash, phash, side="right")
+    # method="sort" lowers to a concat-sort rank computation instead
+    # of a log2(n)-iteration gather loop — measured 13x faster on TPU
+    # (64ms vs 1.06s for lo+hi at 2M x 1M; round-4 microbench)
+    lo = jnp.searchsorted(sorted_hash, phash, side="left", method="sort")
+    hi = jnp.searchsorted(sorted_hash, phash, side="right", method="sort")
     counts = (hi - lo).astype(jnp.int64)
 
     return expand_matches(
@@ -162,7 +165,7 @@ def expand_matches(
     overflow = total > out_capacity
 
     slots = jnp.arange(out_capacity, dtype=jnp.int64)
-    pid = jnp.searchsorted(cum, slots, side="right")
+    pid = jnp.searchsorted(cum, slots, side="right", method="sort")
     pid_c = jnp.clip(pid, 0, probe_cap - 1)
     prev = jnp.concatenate([jnp.zeros((1,), dtype=cum.dtype), cum[:-1]])
     off = slots - prev[pid_c]
@@ -222,8 +225,8 @@ def semi_join_mask(
     poisoned = jnp.where(bvalid, bhash, jnp.uint64(0xFFFFFFFFFFFFFFFF))
     perm = jnp.argsort(poisoned)
     sorted_hash = poisoned[perm]
-    lo = jnp.searchsorted(sorted_hash, phash, side="left")
-    hi = jnp.searchsorted(sorted_hash, phash, side="right")
+    lo = jnp.searchsorted(sorted_hash, phash, side="left", method="sort")
+    hi = jnp.searchsorted(sorted_hash, phash, side="right", method="sort")
 
     # verify within a bounded window (hash collisions beyond window are
     # astronomically unlikely; window also bounds compile size)

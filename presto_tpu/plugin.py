@@ -154,11 +154,27 @@ def _install_function(spec: ScalarFunctionSpec) -> None:
                propagate_nulls=spec.propagate_nulls)
 
 
-def install(plugin: Plugin, catalogs: Optional[Dict] = None) -> Plugin:
+def install(
+    plugin: Plugin,
+    catalogs: Optional[Dict] = None,
+    allow_access_control: bool = False,
+) -> Plugin:
     """Install a plugin into the process-wide registries; when a catalogs
     dict is passed (LocalRunner/PrestoTpuServer wiring), the plugin's
     connectors are added to it (reference: PluginManager.installPlugin +
-    ConnectorManager.createConnection)."""
+    ConnectorManager.createConnection).
+
+    A plugin contributing an AccessControl must be installed through an
+    engine that can enforce it (LocalRunner(plugins=...) /
+    PrestoTpuServer(plugins=...)); those callers pass
+    allow_access_control=True and wire it themselves. Direct install()
+    raises instead of silently dropping the contributed policy."""
+    if not allow_access_control and plugin.access_control() is not None:
+        raise ValueError(
+            "plugin contributes an AccessControl that install() cannot "
+            "enforce; install it via LocalRunner(plugins=...) or "
+            "PrestoTpuServer(plugins=...)"
+        )
     for item in plugin.scalar_functions():
         _install_function(_as_spec(item))
     for agg in plugin.aggregate_functions():

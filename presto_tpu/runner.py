@@ -50,7 +50,7 @@ class LocalRunner:
             from presto_tpu.plugin import install
 
             for p in plugins:
-                install(p, self.catalogs)
+                install(p, self.catalogs, allow_access_control=True)
                 ac = p.access_control()
                 if ac is not None:
                     if self.access_control is not ALLOW_ALL:
@@ -79,9 +79,13 @@ class LocalRunner:
         # (catalog, name) -> view SQL text (reference: ConnectorMetadata
         # createView storage; ours is engine-level, expanded at analysis)
         self.views: Dict[tuple, str] = {}
-        # prepared-statement registry (reference: Session prepared
-        # statements, PREPARE/EXECUTE/DEALLOCATE)
-        self.prepared: Dict[str, str] = {}
+        # prepared-statement registry, keyed by user so concurrent
+        # clients can neither EXECUTE nor DEALLOCATE each other's
+        # statements (reference scopes prepared statements to the
+        # Session; user is the stable key a stateless HTTP session
+        # carries across requests)
+        self.prepared: Dict[str, Dict[str, str]] = {}
+        self._ctor_page_rows = page_rows
         if mesh is None:
             self.executor = Executor(catalogs, page_rows=page_rows)
         else:
@@ -183,6 +187,15 @@ class LocalRunner:
         pj = self.session.get("pallas_join_enabled")
         ex.pallas_join = {"auto": "auto", "true": "force",
                           "false": "off"}[pj]
+        # only an EXPLICIT session override wins over the constructor's
+        # page_rows (the property default must not clobber
+        # LocalRunner(page_rows=...) users); restore the constructor
+        # value otherwise — the serial server path re-sessions one
+        # runner, and a previous session's override must not leak
+        if self.session.is_set("page_rows"):
+            ex.page_rows = int(self.session.get("page_rows"))
+        else:
+            ex.page_rows = self._ctor_page_rows
 
     def estimate_memory(self, sql: str) -> int:
         """Crude peak-HBM estimate for admission control (reference:
@@ -263,16 +276,22 @@ class LocalRunner:
                 raise ValueError(f"view not found: {name}")
             return QueryResult([], [], update_type="DROP VIEW")
         if isinstance(stmt, N.Prepare):
-            self.prepared[stmt.name] = stmt.statement_sql
+            # validate now so a bad statement fails at PREPARE, not at
+            # first EXECUTE (and so the text passed the execute-query
+            # access check above as part of the PREPARE statement)
+            parse(stmt.statement_sql)
+            mine = self.prepared.setdefault(self.session.user, {})
+            mine[stmt.name] = stmt.statement_sql
             return QueryResult([], [], update_type="PREPARE")
         if isinstance(stmt, N.Deallocate):
-            if self.prepared.pop(stmt.name, None) is None:
+            mine = self.prepared.get(self.session.user, {})
+            if mine.pop(stmt.name, None) is None:
                 raise ValueError(
                     f"prepared statement not found: {stmt.name}"
                 )
             return QueryResult([], [], update_type="DEALLOCATE")
         if isinstance(stmt, N.ExecutePrepared):
-            text = self.prepared.get(stmt.name)
+            text = self.prepared.get(self.session.user, {}).get(stmt.name)
             if text is None:
                 raise ValueError(
                     f"prepared statement not found: {stmt.name}"

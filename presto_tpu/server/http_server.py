@@ -540,9 +540,10 @@ class PrestoTpuServer:
                 return self._runner
             # concurrent path: per-query runner/executor so query state
             # (overflow flags, capacity boosts, stream caches) never
-            # crosses queries; compiled kernels, views, and prepared
-            # statements are server-wide (reference: views live in
-            # connector metadata; prepared statements in the session)
+            # crosses queries; compiled kernels and views are server-
+            # wide (reference: views live in connector metadata); the
+            # prepared registry is shared but keyed per user inside
+            # LocalRunner, mirroring the reference's session scoping
             r = LocalRunner(
                 self.catalogs, default_catalog=self._default_catalog,
                 page_rows=self._page_rows, mesh=self._mesh,

@@ -31,7 +31,10 @@ def _parse_value(prop: PropertyMetadata, value: Any) -> Any:
         # casing ("SET SESSION x = TRUE" arrives as a string either way)
         if isinstance(value, bool):
             return "true" if value else "false"
-        return str(value).strip().lower()
+        value = str(value).strip()
+        # normalize case only for enum-domain properties (those with a
+        # validator); free-form string values keep their casing
+        return value.lower() if prop.validate is not None else value
     if isinstance(value, str) and prop.type is bool:
         low = value.strip().lower()
         if low in ("true", "1", "on"):
@@ -166,6 +169,13 @@ class Session:
         if prop is None:
             raise KeyError(f"unknown session property: {name}")
         return self._values.get(name, prop.default)
+
+    def is_set(self, name: str) -> bool:
+        """True when the property was explicitly set (SET SESSION /
+        header / set()) rather than defaulting — consumers that must
+        distinguish an override from the default (e.g. page_rows vs a
+        constructor argument) check this, never _values directly."""
+        return name in self._values
 
     def rows(self) -> List[tuple]:
         """SHOW SESSION rows: (name, value, default, type, description)."""

@@ -120,6 +120,10 @@ class Case(Node):
 class Cast(Node):
     value: Node
     type_name: str
+    # TRY_CAST(x AS t): parse failures yield NULL (reference:
+    # TryCastFunction). Plain CAST also NULLs unparsable varchar under
+    # the masked-eval policy — `safe` keeps the surface distinction.
+    safe: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -129,6 +133,15 @@ class FunctionCall(Node):
     distinct: bool = False
     is_star: bool = False  # count(*)
     window: Optional["WindowSpec"] = None  # fn(...) OVER (...)
+
+
+@dataclasses.dataclass(frozen=True)
+class Lambda(Node):
+    """x -> expr / (x, y) -> expr argument to a higher-order function
+    (reference: sql/tree/LambdaExpression)."""
+
+    params: Tuple[str, ...]
+    body: Node
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,7 +23,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<dquoted>"(?:[^"]|"")*")
   | (?P<name>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;?\[\]])
+  | (?P<op><>|!=|>=|<=|->|\|\||[=<>+\-*/%(),.;?\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -773,11 +773,52 @@ class Parser:
             return "double"
         return name
 
+    def _parse_call_arg(self) -> N.Node:
+        """One function-call argument; detects lambda syntax
+        `x -> body` / `(x, y) -> body` (reference:
+        sql/tree/LambdaExpression for higher-order functions)."""
+        t = self.peek()
+        if (t.kind == "name" and self.peek(1).kind == "op"
+                and self.peek(1).value == "->"):
+            p = self.next().value
+            self.next()  # ->
+            return N.Lambda((p,), self.parse_expr())
+        if t.kind == "op" and t.value == "(":
+            j, params = 1, []
+            is_lambda = False
+            while True:
+                tk = self.peek(j)
+                if tk.kind != "name":
+                    break
+                params.append(tk.value)
+                nxt = self.peek(j + 1)
+                if nxt.kind == "op" and nxt.value == ",":
+                    j += 2
+                    continue
+                if nxt.kind == "op" and nxt.value == ")":
+                    after = self.peek(j + 2)
+                    is_lambda = after.kind == "op" and after.value == "->"
+                break
+            if is_lambda and params:
+                for _ in range(2 * len(params) + 2):
+                    self.next()  # ( p1 , ... pN ) ->
+                return N.Lambda(tuple(params), self.parse_expr())
+        return self.parse_expr()
+
     def parse_name_expr(self) -> N.Node:
         t = self.next()
         if t.kind not in ("name", "keyword"):
             raise SqlSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
         name = t.value
+        # TRY_CAST(e AS type) shares CAST's special syntax
+        if (name == "try_cast" and self.peek().kind == "op"
+                and self.peek().value == "("):
+            self.next()
+            e = self.parse_expr()
+            self.expect_keyword("as")
+            type_name = self._parse_type_name()
+            self.expect_op(")")
+            return N.Cast(e, type_name, safe=True)
         # function call?
         if self.peek().kind == "op" and self.peek().value == "(":
             self.next()
@@ -793,9 +834,9 @@ class Parser:
                     distinct = True
                 else:
                     self.accept_keyword("all")
-                args.append(self.parse_expr())
+                args.append(self._parse_call_arg())
                 while self.accept_op(","):
-                    args.append(self.parse_expr())
+                    args.append(self._parse_call_arg())
             self.expect_op(")")
             return self._maybe_over(
                 N.FunctionCall(name, tuple(args), distinct=distinct)

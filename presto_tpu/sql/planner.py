@@ -1773,6 +1773,9 @@ class ExprTranslator:
         self.agg_subst = agg_subst or {}
         self.group_subst = group_subst or {}
         self.agg_fields = agg_fields
+        # innermost-first stack of lambda parameter scopes
+        # ({name: ir.ParamRef}); see _tr_higher_order
+        self._lambda_scopes: List[dict] = []
 
     def translate(self, e: N.Node, root: bool = False) -> ir.RowExpression:
         out = self._tr(e, root)
@@ -1795,6 +1798,17 @@ class ExprTranslator:
                 return probe
 
         if isinstance(e, N.Identifier):
+            if self._lambda_scopes and len(e.parts) == 1:
+                for frame in reversed(self._lambda_scopes):
+                    ref = frame.get(e.parts[0])
+                    if ref is not None:
+                        return ref
+            if self._lambda_scopes:
+                raise PlanningError(
+                    f"lambda bodies cannot capture columns "
+                    f"({'.'.join(e.parts)}); only lambda parameters "
+                    f"and constants are allowed"
+                )
             lvl, ch, f = self.scope.resolve(e)
             if lvl == 0:
                 if self.group_subst is not None and self.agg_fields:
@@ -1866,7 +1880,10 @@ class ExprTranslator:
         if isinstance(e, N.Case):
             return self._tr_case(e)
         if isinstance(e, N.Cast):
-            return ir.cast(self._tr(e.value), T.parse_type(e.type_name))
+            to = T.parse_type(e.type_name)
+            if e.safe:
+                return ir.Call("try_cast", (self._tr(e.value),), to)
+            return ir.cast(self._tr(e.value), to)
         if isinstance(e, N.Extract):
             return ir.call(e.field.lower(), self._tr(e.value))
         if isinstance(e, N.FunctionCall):
@@ -1889,6 +1906,8 @@ class ExprTranslator:
                 if len(args) == 2:
                     args.append(ir.Constant(None, args[1].type))
                 return ir.if_(*args)
+            if any(isinstance(a, N.Lambda) for a in e.args):
+                return self._tr_higher_order(e)
             return ir.call(e.name, *[self._tr(a) for a in e.args])
         if isinstance(e, N.ScalarSubquery):
             return self.planner.execute_scalar(e.query)
@@ -1898,6 +1917,60 @@ class ExprTranslator:
                 f"EXECUTE <name> USING <values>"
             )
         raise PlanningError(f"unsupported expression: {type(e).__name__}")
+
+    def _tr_higher_order(self, e: N.FunctionCall) -> ir.RowExpression:
+        """Higher-order function call: non-lambda args translate
+        normally; lambda parameters bind to the collection's element
+        type(s) (reference: ExpressionAnalyzer's lambda type
+        inference against the function signature)."""
+        first = self._tr(e.args[0])
+        t0 = first.type
+        if isinstance(t0, T.ArrayType):
+            param_types = [t0.element]
+        elif isinstance(t0, T.MapType):
+            param_types = [t0.key, t0.value]
+        else:
+            raise PlanningError(
+                f"{e.name}: first argument must be an array or map, "
+                f"got {t0}"
+            )
+        out_args: List[ir.RowExpression] = [first]
+        for pos, a in enumerate(e.args[1:], start=1):
+            if not isinstance(a, N.Lambda):
+                out_args.append(self._tr(a))
+                continue
+            if e.name == "reduce":
+                # combine is (state, element) -> state; the optional
+                # output lambda is state -> result; the state type
+                # comes from the (already translated) initial value
+                state_t = (out_args[1].type if len(out_args) > 1
+                           else T.UNKNOWN)
+                want = ([state_t, param_types[0]] if pos == 2
+                        else [state_t])
+            elif (e.name == "transform_values"
+                    and len(a.params) == 1):
+                want = [param_types[1]]  # v -> ... binds the value
+            else:
+                want = (param_types if len(a.params) == len(param_types)
+                        else param_types[: len(a.params)])
+            if len(a.params) != len(want):
+                raise PlanningError(
+                    f"{e.name}: lambda takes {len(a.params)} "
+                    f"parameters, expected {len(want)}"
+                )
+            frame = {
+                p: ir.ParamRef(i, t)
+                for i, (p, t) in enumerate(zip(a.params, want))
+            }
+            self._lambda_scopes.append(frame)
+            try:
+                body = self._tr(a.body)
+            finally:
+                self._lambda_scopes.pop()
+            out_args.append(
+                ir.Lambda(len(a.params), body, body.type)
+            )
+        return ir.call(e.name, *out_args)
 
     def _group_probe(self, e: N.Node) -> Optional[ir.RowExpression]:
         """If e translates (in the pre-agg scope) to a group expression,

@@ -235,3 +235,52 @@ class TestHashedGroupby:
         b = A.compute_groups_hashed([k], [None], valid, 64)
         assert np.array_equal(np.asarray(a.group_ids), np.asarray(b.group_ids))
         assert np.array_equal(np.asarray(a.rep_index), np.asarray(b.rep_index))
+
+
+def test_matmul_agg_parity(monkeypatch):
+    # force the one-hot matmul path on tiny CPU shapes and compare
+    # against the scatter path (identical exact semantics required)
+    import importlib
+
+    import numpy as np
+
+    from presto_tpu.ops import agg as A
+
+    rng = np.random.default_rng(7)
+    n, G = 512, 37
+    gids = jnp.asarray(rng.integers(0, G, n))
+    valid = jnp.asarray(rng.random(n) < 0.9)
+    data = jnp.asarray(
+        rng.integers(-(2**40), 2**40, n).astype(np.int64))
+    nulls = jnp.asarray(rng.random(n) < 0.2)
+    groups = A.GroupbyResult(
+        group_ids=gids.astype(jnp.int64), row_valid=valid,
+        rep_index=jnp.zeros((G,), jnp.int64),
+        group_valid=jnp.ones((G,), bool),
+        num_groups=jnp.asarray(G), overflow=jnp.asarray(False),
+    )
+    results = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("PRESTO_TPU_MM_AGG", flag)
+        A._MM_BACKEND = None
+        out = {}
+        for kind in (A.SUM, A.COUNT, A.COUNT_STAR):
+            vals, onulls = A.aggregate(
+                groups, kind, G,
+                None if kind == A.COUNT_STAR else data,
+                None if kind == A.COUNT_STAR else nulls,
+            )
+            out[kind] = (np.asarray(vals),
+                         None if onulls is None else np.asarray(onulls))
+        bd = jnp.asarray(rng.random(n) < 0.5)
+        for kind in (A.BOOL_OR, A.BOOL_AND):
+            vals, onulls = A.aggregate(groups, kind, G, bd, nulls)
+            out[kind] = (np.asarray(vals), np.asarray(onulls))
+        results[flag] = out
+    A._MM_BACKEND = None
+    for kind in results["0"]:
+        v0, n0 = results["0"][kind]
+        v1, n1 = results["1"][kind]
+        assert (v0 == v1).all(), kind
+        if n0 is not None:
+            assert (n0 == n1).all(), kind

@@ -279,3 +279,17 @@ def test_type_plugin_cannot_shadow_builtin():
 
     with _pytest.raises(ValueError):
         LocalRunner({"tpch": TpchConnector(0.01)}, plugins=[_Shadow()])
+
+
+def test_install_rejects_unwired_access_control():
+    # ADVICE r3: install() must not silently drop a contributed
+    # AccessControl — only engine entry points can enforce one
+    from presto_tpu.plugin import Plugin, install
+    from presto_tpu.security import AccessControl
+
+    class ACPlugin(Plugin):
+        def access_control(self):
+            return AccessControl()
+
+    with pytest.raises(ValueError):
+        install(ACPlugin())

@@ -121,3 +121,24 @@ def test_execute_missing_or_unbound(runner):
     with pytest.raises(Exception):
         runner.execute("execute p2")  # parameter not bound
     assert runner.execute("execute p2 using 41").rows == [(42,)]
+
+
+def test_prepared_statements_scoped_per_user(runner):
+    # ADVICE r3: one user must not see / EXECUTE / DEALLOCATE another
+    # user's prepared statements (reference scopes them per session)
+    runner.session.user = "alice"
+    runner.execute("prepare mine from select 1")
+    runner.session.user = "bob"
+    with pytest.raises(Exception):
+        runner.execute("execute mine")
+    with pytest.raises(Exception):
+        runner.execute("deallocate prepare mine")
+    runner.execute("prepare mine from select 2")  # no name collision
+    assert runner.execute("execute mine").rows == [(2,)]
+    runner.session.user = "alice"
+    assert runner.execute("execute mine").rows == [(1,)]
+
+
+def test_prepare_validates_statement(runner):
+    with pytest.raises(Exception):
+        runner.execute("prepare bad from select from from")

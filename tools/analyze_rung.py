@@ -1,0 +1,43 @@
+"""Honest per-node breakdown of one bench rung on the real chip:
+EXPLAIN ANALYZE with the executor's stats_drain mode, which drains the
+axon execution queue after every page so per-node wall times are device
+time, not dispatch time (see bench.py docstring for the timing model).
+
+Usage: analyze_rung.py {tpch|tpcds} QID SF [k=v session props...]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from tools._common import configure_jax, make_runner, queries  # noqa: E402
+
+
+def main() -> int:
+    suite, qid, sf = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+    configure_jax()
+    runner = make_runner(suite, sf, props=sys.argv[4:])
+    sql = queries(suite)[qid]
+    plan = runner.plan(sql)
+    ex = runner.executor
+    # warm compile + first-flush out of the way (un-timed)
+    t0 = time.time()
+    ex.execute(plan)
+    print(f"# warm run (compile + flush): {time.time() - t0:.1f}s",
+          file=sys.stderr)
+    ex.stats_drain = True
+    t0 = time.time()
+    _names, _rows, stats = ex.execute_with_stats(plan)
+    total = time.time() - t0
+    from presto_tpu.runner import explain_text
+
+    print(explain_text(plan, stats=stats))
+    print(f"# analyzed wall (incl. per-page drain overhead): {total:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
