@@ -42,6 +42,12 @@ class StateCol:
     pre: Optional[str] = None  # None | 'hi32' | 'lo32'
 
 
+# collect-state aggregate markers (handled by the executor's collect
+# branches against ops/collect.py, never by ops/agg.aggregate)
+COLLECT = "collect"
+COLLECT_FNS = frozenset({"array_agg", "map_agg", "approx_percentile"})
+
+
 def state_layout(function: str, in_type: Optional[T.SqlType]) -> List[StateCol]:
     """State columns for an aggregate over an input type (reference analog:
     the generated GroupedAccumulator field layout)."""
@@ -86,6 +92,40 @@ def state_layout(function: str, in_type: Optional[T.SqlType]) -> List[StateCol]:
         # kernels (exec/executor.py) against ops/hll.py. Reference:
         # operator/aggregation/ApproximateCountDistinctAggregation.
         return [StateCol("hll", A.HLL_INSERT, A.HLL_MERGE, T.HLL_STATE)]
+    if function == "approx_percentile":
+        # [cap, K] collected-value matrix + used-slot count;
+        # insert/merge special-cased in the executor kernels against
+        # ops/collect.py (reference: ApproximatePercentileAggregations;
+        # ours is EXACT within the array_agg_max_elements bound).
+        return [
+            StateCol("vals", COLLECT, COLLECT, T.CollectStateType(
+                in_type if in_type is not None else T.UNKNOWN)),
+            StateCol("count", A.COUNT, A.SUM, T.BIGINT),
+        ]
+    if function == "array_agg":
+        # value matrix + element-null-flag matrix + used-slot count
+        # (reference: ArrayAggregationFunction — null elements are
+        # INCLUDED in the collected array)
+        return [
+            StateCol("vals", COLLECT, COLLECT, T.CollectStateType(
+                in_type if in_type is not None else T.UNKNOWN)),
+            StateCol("vnulls", COLLECT, COLLECT,
+                     T.CollectStateType(T.UNKNOWN)),
+            StateCol("count", A.COUNT, A.SUM, T.BIGINT),
+        ]
+    if function == "map_agg":
+        # collected keys + values + value-null flags + count
+        # (reference: MapAggregationFunction's KeyValuePairsState —
+        # null keys skipped, null values preserved)
+        return [
+            StateCol("kvals", COLLECT, COLLECT, T.CollectStateType(
+                in_type if in_type is not None else T.UNKNOWN)),
+            StateCol("vvals", COLLECT, COLLECT,
+                     T.CollectStateType(T.UNKNOWN)),
+            StateCol("vnulls", COLLECT, COLLECT,
+                     T.CollectStateType(T.UNKNOWN)),
+            StateCol("count", A.COUNT, A.SUM, T.BIGINT),
+        ]
     if function in _PLUGIN_AGGS:
         return list(_PLUGIN_AGGS[function].state)
     raise ValueError(f"unknown aggregate function: {function}")
@@ -127,12 +167,26 @@ def is_plugin_aggregate(name: str) -> bool:
     return name in _PLUGIN_AGGS
 
 
-def result_type(function: str, in_type: Optional[T.SqlType]) -> T.SqlType:
+def result_type(
+    function: str,
+    in_type: Optional[T.SqlType],
+    extra: tuple = (),
+) -> T.SqlType:
     """Reference: FunctionRegistry aggregate signatures — sum(bigint)->
     bigint, sum(decimal(p,s))->decimal(38,s), avg(decimal(p,s))->
-    decimal(p,s), count->bigint."""
+    decimal(p,s), count->bigint. ``extra`` carries additional input
+    types (map_agg's value column)."""
     if function in ("count", "count_star"):
         return T.BIGINT
+    if function == "array_agg":
+        return T.ArrayType(in_type if in_type is not None else T.UNKNOWN)
+    if function == "map_agg":
+        return T.MapType(
+            in_type if in_type is not None else T.UNKNOWN,
+            extra[0] if extra else T.UNKNOWN,
+        )
+    if function == "approx_percentile":
+        return in_type
     if function in ("min", "max", "any"):
         return in_type
     if function in ("bool_or", "bool_and"):

@@ -215,6 +215,9 @@ class Executor:
         # (SURVEY §8.2.1's compiled-branch escape, moved to query scope).
         self._pending_overflow: List[jnp.ndarray] = []
         self._capacity_boost = 1
+        # per-group slot bound for collect-state aggregates (array_agg/
+        # map_agg/approx_percentile); session array_agg_max_elements
+        self.collect_k = 1024
         self._collect_stats = None  # id(node) -> NodeStats when ANALYZE
         # EXPLAIN ANALYZE wall honesty on axon: drain the device queue
         # after every page so per-node wall_s is real device time (costs
@@ -308,13 +311,19 @@ class Executor:
                 for spec in node.aggregates:
                     in_t = (None if spec.channel is None
                             else src[spec.channel])
-                    out.append(S.result_type(spec.function, in_t))
+                    out.append(S.result_type(
+                        spec.function, in_t,
+                        tuple(src[c] for c in spec.extra_channels),
+                    ))
                 return out
             src = self.output_types(node.source)
             out = [src[c] for c in node.group_channels]
             for spec in node.aggregates:
                 in_t = None if spec.channel is None else src[spec.channel]
-                out.append(S.result_type(spec.function, in_t))
+                out.append(S.result_type(
+                    spec.function, in_t,
+                    tuple(src[c] for c in spec.extra_channels),
+                ))
             return out
         if isinstance(node, P.Exchange):
             return self.output_types(node.source)
@@ -701,6 +710,23 @@ class Executor:
             )
         return src
 
+    @property
+    def _collect_k_eff(self) -> int:
+        """Collect-state slots per group for this attempt: the session
+        bound scaled by the overflow-retry boost, so a group exceeding
+        array_agg_max_elements lands on the same boosted-retry ladder
+        as every other capacity (SURVEY §8.2.1)."""
+        return self.collect_k * self._capacity_boost
+
+    def _agg_extra_types(self, node: P.Aggregation):
+        """Per-aggregate extra input types (map_agg's value column),
+        resolved against the aggregation's source schema."""
+        src = self.output_types(node.source)
+        return tuple(
+            tuple(src[c] for c in spec.extra_channels)
+            for spec in node.aggregates
+        )
+
     def _exec_agg_partial(self, node: P.Aggregation) -> Iterator[Page]:
         """Partial step only: one state page per input page (reference:
         AggregationNode.Step.PARTIAL before the exchange)."""
@@ -723,10 +749,11 @@ class Executor:
         cap = _next_pow2(node.capacity * self._capacity_boost)
         max_iters = 64 * self._capacity_boost
         fn = self._jit(
-            ("agg_partial", node),
+            ("agg_partial", node, self._collect_k_eff),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
-                tuple(tuple(l) for l in layouts)
+                tuple(tuple(l) for l in layouts),
+                collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
         )
@@ -749,7 +776,8 @@ class Executor:
         if not node.group_channels:
             merged = (
                 concat_all(pages) if pages
-                else _empty_state_page(node.aggregates, layouts)
+                else _empty_state_page(node.aggregates, layouts,
+                                      collect_k=self._collect_k_eff)
             )
             fn = self._jit(
                 ("gagg_final", node),
@@ -764,10 +792,12 @@ class Executor:
             return
         merged = concat_all(pages) if len(pages) > 1 else pages[0]
         fn = self._jit(
-            ("agg_final", node),
+            ("agg_final", node, self._collect_k_eff),
             functools.partial(
                 _final_agg_page, node.group_channels, node.aggregates,
-                tuple(tuple(l) for l in layouts), tuple(in_types)
+                tuple(tuple(l) for l in layouts), tuple(in_types),
+                collect_k=self._collect_k_eff,
+                extra_types=self._agg_extra_types(origin),
             ),
             static_argnums=(1, 2),
         )
@@ -826,10 +856,11 @@ class Executor:
         # min(..., page.capacity) below bounds each launch
         cap = _next_pow2(node.capacity * self._capacity_boost)
         partial_fn = self._jit(
-            ("agg_partial", node),
+            ("agg_partial", node, self._collect_k_eff),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
-                tuple(tuple(l) for l in layouts)
+                tuple(tuple(l) for l in layouts),
+                collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
         )
@@ -848,11 +879,12 @@ class Executor:
         # spill is on, onto partitioned passes).
         fold_cap = min(cap, _next_pow2((1 << 20) * self._capacity_boost))
         merge_fn = self._jit(
-            ("agg_merge", node),
+            ("agg_merge", node, self._collect_k_eff),
             functools.partial(
                 _merge_partials_page, node.aggregates,
                 tuple(tuple(l) for l in layouts),
-                len(node.group_channels)
+                len(node.group_channels),
+                collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
         )
@@ -869,10 +901,12 @@ class Executor:
         if merged is None:
             return
         final_fn = self._jit(
-            ("agg_final", node),
+            ("agg_final", node, self._collect_k_eff),
             functools.partial(
                 _final_agg_page, node.group_channels, node.aggregates,
-                tuple(tuple(l) for l in layouts), tuple(in_types)
+                tuple(tuple(l) for l in layouts), tuple(in_types),
+                collect_k=self._collect_k_eff,
+                extra_types=self._agg_extra_types(node),
             ),
             static_argnums=(1, 2),
         )
@@ -924,27 +958,31 @@ class Executor:
         pcap = _next_pow2(max(cap // parts * 2, 1024))
         max_iters = 64 * self._capacity_boost
         partial_fn = self._jit(
-            ("agg_partial", node),
+            ("agg_partial", node, self._collect_k_eff),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
-                tuple(tuple(l) for l in layouts)
+                tuple(tuple(l) for l in layouts),
+                collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
         )
         final_fn = self._jit(
-            ("agg_final", node),
+            ("agg_final", node, self._collect_k_eff),
             functools.partial(
                 _final_agg_page, node.group_channels, node.aggregates,
-                tuple(tuple(l) for l in layouts), tuple(in_types)
+                tuple(tuple(l) for l in layouts), tuple(in_types),
+                collect_k=self._collect_k_eff,
+                extra_types=self._agg_extra_types(node),
             ),
             static_argnums=(1, 2),
         )
         nkeys = len(node.group_channels)
         merge_fn = self._jit(
-            ("agg_merge", node),
+            ("agg_merge", node, self._collect_k_eff),
             functools.partial(
                 _merge_partials_page, node.aggregates,
-                tuple(tuple(l) for l in layouts), nkeys
+                tuple(tuple(l) for l in layouts), nkeys,
+                collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
         )
@@ -989,26 +1027,30 @@ class Executor:
         pcap = _next_pow2(max(cap // parts * 2, 1024))
         max_iters = 64 * self._capacity_boost
         partial_fn = self._jit(
-            ("agg_partial", node),
+            ("agg_partial", node, self._collect_k_eff),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
-                tuple(tuple(l) for l in layouts)
+                tuple(tuple(l) for l in layouts),
+                collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
         )
         merge_fn = self._jit(
-            ("agg_merge", node),
+            ("agg_merge", node, self._collect_k_eff),
             functools.partial(
                 _merge_partials_page, node.aggregates,
-                tuple(tuple(l) for l in layouts), nkeys
+                tuple(tuple(l) for l in layouts), nkeys,
+                collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
         )
         final_fn = self._jit(
-            ("agg_final", node),
+            ("agg_final", node, self._collect_k_eff),
             functools.partial(
                 _final_agg_page, node.group_channels, node.aggregates,
-                tuple(tuple(l) for l in layouts), tuple(in_types)
+                tuple(tuple(l) for l in layouts), tuple(in_types),
+                collect_k=self._collect_k_eff,
+                extra_types=self._agg_extra_types(node),
             ),
             static_argnums=(1, 2),
         )
@@ -1053,7 +1095,8 @@ class Executor:
         partials = [partial_fn(p) for p in self.pages(node.source)]
         if not partials:
             partials = [
-                _empty_state_page(node.aggregates, layouts)
+                _empty_state_page(node.aggregates, layouts,
+                                      collect_k=self._collect_k_eff)
             ]
         merged = concat_all(partials) if len(partials) > 1 else partials[0]
         final_fn = self._jit(
@@ -1447,6 +1490,19 @@ class Executor:
                 ),
                 static_argnums=(3,),
             )
+        elif (unique_build and node.join_type in ("inner", "left")
+                and self._capacity_boost == 1):
+            # FK fast path: no expansion; a u64 hash collision between
+            # distinct unique keys flags overflow and the boosted retry
+            # takes the general expansion below
+            probe_fn = self._jit(
+                ("join_probe_unique", node, build.capacity),
+                functools.partial(
+                    _probe_join_page_unique, node.left_keys,
+                    node.right_keys, node.join_type
+                ),
+                static_argnums=(3,),
+            )
         else:
             probe_fn = self._jit(
                 ("join_probe", node, build.capacity),
@@ -1647,14 +1703,19 @@ def _group_ids(group_channels, page: Page, cap: int, max_iters: int = 64):
                 sizes=tuple(sizes),
             )
     key_cols, key_nulls = K.block_key_columns(key_blocks)
-    if page.valid.shape[0] >= (1 << 22):
-        # the vectorized-probing while_loop kernel faults the XLA:TPU
-        # runtime at >= ~4M rows (observed on v5e regardless of table
-        # size or chunking); large inputs take the packed-argsort path,
-        # which is slower but correct at any scale
+    if cap > A.MATMUL_AGG_MAX_GROUPS or page.valid.shape[0] >= (1 << 22):
+        # High-cardinality group-bys take the packed-argsort path: its
+        # sorted layout lets aggregate() run scatter-free (gather +
+        # cumsum + boundary diffs — round-4: the hashed while_loop's
+        # per-iteration scatters made Q3 SF1's aggregation 42s of a
+        # 91s query). Also mandatory >= ~4M rows, where the
+        # vectorized-probing while_loop kernel faults the XLA:TPU
+        # runtime (observed on v5e regardless of table size).
         return A.compute_groups_sorted(
             key_cols, key_nulls, page.valid, cap
         )
+    # small capacities: the probing hash table is cheap and its input-
+    # order group ids feed the MXU one-hot matmul aggregation directly
     return A.compute_groups_hashed(
         key_cols, key_nulls, page.valid, cap, max_iters=max_iters
     )
@@ -1776,14 +1837,209 @@ def _agg_keys_page(src: Page, group_channels, groups) -> Page:
     )
 
 
+def _collect_encode(blk: Block):
+    """Encode a block's values into int64 collect slots (ints/dates/
+    bools/short decimals directly, dictionary codes as-is — the
+    dictionary rides the state Block).
+
+    Floats use an arithmetic sign/exponent/mantissa pack built from
+    log2/exp2/floor only: the axon TPU toolchain compiles NEITHER
+    64-bit bitcast_convert_type NOR frexp/ldexp (probed round 4 —
+    compiler SIGSEGV / unimplemented X64 rewrite), and its emulated
+    float64 is range-limited (~1e38, f32-pair emulation), so the
+    exponent fits comfortably in the 11-bit field. The pack is
+    ORDER-PRESERVING (int64 order == float order), which is why
+    approx_percentile needs no float special case. Values round-trip
+    at full device precision; NaN encodes as +max (documented)."""
+    data = blk.data
+    if isinstance(data, tuple):
+        raise NotImplementedError(
+            "array_agg/map_agg over long-decimal (p>18) inputs is not "
+            "supported"
+        )
+    if data.dtype in (jnp.float64, jnp.float32):
+        x = data.astype(jnp.float64)
+        ax = jnp.abs(x)
+        safe = jnp.where(ax > 0, ax, 1.0)
+        e = jnp.floor(jnp.log2(safe))
+        # power-of-two scaling is exact; two correction steps absorb
+        # any log2 boundary imprecision
+        m = safe * jnp.exp2(-e - 1.0)
+        for _ in range(2):
+            hi = m >= 1.0
+            lo = m < 0.5
+            e = e + jnp.where(hi, 1.0, 0.0) - jnp.where(lo, 1.0, 0.0)
+            m = jnp.where(hi, m * 0.5, jnp.where(lo, m * 2.0, m))
+        frac = jnp.clip(
+            ((m - 0.5) * float(2**53)).astype(jnp.int64),
+            0, (1 << 52) - 1,
+        )
+        e_adj = jnp.clip(e.astype(jnp.int64) + 1100, 0, 2047)
+        mag = (e_adj << jnp.int64(52)) | frac
+        enc = jnp.where(
+            ax == 0, jnp.int64(0), jnp.where(x < 0, -mag, mag)
+        )
+        return jnp.where(
+            jnp.isnan(x), jnp.iinfo(jnp.int64).max, enc
+        )
+    return data.astype(jnp.int64)
+
+
+def _collect_float_decode_device(enc: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of the float pack, on device (bitcast/ldexp-free):
+    value = sign * 2^(e+1) * (0.5 + frac * 2^-53)."""
+    mag = jnp.abs(enc)
+    e = ((mag >> jnp.int64(52)) - jnp.int64(1100)).astype(jnp.float64)
+    frac = (mag & jnp.int64((1 << 52) - 1)).astype(jnp.float64)
+    m = 0.5 + frac * float(2.0**-53)
+    val = m * jnp.exp2(e + 1.0)
+    val = jnp.where(enc < 0, -val, val)
+    return jnp.where(enc == 0, 0.0, val)
+
+
+def _collect_partial_blocks(spec, layout, page, groups, out_cap,
+                            collect_k):
+    """Partial-step collect state. Null semantics per the reference:
+    array_agg INCLUDES null elements (a parallel null-flag matrix rides
+    the state); map_agg skips null KEYS but preserves null values;
+    approx_percentile ignores nulls. A per-aggregate DISTINCT mask
+    always excludes unmarked rows."""
+    from presto_tpu.ops import collect as C
+
+    blk = page.block(spec.channel)
+    mask = None if spec.mask is None else page.block(spec.mask).data
+    contributing = groups.row_valid
+    if mask is not None:
+        contributing = contributing & mask
+    fn = spec.function
+    if fn == "map_agg":
+        if blk.nulls is not None:  # null keys are skipped
+            contributing = contributing & ~blk.nulls
+        vblk = page.block(spec.extra_channels[0])
+        if vblk.dictionary is not None:
+            raise NotImplementedError(
+                "map_agg with dictionary-coded (varchar/complex) VALUE "
+                "columns is not supported yet; keys may be any type"
+            )
+        sources = [
+            (blk, None),
+            (vblk, None),
+            (None, vblk.nulls),  # value null flags
+        ]
+    elif fn == "approx_percentile":
+        if blk.nulls is not None:  # percentile ignores nulls
+            contributing = contributing & ~blk.nulls
+        sources = [(blk, None)]
+    else:  # array_agg: null elements included
+        sources = [(blk, None), (None, blk.nulls)]
+    blocks: List[Block] = []
+    overflow = jnp.zeros((), dtype=jnp.bool_)
+    for (vb, null_src), st in zip(sources, layout):
+        if vb is not None:
+            enc = _collect_encode(vb)
+            dic = vb.dictionary
+        else:
+            enc = (null_src.astype(jnp.int64) if null_src is not None
+                   else jnp.zeros(page.capacity, dtype=jnp.int64))
+            dic = None
+        vals, ovf = C.insert(
+            groups.group_ids, contributing, out_cap, enc, collect_k
+        )
+        overflow = overflow | ovf
+        blocks.append(Block(data=vals, type=st.type, nulls=None,
+                            dictionary=dic))
+    cnt, _ = A.aggregate(
+        groups, A.COUNT, out_cap,
+        jnp.zeros(page.capacity, dtype=jnp.int64),
+        ~contributing,
+    )
+    blocks.append(Block(data=cnt, type=T.BIGINT, nulls=None))
+    return blocks, overflow
+
+
+def _collect_merge_blocks(spec, layout, merged, groups, out_cap, ch,
+                          collect_k):
+    """Merge partial collect states (grouped by output key): per
+    collected column, concatenate member rows' slot vectors in row
+    order; the count column segment-sums."""
+    from presto_tpu.ops import collect as C
+
+    n_collect = len(layout) - 1
+    cnt_blk = merged.block(ch + n_collect)
+    counts = cnt_blk.data
+    blocks: List[Block] = []
+    overflow = jnp.zeros((), dtype=jnp.bool_)
+    for i in range(n_collect):
+        blk = merged.block(ch + i)
+        vals, ovf = C.merge(
+            groups.group_ids, groups.row_valid, out_cap,
+            blk.data, counts, collect_k,
+        )
+        overflow = overflow | ovf
+        blocks.append(Block(data=vals, type=layout[i].type, nulls=None,
+                            dictionary=blk.dictionary))
+    ncnt, _ = A.aggregate(groups, A.SUM, out_cap, counts, None)
+    blocks.append(Block(data=ncnt, type=T.BIGINT, nulls=None))
+    return blocks, overflow
+
+
+def _collect_finalize_block(spec, in_t, extra_t, state_blocks) -> Block:
+    """Merged collect state -> the SQL result Block. The result Block
+    carries TUPLE data ((vals2d, nulls2d, counts) for arrays; (k2d,
+    v2d, vnulls2d, counts) for maps) decoded host-side at the client
+    boundary (page.to_pylist) — collect results cannot feed further
+    device expressions (documented divergence; reference arrays are
+    first-class)."""
+    from presto_tpu.ops import collect as C
+
+    if spec.function == "approx_percentile":
+        vals_blk, cnt_blk = state_blocks
+        frac = float(spec.params[0]) if spec.params else 0.5
+        # the float slot-encoding is order-preserving, so one int64
+        # sort serves every element type
+        picked = C.percentile_select(
+            vals_blk.data, cnt_blk.data, frac,
+            vals_blk.data.shape[1],
+        )
+        if T.is_floating(in_t):
+            data = _collect_float_decode_device(picked).astype(
+                np.dtype(in_t.numpy_dtype))
+        else:
+            data = picked.astype(np.dtype(in_t.numpy_dtype))
+        return Block(data=data, type=in_t, nulls=cnt_blk.data == 0)
+    if spec.function == "map_agg":
+        # value columns are restricted to non-dictionary types (checked
+        # at partial), so the Block's one dictionary slot carries keys
+        k_blk, v_blk, vn_blk, cnt_blk = state_blocks
+        out_t = T.MapType(in_t, extra_t[0] if extra_t else T.UNKNOWN)
+        return Block(
+            data=(k_blk.data, v_blk.data, vn_blk.data, cnt_blk.data),
+            type=out_t,
+            nulls=cnt_blk.data == 0, dictionary=k_blk.dictionary,
+        )
+    vals_blk, vn_blk, cnt_blk = state_blocks
+    out_t = T.ArrayType(in_t)
+    return Block(
+        data=(vals_blk.data, vn_blk.data, cnt_blk.data), type=out_t,
+        nulls=cnt_blk.data == 0, dictionary=vals_blk.dictionary,
+    )
+
+
 def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
-                      cap: int, max_iters: int = 64):
+                      cap: int, max_iters: int = 64, collect_k: int = 1024):
     groups = _group_ids(group_channels, page, cap, max_iters)
     # dense fast path may size output below cap (see _group_ids)
     out_cap = groups.group_valid.shape[0]
     keys_page = _agg_keys_page(page, group_channels, groups)
     state_blocks: List[Block] = []
     for spec, layout in zip(aggregates, layouts):
+        if spec.function in S.COLLECT_FNS:
+            blocks, c_ovf = _collect_partial_blocks(
+                spec, layout, page, groups, out_cap, collect_k
+            )
+            state_blocks.extend(blocks)
+            groups.overflow = groups.overflow | c_ovf
+            continue
         blk = None if spec.channel is None else page.block(spec.channel)
         blk = _apply_agg_mask(spec, page, blk)
         if spec.function == "approx_distinct":
@@ -1814,7 +2070,8 @@ def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
 
 
 def _merge_partials_page(aggregates, layouts, nkeys, merged: Page,
-                         cap: int, max_iters: int = 64):
+                         cap: int, max_iters: int = 64,
+                         collect_k: int = 1024):
     """Merge partial-state pages into one partial-state page (group by
     keys, merge_kind reductions, NO finalize) — the incremental fold that
     keeps aggregation memory bounded (reference: InMemoryHashAggregation-
@@ -1826,6 +2083,14 @@ def _merge_partials_page(aggregates, layouts, nkeys, merged: Page,
     out_blocks: List[Block] = []
     ch = nkeys
     for spec, layout in zip(aggregates, layouts):
+        if spec.function in S.COLLECT_FNS:
+            blocks, c_ovf = _collect_merge_blocks(
+                spec, layout, merged, groups, out_cap, ch, collect_k
+            )
+            out_blocks.extend(blocks)
+            groups.overflow = groups.overflow | c_ovf
+            ch += len(layout)
+            continue
         if spec.function == "approx_distinct":
             blk = merged.block(ch)
             ch += 1
@@ -1857,7 +2122,8 @@ def _merge_partials_page(aggregates, layouts, nkeys, merged: Page,
 
 
 def _final_agg_page(group_channels, aggregates, layouts, in_types,
-                    merged: Page, cap: int, max_iters: int = 64):
+                    merged: Page, cap: int, max_iters: int = 64,
+                    collect_k: int = 1024, extra_types=()):
     nkeys = len(group_channels)
     key_channels = tuple(range(nkeys))
     groups = _group_ids(key_channels, merged, cap, max_iters)
@@ -1865,7 +2131,20 @@ def _final_agg_page(group_channels, aggregates, layouts, in_types,
     keys_page = _agg_keys_page(merged, key_channels, groups)
     out_blocks: List[Block] = []
     ch = nkeys
-    for spec, layout, in_t in zip(aggregates, layouts, in_types):
+    for idx, (spec, layout, in_t) in enumerate(
+        zip(aggregates, layouts, in_types)
+    ):
+        if spec.function in S.COLLECT_FNS:
+            blocks, c_ovf = _collect_merge_blocks(
+                spec, layout, merged, groups, out_cap, ch, collect_k
+            )
+            groups.overflow = groups.overflow | c_ovf
+            ch += len(layout)
+            ext = extra_types[idx] if idx < len(extra_types) else ()
+            out_blocks.append(
+                _collect_finalize_block(spec, in_t, ext, blocks)
+            )
+            continue
         if spec.function == "approx_distinct":
             blk = merged.block(ch)
             ch += 1
@@ -1971,10 +2250,19 @@ def _final_global_agg(aggregates, layouts, in_types, merged: Page) -> Page:
                 valid=jnp.ones((1,), dtype=jnp.bool_))
 
 
-def _empty_state_page(aggregates, layouts) -> Page:
+def _empty_state_page(aggregates, layouts, collect_k: int = 1024) -> Page:
     blocks = []
     for spec, layout in zip(aggregates, layouts):
         for st in layout:
+            if isinstance(st.type, T.CollectStateType):
+                blocks.append(
+                    Block(
+                        data=jnp.zeros((1, collect_k), dtype=jnp.int64),
+                        type=st.type,
+                        nulls=None,
+                    )
+                )
+                continue
             if isinstance(st.type, T.HllStateType):
                 blocks.append(
                     Block(
@@ -2043,6 +2331,49 @@ def _probe_join_page(left_keys, right_keys, join_type, page: Page,
         None, None, None, lcols, lnulls, page.valid, out_cap, index=index
     )
     return _assemble_join_output(join_type, page, build, m)
+
+
+def _probe_join_page_unique(left_keys, right_keys, join_type, page: Page,
+                            build: Page, index, out_cap: int):
+    """FK-join (unique build keys) probe: no match expansion — the
+    output page IS the probe page plus gathered build columns; for
+    LEFT joins unmatched probe rows simply carry a null build side in
+    the SAME page (no appended pad page). out_cap is ignored (output
+    capacity == probe capacity by construction)."""
+    lblocks = [page.block(c) for c in left_keys]
+    rblocks = [build.block(c) for c in right_keys]
+    lcols, lnulls, _rcols, _rnulls = _canonical_join_cols(lblocks, rblocks)
+    bcols, bvalid, sorted_hash, perm = index
+    pcols, p_null = J._fold_nulls(lcols, lnulls, False)
+    pvalid = page.valid & ~p_null
+    phash = H.hash_columns(pcols, [None] * len(pcols))
+    lo = jnp.searchsorted(sorted_hash, phash, side="left", method="sort")
+    hi = jnp.searchsorted(sorted_hash, phash, side="right",
+                          method="sort")
+    bid, found, collision = J.unique_join_lookup(
+        bcols, bvalid, perm, pcols, pvalid, lo, hi
+    )
+    right_out = gather_rows(build, bid, found)
+    if join_type == "left":
+        # matched rows carry build values; unmatched carry NULL build
+        right_blocks = tuple(
+            Block(
+                data=b.data, type=b.type,
+                nulls=(~found if b.nulls is None else (b.nulls | ~found)),
+                dictionary=b.dictionary,
+            )
+            for b in right_out.blocks
+        )
+        out_valid = page.valid
+    else:  # inner
+        right_blocks = right_out.blocks
+        out_valid = page.valid & found
+    out = Page(blocks=page.blocks + right_blocks, valid=out_valid)
+    # build_matched feeds only RIGHT/FULL outer emission, which this
+    # kernel never serves (inner/left only) — a zeros stub keeps the
+    # jit output signature without paying the scatter
+    matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
+    return out, matched, collision
 
 
 def _build_radix_join_index(left_keys, right_keys, layout, page: Page,

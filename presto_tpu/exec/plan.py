@@ -85,6 +85,11 @@ class AggSpec:
     function: str
     channel: Optional[int] = None
     mask: Optional[int] = None
+    # additional input channels (map_agg's value column) and constant
+    # parameters (approx_percentile's fraction) — reference:
+    # AggregationNode.Aggregation's full argument list
+    extra_channels: Tuple[int, ...] = ()
+    params: Tuple = ()
 
 
 @dataclasses.dataclass(frozen=True)

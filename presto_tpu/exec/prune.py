@@ -188,6 +188,7 @@ def _prune(node: P.PhysicalNode, needed: Set[int], ctx: Dict):
                 child_needed.add(ch)
             if node.aggregates[i].mask is not None:
                 child_needed.add(node.aggregates[i].mask)
+            child_needed.update(node.aggregates[i].extra_channels)
         src, m = _prune(node.source, child_needed, ctx)
         groups = tuple(m[c] for c in node.group_channels)
         aggs = tuple(
@@ -197,6 +198,10 @@ def _prune(node: P.PhysicalNode, needed: Set[int], ctx: Dict):
                 else m[node.aggregates[i].channel],
                 None if node.aggregates[i].mask is None
                 else m[node.aggregates[i].mask],
+                extra_channels=tuple(
+                    m[c] for c in node.aggregates[i].extra_channels
+                ),
+                params=node.aggregates[i].params,
             )
             for i in keep_aggs
         )

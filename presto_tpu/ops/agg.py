@@ -97,6 +97,19 @@ class GroupbyResult:
     # gathering through rep_index — XLA then dead-code-eliminates the
     # rep scatter entirely.
     dense_sizes: Optional[Tuple[int, ...]] = None
+    # sorted path only: the group-sort permutation and the per-SORTED-
+    # row group id (invalid rows = out_cap, sorted to the tail). With
+    # these, aggregate() computes SUM/COUNT via gather+cumsum+boundary
+    # differences — no scatter at all (scatter: ~14M rows/s on TPU;
+    # sort+cumsum: ~250M rows/s). The input-order group_ids scatter
+    # above is then dead code XLA eliminates.
+    sort_perm: Optional[jnp.ndarray] = None
+    gid_sorted: Optional[jnp.ndarray] = None
+    # group g occupies sorted positions [seg_start[g], seg_end[g]);
+    # computed once per page with one scatter-min (group ids from the
+    # sort are consecutive, so end[g] = start[g+1])
+    seg_start: Optional[jnp.ndarray] = None
+    seg_end: Optional[jnp.ndarray] = None
 
 
 def compute_groups_sorted(
@@ -146,12 +159,29 @@ def compute_groups_sorted(
     gids = jnp.zeros(valid.shape, dtype=jnp.int64)
     gids = gids.at[perm].set(jnp.clip(gid_sorted, 0, out_capacity - 1))
 
-    # representative input row per group = row at each boundary
-    targets = jnp.where(
-        boundary & (gid_sorted < out_capacity), gid_sorted, out_capacity
+    # group g occupies sorted positions [start[g], end[g]). Group ids
+    # from the sort are CONSECUTIVE (cumsum of boundaries), so one
+    # scatter-min of boundary positions gives every start and
+    # end[g] = start[g+1] (n_valid for the last group). This is the
+    # only scatter the sorted path pays per page; every reduction then
+    # runs scatter-free on [start, end) cumsum differences.
+    gid_x = jnp.where(svalid, gid_sorted, out_capacity)
+    n = valid.shape[0]
+    idxs = jnp.arange(n, dtype=jnp.int64)
+    n_valid = jnp.sum(svalid.astype(jnp.int64))
+    start = (
+        jnp.full((out_capacity + 1,), jnp.int64(n))
+        .at[jnp.where(boundary & (gid_sorted < out_capacity),
+                      gid_sorted, out_capacity)]
+        .min(idxs, mode="drop")
     )
-    rep = jnp.zeros((out_capacity,), dtype=jnp.int64)
-    rep = rep.at[targets].set(perm.astype(jnp.int64), mode="drop")
+    start = jnp.minimum(start, n_valid)
+    seg_start = start[:out_capacity]
+    seg_end = jnp.concatenate(
+        [start[1:out_capacity], n_valid[None]]
+    )
+    seg_end = jnp.maximum(seg_start, seg_end)
+    rep = perm[jnp.clip(seg_start, 0, n - 1)].astype(jnp.int64)
     group_valid = jnp.arange(out_capacity, dtype=jnp.int64) < num_groups
     return GroupbyResult(
         group_ids=gids,
@@ -160,6 +190,10 @@ def compute_groups_sorted(
         group_valid=group_valid,
         num_groups=num_groups,
         overflow=overflow,
+        sort_perm=perm,
+        gid_sorted=gid_x,
+        seg_start=seg_start,
+        seg_end=seg_end,
     )
 
 
@@ -415,6 +449,63 @@ def _minmax_identity(dtype, is_min: bool):
     return jnp.array(info.max if is_min else info.min, dtype=dtype)
 
 
+def _sorted_aggregate(
+    groups: GroupbyResult,
+    kind: str,
+    out_capacity: int,
+    data: Optional[jnp.ndarray],
+    nulls: Optional[jnp.ndarray],
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Scatter-free segmented reduction over the sorted group layout:
+    gather rows into group order, one cumulative sum, difference at
+    group boundaries (start positions come from a method='sort'
+    searchsorted over the sorted group ids). Exact for integers
+    (prefix sums stay in-range: |page total| < 2^63); float SUM keeps
+    the scatter path for accumulation-order stability."""
+    perm, gidx = groups.sort_perm, groups.gid_sorted
+    n = perm.shape[0]
+    contributing_sorted = gidx < out_capacity
+    if nulls is not None:
+        contributing_sorted = contributing_sorted & ~nulls[perm]
+
+    if kind in (SUM, BOOL_OR, BOOL_AND):
+        assert data is not None
+        ds = data[perm]
+    if kind == SUM:
+        x = jnp.where(contributing_sorted, ds,
+                      jnp.zeros((), dtype=data.dtype))
+    elif kind in (BOOL_OR, BOOL_AND):
+        x = jnp.where(
+            contributing_sorted & ds.astype(jnp.bool_),
+            jnp.int64(1), jnp.int64(0),
+        )
+    else:  # COUNT / COUNT_STAR
+        x = contributing_sorted.astype(jnp.int64)
+
+    csum = jnp.cumsum(x)
+    start, end = groups.seg_start, groups.seg_end
+    pcs = jnp.concatenate([jnp.zeros((1,), dtype=csum.dtype), csum])
+    totals = pcs[end] - pcs[start]
+
+    if kind == COUNT_STAR:
+        return totals, None
+    ncontrib = (end - start).astype(jnp.int64)
+    if nulls is not None:
+        pcn = jnp.concatenate([
+            jnp.zeros((1,), dtype=jnp.int64),
+            jnp.cumsum(contributing_sorted.astype(jnp.int64)),
+        ])
+        ncontrib = pcn[end] - pcn[start]
+    empty = ncontrib == 0
+    if kind == COUNT:
+        return ncontrib, None
+    if kind == BOOL_OR:
+        return (totals > 0), empty
+    if kind == BOOL_AND:
+        return (totals == ncontrib) & ~empty, empty
+    return totals, empty  # SUM
+
+
 def aggregate(
     groups: GroupbyResult,
     kind: str,
@@ -430,6 +521,12 @@ def aggregate(
     ids = jnp.where(groups.row_valid, groups.group_ids, out_capacity)
     nseg = out_capacity + 1
     mm = _mm_eligible(kind, out_capacity, data)
+    if (groups.sort_perm is not None and not mm
+            and kind in (SUM, COUNT, COUNT_STAR, BOOL_OR, BOOL_AND)
+            and (data is None or not isinstance(data, tuple))
+            and (kind != SUM
+                 or jnp.issubdtype(data.dtype, jnp.integer))):
+        return _sorted_aggregate(groups, kind, out_capacity, data, nulls)
 
     if kind == COUNT_STAR:
         if mm:

@@ -199,6 +199,38 @@ def expand_matches(
     )
 
 
+def unique_join_lookup(
+    bcols,
+    bvalid: jnp.ndarray,
+    perm: jnp.ndarray,
+    pcols,
+    pvalid: jnp.ndarray,
+    lo: jnp.ndarray,
+    hi: jnp.ndarray,
+):
+    """FK-join fast path: build keys are provably unique, so every
+    probe row has <= 1 true match — no expansion, no output-capacity
+    machinery; the output is the probe page itself plus gathered build
+    columns (reference: LookupJoinOperator's unique-positions path).
+
+    Only the FIRST candidate in the probe row's hash range is checked.
+    A range wider than 1 means distinct unique keys collided in the
+    u64 hash (~2^-64 per pair); ``collision`` flags it for the
+    boosted-retry ladder, where eligibility falls back to the general
+    expansion — wasted work, never wrong results.
+
+    Returns (build_idx[int64, probe_cap], found[bool], collision)."""
+    build_cap = bvalid.shape[0]
+    pos = jnp.clip(lo.astype(jnp.int64), 0, build_cap - 1)
+    bid = perm[pos].astype(jnp.int64)
+    in_range = (hi - lo) >= 1
+    found = in_range & pvalid & bvalid[bid]
+    for bc, pc in zip(bcols, pcols):
+        found = found & (bc[bid] == pc)
+    collision = jnp.any(pvalid & ((hi - lo) > 1))
+    return bid, found, collision
+
+
 def semi_join_mask(
     build_cols: Sequence[jnp.ndarray],
     build_nulls: Sequence[Optional[jnp.ndarray]],

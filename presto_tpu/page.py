@@ -305,9 +305,65 @@ def _encode_column(
     )
 
 
+def _collect_elem_decoder(elem_t, dictionary):
+    """int64-encoded collect-state slot -> Python element value
+    (inverse of exec/executor._collect_encode)."""
+    if dictionary is not None:
+        values = dictionary.values
+
+        def dec(v):
+            return values[int(np.clip(v, 0, len(values) - 1))]
+        return dec
+    if isinstance(elem_t, (T.DoubleType, T.RealType)):
+        import math
+
+        def dec_float(v):
+            v = int(v)
+            if v == 0:
+                return 0.0
+            mag = abs(v)
+            e = (mag >> 52) - 1100
+            frac = mag & ((1 << 52) - 1)
+            out = math.ldexp(0.5 + frac * 2.0**-53, e + 1)
+            return -out if v < 0 else out
+        return dec_float
+    if isinstance(elem_t, T.BooleanType):
+        return lambda v: bool(v)
+    return lambda v: int(v)
+
+
 def _decode_block(blk: Block, rows_idx: np.ndarray) -> list:
     nulls = np.asarray(blk.nulls) if blk.nulls is not None else None
-    if isinstance(blk.data, tuple):
+    if (isinstance(blk.type, (T.ArrayType, T.MapType))
+            and isinstance(blk.data, tuple)):
+        # collect-state result: (vals2d, elem-null-flags2d, counts) for
+        # array_agg; (k2d, v2d, value-null-flags2d, counts) for map_agg
+        *mats, counts = blk.data
+        mats = [np.asarray(m)[rows_idx] for m in mats]
+        counts = np.asarray(counts)[rows_idx]
+        if isinstance(blk.type, T.ArrayType):
+            dec = _collect_elem_decoder(blk.type.element, blk.dictionary)
+            vals = [
+                tuple(
+                    None if nf else dec(v)
+                    for v, nf in zip(mats[0][i, : int(c)],
+                                     mats[1][i, : int(c)])
+                )
+                for i, c in enumerate(counts)
+            ]
+        else:
+            kdec = _collect_elem_decoder(blk.type.key, blk.dictionary)
+            vdec = _collect_elem_decoder(blk.type.value, None)
+            vals = [
+                tuple(
+                    (kdec(k), None if nf else vdec(v))
+                    for k, v, nf in zip(mats[0][i, : int(c)],
+                                        mats[1][i, : int(c)],
+                                        mats[2][i, : int(c)])
+                )
+                for i, c in enumerate(counts)
+            ]
+    elif isinstance(blk.data, tuple):
         hi = np.asarray(blk.data[0])[rows_idx].astype(object)
         lo = np.asarray(blk.data[1])[rows_idx].astype(object)
         vals = [(int(h) << 64) | (int(l) & ((1 << 64) - 1)) for h, l in zip(hi, lo)]

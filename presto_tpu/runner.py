@@ -196,6 +196,7 @@ class LocalRunner:
             ex.page_rows = int(self.session.get("page_rows"))
         else:
             ex.page_rows = self._ctor_page_rows
+        ex.collect_k = int(self.session.get("array_agg_max_elements"))
 
     def estimate_memory(self, sql: str) -> int:
         """Crude peak-HBM estimate for admission control (reference:

@@ -16,6 +16,7 @@ device at a time) while the protocol surface stays responsive.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 import uuid
@@ -210,7 +211,7 @@ class QueryManager:
                     {"name": n, "type": t}
                     for n, t in zip(result.column_names, types)
                 ]
-                q.rows = [_json_row(r) for r in result.rows]
+                q.rows = [_json_row(r, types) for r in result.rows]
                 q.update_type = result.update_type
                 if result.update_type == "SET SESSION":
                     # surface the new value so clients echo it back
@@ -286,14 +287,68 @@ class QueryManager:
         return "\n".join(lines) + "\n"
 
 
-def _json_row(row: tuple) -> list:
+_DECIMAL_RE = re.compile(r"decimal\((\d+),\s*(\d+)\)")
+
+
+def _render_decimal(unscaled: int, scale: int) -> str:
+    """Engine-internal unscaled int -> SQL decimal text (reference:
+    server/protocol renders decimals scaled: 1529698.00, never the raw
+    152969800)."""
+    if scale == 0:
+        return str(int(unscaled))
+    u = int(unscaled)
+    sign = "-" if u < 0 else ""
+    u = abs(u)
+    return f"{sign}{u // 10**scale}.{u % 10**scale:0{scale}d}"
+
+
+def _json_row(row: tuple, types=None) -> list:
     out = []
-    for v in row:
-        if v is None or isinstance(v, (bool, int, float, str)):
+    for j, v in enumerate(row):
+        t = types[j] if types and j < len(types) else ""
+        if v is None:
+            out.append(None)
+        elif isinstance(v, int) and not isinstance(v, bool) and t:
+            m = _DECIMAL_RE.match(t)
+            if m:
+                out.append(_render_decimal(v, int(m.group(2))))
+            elif t == "date":
+                import datetime
+
+                out.append(str(
+                    datetime.date(1970, 1, 1)
+                    + datetime.timedelta(days=v)
+                ))
+            elif t == "timestamp":
+                import datetime
+
+                out.append(
+                    (datetime.datetime(1970, 1, 1)
+                     + datetime.timedelta(microseconds=v)
+                     ).isoformat(sep=" ")
+                )
+            else:
+                out.append(v)
+        elif isinstance(v, (bool, int, float, str)):
             out.append(v)
+        elif isinstance(v, (tuple, list)):
+            if t.startswith("map("):
+                # map values serialize as JSON objects (reference:
+                # protocol renders MAP as {key: value})
+                out.append({str(k): mv for k, mv in v})
+            else:
+                out.append(_json_value(v))
         else:
             out.append(str(v))
     return out
+
+
+def _json_value(v):
+    if isinstance(v, (tuple, list)):
+        return [_json_value(x) for x in v]
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
 
 
 class _Handler(BaseHTTPRequestHandler):

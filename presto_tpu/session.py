@@ -89,6 +89,13 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1 << 18,
         ),
         PropertyMetadata(
+            "array_agg_max_elements",
+            "per-group value-slot bound for array_agg/map_agg/"
+            "approx_percentile collect state; a group exceeding it "
+            "fails with a clear error (raise and re-run)",
+            int, 1024,
+        ),
+        PropertyMetadata(
             "query_max_memory_bytes",
             "fail queries whose largest page footprint exceeds this many "
             "bytes (0 = unlimited; reference: query.max-memory)",

@@ -48,7 +48,8 @@ AGG_FUNCTIONS = {"sum", "count", "avg", "min", "max", "any_value",
                  "bool_or", "bool_and",
                  "stddev", "stddev_samp", "stddev_pop",
                  "variance", "var_samp", "var_pop",
-                 "approx_distinct"}
+                 "approx_distinct",
+                 "array_agg", "map_agg", "approx_percentile"}
 
 # SQL-surface aliases -> agg_states layout names (reference:
 # FunctionRegistry registers stddev as an alias of stddev_samp)
@@ -1356,7 +1357,10 @@ class Planner:
         ]
 
         def chan_for(ast_expr) -> int:
-            e = tr.translate(ast_expr)
+            # long-decimal window inputs compute in double (the module-
+            # docstring long-decimal divergence; ops/window has no limb
+            # arithmetic)
+            e = _decimal_safe(tr.translate(ast_expr))
             if isinstance(e, ir.InputRef):
                 return e.channel
             for i, existing in enumerate(pre_exprs):
@@ -1496,19 +1500,25 @@ class Planner:
         distinct_aggs = [a for a in uniq_aggs if a.distinct]
         plain_aggs = [a for a in uniq_aggs if not a.distinct]
 
+        # global collect aggregates (array_agg/map_agg/approx_percentile
+        # with no GROUP BY) reuse the grouped machinery via a synthetic
+        # constant key — the [cap, K] collect state needs the grouped
+        # kernels. Divergence: over an EMPTY input this yields zero rows
+        # where the reference yields one NULL row.
+        if not group_irs and any(
+            _canon_agg(a.name) in AS.COLLECT_FNS for a in uniq_aggs
+        ):
+            group_irs = [ir.Constant(0, T.BIGINT)]
+
         # pre-projection: group keys then agg arguments
         pre_exprs: List[ir.RowExpression] = list(group_irs)
         agg_arg_ch: List[Optional[int]] = []
         agg_arg_ir: List[Optional[ir.RowExpression]] = []
-        for a in uniq_aggs:
-            if a.is_star or not a.args:
-                agg_arg_ch.append(None)
-                agg_arg_ir.append(None)
-                continue
-            e = _decimal_safe(tr.translate(a.args[0]))
-            if (_canon_agg(a.name) in AS.VARIANCE_FNS
-                    and e.type != T.DOUBLE):
-                e = ir.cast(e, T.DOUBLE)
+        agg_extra_ch: List[tuple] = []
+        agg_extra_ir: List[tuple] = []
+        agg_params: List[tuple] = []
+
+        def _arg_channel(e: ir.RowExpression) -> int:
             idx = None
             if e in pre_exprs:
                 i0 = pre_exprs.index(e)
@@ -1520,8 +1530,48 @@ class Planner:
             if idx is None:
                 pre_exprs.append(e)
                 idx = len(pre_exprs) - 1
-            agg_arg_ch.append(idx)
+            return idx
+
+        for a in uniq_aggs:
+            if a.is_star or not a.args:
+                agg_arg_ch.append(None)
+                agg_arg_ir.append(None)
+                agg_extra_ch.append(())
+                agg_extra_ir.append(())
+                agg_params.append(())
+                continue
+            cname = _canon_agg(a.name)
+            e = _decimal_safe(tr.translate(a.args[0]))
+            if cname in AS.VARIANCE_FNS and e.type != T.DOUBLE:
+                e = ir.cast(e, T.DOUBLE)
+            agg_arg_ch.append(_arg_channel(e))
             agg_arg_ir.append(e)
+            extras_c: List[int] = []
+            extras_e: List[ir.RowExpression] = []
+            prms: tuple = ()
+            if cname == "map_agg":
+                if len(a.args) != 2:
+                    raise PlanningError("map_agg takes (key, value)")
+                e2 = _decimal_safe(tr.translate(a.args[1]))
+                extras_c.append(_arg_channel(e2))
+                extras_e.append(e2)
+            elif cname == "approx_percentile":
+                if len(a.args) != 2:
+                    raise PlanningError(
+                        "approx_percentile takes (value, fraction)"
+                    )
+                pe = tr.translate(a.args[1])
+                if not isinstance(pe, ir.Constant) or pe.value is None:
+                    raise PlanningError(
+                        "approx_percentile fraction must be a constant"
+                    )
+                frac = pe.value
+                if isinstance(pe.type, T.DecimalType):
+                    frac = frac / (10 ** pe.type.scale)
+                prms = (float(frac),)
+            agg_extra_ch.append(tuple(extras_c))
+            agg_extra_ir.append(tuple(extras_e))
+            agg_params.append(prms)
         pre_fields = [Field(None, e.type) for e in pre_exprs]
         pre = RelationPlan(P.Project(plan.node, tuple(pre_exprs)),
                            pre_fields)
@@ -1551,9 +1601,11 @@ class Planner:
                 capacity=_agg_capacity(pre.node, self.catalogs),
             )
             specs = []
-            for a, ch in zip(uniq_aggs, agg_arg_ch):
+            for a, ch, ec, pr in zip(uniq_aggs, agg_arg_ch,
+                                     agg_extra_ch, agg_params):
                 fn = "count" if a.name == "count" else _canon_agg(a.name)
-                specs.append(P.AggSpec(fn, ch))
+                specs.append(P.AggSpec(fn, ch, extra_channels=ec,
+                                       params=pr))
             agg_node = P.Aggregation(
                 dedup, tuple(range(nkeys)), tuple(specs),
                 capacity=_agg_capacity(dedup, self.catalogs),
@@ -1572,26 +1624,31 @@ class Planner:
             }
             md = P.MarkDistinct(pre.node, mark_sets)
             specs = []
-            for a, ch in zip(uniq_aggs, agg_arg_ch):
+            for a, ch, ec, pr in zip(uniq_aggs, agg_arg_ch,
+                                     agg_extra_ch, agg_params):
                 fn = _canon_agg(a.name)
                 if a.is_star or (fn == "count" and ch is None):
                     specs.append(P.AggSpec("count_star", None))
                 elif a.distinct:
-                    specs.append(P.AggSpec(fn, ch, mask=mark_of[ch]))
+                    specs.append(P.AggSpec(fn, ch, mask=mark_of[ch],
+                                           extra_channels=ec, params=pr))
                 else:
-                    specs.append(P.AggSpec(fn, ch))
+                    specs.append(P.AggSpec(fn, ch, extra_channels=ec,
+                                           params=pr))
             agg_node = P.Aggregation(
                 md, tuple(range(nkeys)), tuple(specs),
                 capacity=_agg_capacity(pre.node, self.catalogs),
             )
         else:
             specs = []
-            for a, ch in zip(uniq_aggs, agg_arg_ch):
+            for a, ch, ec, pr in zip(uniq_aggs, agg_arg_ch,
+                                     agg_extra_ch, agg_params):
                 fn = _canon_agg(a.name)
                 if a.is_star or (fn == "count" and ch is None):
                     specs.append(P.AggSpec("count_star", None))
                 else:
-                    specs.append(P.AggSpec(fn, ch))
+                    specs.append(P.AggSpec(fn, ch, extra_channels=ec,
+                                           params=pr))
             src_node = pre.node
             group_channels = tuple(range(nkeys))
             if grouping_sets is not None:
@@ -1615,13 +1672,16 @@ class Planner:
             out_fields.append(Field(nm, g.type))
         for _ in range(gid_extra):
             out_fields.append(Field(None, T.BIGINT))
-        for a, e in zip(uniq_aggs, agg_arg_ir):
+        for a, e, ee in zip(uniq_aggs, agg_arg_ir, agg_extra_ir):
             if a.is_star or e is None:
                 out_t = T.BIGINT
             elif a.distinct and a.name == "count":
                 out_t = T.BIGINT
             else:
-                out_t = AS.result_type(_canon_agg(a.name), e.type)
+                out_t = AS.result_type(
+                    _canon_agg(a.name), e.type,
+                    tuple(x.type for x in ee),
+                )
             out_fields.append(Field(None, out_t))
         agg_plan = RelationPlan(agg_node, out_fields)
 
@@ -1799,15 +1859,19 @@ class ExprTranslator:
 
         if isinstance(e, N.Identifier):
             if self._lambda_scopes and len(e.parts) == 1:
-                for frame in reversed(self._lambda_scopes):
-                    ref = frame.get(e.parts[0])
-                    if ref is not None:
-                        return ref
+                # innermost frame ONLY: ParamRef indices are frame-
+                # local, so an outer lambda's parameter inside a nested
+                # lambda would silently alias the inner page's params —
+                # raise (with the capture error below) instead
+                ref = self._lambda_scopes[-1].get(e.parts[0])
+                if ref is not None:
+                    return ref
             if self._lambda_scopes:
                 raise PlanningError(
-                    f"lambda bodies cannot capture columns "
-                    f"({'.'.join(e.parts)}); only lambda parameters "
-                    f"and constants are allowed"
+                    f"lambda bodies cannot capture columns or outer "
+                    f"lambda parameters ({'.'.join(e.parts)}); only "
+                    f"this lambda's parameters and constants are "
+                    f"allowed"
                 )
             lvl, ch, f = self.scope.resolve(e)
             if lvl == 0:

@@ -372,6 +372,37 @@ class HllStateType(SqlType):
         return False
 
 
+@dataclasses.dataclass(frozen=True)
+class CollectStateType(SqlType):
+    """Internal bounded-collection accumulator state (array_agg /
+    map_agg / approx_percentile): Block data is a [cap, K] int64 slot
+    matrix; a sibling BIGINT count column says how many slots each
+    group uses (reference: operator/aggregation/ArrayAggregation-
+    Function's grouped BlockBuilder state). Values bit-encode into
+    int64 (doubles bitcast, dictionary-coded types by code — the
+    dictionary rides the Block); K is the array_agg_max_elements
+    session property."""
+
+    element: SqlType = dataclasses.field(default_factory=UnknownType)
+    K: int = 1024
+    name: str = dataclasses.field(init=False, default="collect_state")
+
+    @property
+    def device_dtype(self):
+        return jnp.int64
+
+    @property
+    def is_comparable(self) -> bool:
+        return False
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    def display(self) -> str:
+        return f"collect_state({self.element.display()}, {self.K})"
+
+
 # --- singletons (reference: static INSTANCE fields on each Type) ---------
 BIGINT = BigintType()
 INTEGER = IntegerType()

@@ -1,0 +1,115 @@
+"""array_agg / map_agg / approx_percentile — bounded collect-state
+aggregates (ops/collect.py + executor collect branches).
+
+Reference: presto-main operator/aggregation/ArrayAggregationFunction,
+MapAggregationFunction, ApproximatePercentileAggregations. Engine
+notes: per-group slots bounded by the array_agg_max_elements session
+property (overflow lands on the boosted-retry ladder); percentiles are
+EXACT within the bound (stronger than the reference's qdigest);
+collect results decode at the client and cannot feed further device
+expressions.
+"""
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.runner import LocalRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    conn = MemoryConnector()
+    conn.create_table(
+        "t", ["g", "x", "s", "d"],
+        [T.BIGINT, T.BIGINT, T.VARCHAR, T.DOUBLE],
+        [(1, 10, "a", 1.5), (1, 20, "b", 2.5), (2, 30, "c", 3.5),
+         (2, None, "d", 4.5), (1, 40, "a", 0.5), (3, None, None, None)],
+    )
+    conn.create_table(
+        "big", ["g", "x"], [T.BIGINT, T.BIGINT],
+        [(i % 3, i) for i in range(40)],
+    )
+    # page_rows 16 forces multi-page partial->merge->final folding
+    return LocalRunner({"mem": conn}, default_catalog="mem",
+                       page_rows=1 << 4)
+
+
+def q(runner, sql):
+    return sorted(runner.execute(sql).rows)
+
+
+def test_array_agg_grouped(runner):
+    # null ELEMENTS are included (reference: "Null elements are
+    # included in the aggregation")
+    assert q(runner, "select g, array_agg(x) from t group by g") == [
+        (1, (10, 20, 40)), (2, (30, None)), (3, (None,))]
+
+
+def test_array_agg_global(runner):
+    assert q(runner, "select array_agg(x) from t") == [
+        ((10, 20, 30, None, 40, None),)]
+
+
+def test_array_agg_strings_and_doubles(runner):
+    assert q(runner, "select g, array_agg(s) from t group by g") == [
+        (1, ("a", "b", "a")), (2, ("c", "d")), (3, (None,))]
+    assert q(runner, "select array_agg(d) from t where g = 1") == [
+        ((1.5, 2.5, 0.5),)]
+    # float slot-encoding round-trips exactly, negatives included
+    assert q(runner,
+             "select array_agg(d * -3.25) from t where g = 2") == [
+        ((-11.375, -14.625),)]
+
+
+def test_array_agg_distinct(runner):
+    rows = q(runner, "select array_agg(distinct s) from t where g = 1")
+    assert sorted(rows[0][0]) == ["a", "b"]
+
+
+def test_array_agg_multipage_fold(runner):
+    rows = q(runner, "select g, array_agg(x) from big group by g")
+    assert rows == [
+        (0, tuple(range(0, 40, 3))),
+        (1, tuple(range(1, 40, 3))),
+        (2, tuple(range(2, 40, 3))),
+    ]
+
+
+def test_map_agg(runner):
+    rows = q(runner, "select g, map_agg(s, x) from t "
+                     "where s is not null and x is not null group by g")
+    assert rows == [(1, (("a", 10), ("b", 20), ("a", 40))),
+                    (2, (("c", 30),))]
+
+
+def test_map_agg_null_semantics(runner):
+    # null KEYS skipped; null VALUES preserved (reference semantics)
+    rows = q(runner, "select g, map_agg(s, x) from t group by g")
+    assert rows == [
+        (1, (("a", 10), ("b", 20), ("a", 40))),
+        (2, (("c", 30), ("d", None))),
+        (3, None),  # zero non-null keys -> NULL (empty aggregate)
+    ]
+
+
+def test_approx_percentile(runner):
+    assert q(runner, "select g, approx_percentile(x, 0.5) "
+                     "from t group by g") == [
+        (1, 20), (2, 30), (3, None)]
+    assert q(runner, "select approx_percentile(x, 0.99) from t") == [
+        (40,)]
+    assert q(runner, "select approx_percentile(d, 0.5) from t") == [
+        (2.5,)]
+
+
+def test_collect_k_overflow_retries(runner):
+    # a group larger than the slot bound rides the boosted-retry
+    # ladder: K scales with the capacity boost until it fits
+    runner.execute("set session array_agg_max_elements = 4")
+    try:
+        rows = q(runner, "select g, array_agg(x) from big group by g")
+        assert rows[0] == (0, tuple(range(0, 40, 3)))
+        assert runner.executor._capacity_boost > 1
+    finally:
+        runner.execute("set session array_agg_max_elements = 1024")

@@ -239,3 +239,13 @@ def test_registered_count():
     from presto_tpu.expr import functions as F
 
     assert len(F.registered_names()) >= 150
+
+
+def test_nested_lambda_outer_param_rejected(runner):
+    # outer-lambda params inside a nested lambda would mis-bind
+    # (ParamRef indices are frame-local) — must raise, not mis-compute
+    with pytest.raises(Exception, match="capture"):
+        runner.execute(
+            "select transform(sequence(1, 2), "
+            "x -> transform(sequence(10, 11), y -> x + y)) from t"
+        )

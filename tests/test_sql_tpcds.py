@@ -68,6 +68,7 @@ _ORACLE_TABLES = [
     "date_dim", "store", "item", "customer", "customer_address",
     "web_sales", "warehouse", "ship_mode", "web_site", "reason",
     "time_dim", "household_demographics", "inventory",
+    "customer_demographics", "promotion",
 ]
 
 
@@ -96,36 +97,66 @@ ORACLE_82 = QUERIES[82].replace(
 Q17_FLOAT_COLS = {4, 5, 6, 8, 9, 10, 12, 13, 14}
 
 
+# Q37 shares Q82's decimal-band adaptation
+ORACLE_37 = QUERIES[37].replace(
+    "between 68 and 98", "between 6800 and 9800"
+)
+
+# round-4 breadth queries: float cols (avg over ints -> sqlite float)
+# and round cols (avg over cents decimals: engine yields round-half-up
+# int cents, sqlite a float — bucket both to int, tpch "r" mode)
+_DS_ORACLE = {
+    3: (QUERIES[3], set(), set()),
+    7: (QUERIES[7], {1}, {2, 3, 4}),
+    17: (ORACLE_17, Q17_FLOAT_COLS, set()),
+    19: (QUERIES[19], set(), set()),
+    25: (QUERIES[25], set(), set()),
+    26: (QUERIES[26], {1}, {2, 3, 4}),
+    29: (QUERIES[29], set(), set()),
+    37: (ORACLE_37, set(), set()),
+    42: (QUERIES[42], set(), set()),
+    52: (QUERIES[52], set(), set()),
+    55: (QUERIES[55], set(), set()),
+    62: (QUERIES[62], set(), set()),
+    64: (ORACLE_64, set(), set()),
+    82: (ORACLE_82, set(), set()),
+    93: (QUERIES[93], set(), set()),
+    96: (QUERIES[96], set(), set()),
+}
+
+
 def ds_oracle(qid: int):
     """(oracle sql, float-tolerance column set) per TPC-DS query —
     consumed by bench.py's oracle cross-check and sqlite baseline."""
-    return {
-        17: (ORACLE_17, Q17_FLOAT_COLS),
-        62: (QUERIES[62], set()),
-        64: (ORACLE_64, set()),
-        82: (ORACLE_82, set()),
-        93: (QUERIES[93], set()),
-        96: (QUERIES[96], set()),
-    }[qid]
+    sql, float_cols, _round_cols = _DS_ORACLE[qid]
+    return sql, float_cols
 
 
-def _norm(row, float_cols):
+def _norm(row, float_cols, round_cols=frozenset()):
     out = []
     for j, v in enumerate(row):
-        if j in float_cols and v is not None:
+        if v is None:
+            out.append(None)
+        elif j in float_cols:
             out.append(round(float(v), 6))
+        elif j in round_cols:
+            # round-half-up (engine decimal avgs round half up; python
+            # round() is banker's)
+            out.append(math.floor(float(v) + 0.5))
         else:
             out.append(v)
     return tuple(out)
 
 
-def _compare(engine_rows, oracle_rows, float_cols, label):
+def _compare(engine_rows, oracle_rows, float_cols, label,
+             round_cols=frozenset()):
     assert len(engine_rows) == len(oracle_rows), (
         f"{label}: row count {len(engine_rows)} vs {len(oracle_rows)}\n"
         f"engine: {engine_rows[:3]}\noracle: {oracle_rows[:3]}"
     )
-    e_rows = [_norm(r, float_cols) for r in engine_rows]
-    o_rows = [_norm(tuple(r), float_cols) for r in oracle_rows]
+    e_rows = [_norm(r, float_cols, round_cols) for r in engine_rows]
+    o_rows = [_norm(tuple(r), float_cols, round_cols)
+              for r in oracle_rows]
     for i, (er, orow) in enumerate(zip(e_rows, o_rows)):
         for j, (ev, ov) in enumerate(zip(er, orow)):
             if j in float_cols and ev is not None and ov is not None:
@@ -145,11 +176,14 @@ def test_q17(runner, db):
     _compare(got, want, Q17_FLOAT_COLS, "Q17")
 
 
-@pytest.mark.parametrize("qid", [62, 82, 93, 96])
-def test_new_table_queries(qid, runner, db):
-    """Round-3 breadth: queries over the web channel, inventory,
-    reason, time_dim, warehouse, ship_mode, and web_site."""
-    sql, float_cols = ds_oracle(qid)
+@pytest.mark.parametrize(
+    "qid", [3, 7, 19, 25, 26, 29, 37, 42, 52, 55, 62, 82, 93, 96]
+)
+def test_breadth_queries(qid, runner, db):
+    """Rounds 3-4 breadth: store/catalog/web channels, inventory,
+    demographics, promotion, reason, time_dim, warehouse, ship_mode,
+    web_site — each vs the sqlite oracle over the same rows."""
+    sql, float_cols, round_cols = _DS_ORACLE[qid]
     got = runner.execute(QUERIES[qid]).rows
     want = db.execute(sql).fetchall()
     if qid == 96:
@@ -159,7 +193,7 @@ def test_new_table_queries(qid, runner, db):
         assert len(want) > 0, (
             f"Q{qid}: oracle returned no rows — fixture too sparse"
         )
-    _compare(got, want, float_cols, f"Q{qid}")
+    _compare(got, want, float_cols, f"Q{qid}", round_cols)
 
 
 @pytest.mark.skipif(

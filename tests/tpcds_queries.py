@@ -204,3 +204,202 @@ limit 100
 """
 
 QUERIES = {17: Q17, 62: Q62, 64: Q64, 82: Q82, 93: Q93, 96: Q96}
+
+# ---- round 4: ten more store/catalog-channel queries. Same
+# reconstruction discipline (public spec templates + qualification-style
+# substitutions tuned to this generator's value ranges); deviations
+# (applied identically to the sqlite oracles):
+#   - Q7/Q26: the generator's promotion table has no p_channel_event;
+#     the channel disjunction uses p_channel_tv instead.
+#   - Q37/Q82 pattern: date windows expressed as inv/cs date_sk ranges
+#     (sqlite has no INTERVAL arithmetic).
+#   - Q19: the generator's item table has no i_manufact string column;
+#     the manufacturer grouping uses i_manufact_id alone.
+
+Q3 = """
+select d_year, i_brand_id, i_brand,
+       sum(ss_ext_sales_price) as sum_agg
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manufact_id = 128
+  and d_moy = 11
+group by d_year, i_brand_id, i_brand
+order by d_year, sum_agg desc, i_brand_id
+limit 100
+"""
+
+Q7 = """
+select i_item_id,
+       avg(ss_quantity) as agg1,
+       avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3,
+       avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_tv = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+
+Q19 = """
+select i_brand_id as brand_id, i_brand as brand, i_manufact_id,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item, customer, customer_address, store
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 8
+  and d_moy = 11
+  and d_year = 1999
+  and ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5)
+  and ss_store_sk = s_store_sk
+group by i_brand_id, i_brand, i_manufact_id
+order by ext_price desc, brand_id, i_manufact_id
+limit 100
+"""
+
+Q25 = """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) as store_sales_profit,
+       sum(sr_net_loss) as store_returns_loss,
+       sum(cs_net_profit) as catalog_sales_profit
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4
+  and d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 10
+  and d2.d_year = 2001
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_moy between 4 and 10
+  and d3.d_year = 2001
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+
+Q26 = """
+select i_item_id,
+       avg(cs_quantity) as agg1,
+       avg(cs_list_price) as agg2,
+       avg(cs_coupon_amt) as agg3,
+       avg(cs_sales_price) as agg4
+from catalog_sales, customer_demographics, date_dim, item, promotion
+where cs_sold_date_sk = d_date_sk
+  and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_promo_sk = p_promo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_tv = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+
+Q29 = """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) as store_sales_quantity,
+       sum(sr_return_quantity) as store_returns_quantity,
+       sum(cs_quantity) as catalog_sales_quantity
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4
+  and d1.d_year = 1999
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 7
+  and d2.d_year = 1999
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_year in (1999, 2000, 2001)
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+
+Q37 = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 68 and 98
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and inv_date_sk between 2450994 and 2451054
+  and i_manufact_id in (677, 940, 694, 808)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+
+Q42 = """
+select d_year, i_category_id, i_category,
+       sum(ss_ext_sales_price) as total_sales
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 1
+  and d_moy = 11
+  and d_year = 2000
+group by d_year, i_category_id, i_category
+order by total_sales desc, d_year, i_category_id, i_category
+limit 100
+"""
+
+Q52 = """
+select d_year, i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 1
+  and d_moy = 11
+  and d_year = 2000
+group by d_year, i_brand_id, i_brand
+order by d_year, ext_price desc, brand_id
+limit 100
+"""
+
+Q55 = """
+select i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 28
+  and d_moy = 11
+  and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, brand_id
+limit 100
+"""
+
+QUERIES.update({3: Q3, 7: Q7, 19: Q19, 25: Q25, 26: Q26, 29: Q29,
+                37: Q37, 42: Q42, 52: Q52, 55: Q55})
