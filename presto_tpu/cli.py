@@ -59,27 +59,40 @@ def main(argv=None) -> int:
     ap.add_argument("--execute", "-e", help="run one statement and exit")
     ap.add_argument("--serve", action="store_true",
                     help="start an in-process coordinator first")
-    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--port", type=int, default=None,
+                    help="listen port for --serve (default: the "
+                    "etc config's http-server.http.port, else 8080)")
     ap.add_argument("--scale", type=float, default=0.01,
                     help="tpch catalog scale factor for --serve")
+    ap.add_argument("--etc-dir",
+                    help="deployment config directory: "
+                    "etc/config.properties + etc/catalog/*.properties "
+                    "(reference etc/ layout; overrides --scale)")
     args = ap.parse_args(argv)
 
     server_url = args.server
     srv = None
     if args.serve:
-        from presto_tpu.connectors.blackhole import BlackholeConnector
-        from presto_tpu.connectors.memory import MemoryConnector
-        from presto_tpu.connectors.tpch import TpchConnector
-        from presto_tpu.server import PrestoTpuServer
+        if args.etc_dir:
+            from presto_tpu.config import server_from_etc
 
-        srv = PrestoTpuServer(
-            {
-                "tpch": TpchConnector(scale=args.scale),
-                "memory": MemoryConnector(),
-                "blackhole": BlackholeConnector(),
-            },
-            port=args.port,
-        )
+            srv = server_from_etc(args.etc_dir, port=args.port)
+        else:
+            from presto_tpu.connectors.blackhole import (
+                BlackholeConnector,
+            )
+            from presto_tpu.connectors.memory import MemoryConnector
+            from presto_tpu.connectors.tpch import TpchConnector
+            from presto_tpu.server import PrestoTpuServer
+
+            srv = PrestoTpuServer(
+                {
+                    "tpch": TpchConnector(scale=args.scale),
+                    "memory": MemoryConnector(),
+                    "blackhole": BlackholeConnector(),
+                },
+                port=args.port if args.port is not None else 8080,
+            )
         port = srv.start()
         server_url = f"http://127.0.0.1:{port}"
         print(f"coordinator listening on {server_url}")

@@ -1,0 +1,142 @@
+"""Deployment config: the reference's ``etc/`` layout.
+
+Reference: presto-server's config tiers (SURVEY §6.6) —
+``etc/config.properties`` (node/service keys, airlift @Config binding)
+and ``etc/catalog/<name>.properties`` (one file per catalog; the
+``connector.name`` key selects a ConnectorFactory, remaining keys are
+connector-specific). Ours parses the same shapes into engine objects so
+a reference-style deployment directory drives the server unchanged:
+
+    etc/config.properties        http-server.http.port=8080
+                                 query.max-memory-bytes=268435456
+    etc/catalog/tpch.properties  connector.name=tpch
+                                 tpch.scale-factor=1.0
+
+Unknown connector names or malformed files raise at load (reference:
+unknown config keys are a startup error).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+
+def parse_properties(path: str) -> Dict[str, str]:
+    """Java-style .properties subset: key=value lines, #/! comments,
+    whitespace trimmed (reference: airlift loads these via
+    java.util.Properties)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            if "=" not in line:
+                raise ValueError(
+                    f"{path}:{lineno}: expected key=value, got {line!r}"
+                )
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+# connector.name -> factory(props) -> Connector (reference:
+# ConnectorFactory registry in ConnectorManager; plugins extend it via
+# register_connector_factory)
+_FACTORIES: Dict[str, Callable] = {}
+
+
+def register_connector_factory(name: str, factory: Callable) -> None:
+    _FACTORIES[name] = factory
+
+
+def _builtin_factories() -> Dict[str, Callable]:
+    def tpch(props):
+        from presto_tpu.connectors.tpch import TpchConnector
+
+        return TpchConnector(
+            scale=float(props.get("tpch.scale-factor", "0.01"))
+        )
+
+    def tpcds(props):
+        from presto_tpu.connectors.tpcds import TpcdsConnector
+
+        return TpcdsConnector(
+            scale=float(props.get("tpcds.scale-factor", "0.01"))
+        )
+
+    def memory(props):
+        from presto_tpu.connectors.memory import MemoryConnector
+
+        return MemoryConnector()
+
+    def blackhole(props):
+        from presto_tpu.connectors.blackhole import BlackholeConnector
+
+        return BlackholeConnector()
+
+    return {"tpch": tpch, "tpcds": tpcds, "memory": memory,
+            "blackhole": blackhole}
+
+
+def load_catalogs(etc_dir: str) -> Dict[str, object]:
+    """Build the catalog map from etc/catalog/*.properties (reference:
+    StaticCatalogStore scanning the catalog config dir)."""
+    catalog_dir = os.path.join(etc_dir, "catalog")
+    factories = dict(_builtin_factories())
+    factories.update(_FACTORIES)
+    catalogs: Dict[str, object] = {}
+    if not os.path.isdir(catalog_dir):
+        return catalogs
+    for fname in sorted(os.listdir(catalog_dir)):
+        if not fname.endswith(".properties"):
+            continue
+        name = fname[: -len(".properties")]
+        props = parse_properties(os.path.join(catalog_dir, fname))
+        cname = props.get("connector.name")
+        if not cname:
+            raise ValueError(
+                f"{fname}: missing required key connector.name"
+            )
+        factory = factories.get(cname)
+        if factory is None:
+            raise ValueError(
+                f"{fname}: unknown connector.name {cname!r} "
+                f"(known: {sorted(factories)})"
+            )
+        catalogs[name] = factory(props)
+    return catalogs
+
+
+def load_node_config(etc_dir: str) -> Dict[str, str]:
+    """etc/config.properties, empty when absent (reference: the node/
+    service tier; keys consumed by serve_from_etc below)."""
+    path = os.path.join(etc_dir, "config.properties")
+    if not os.path.exists(path):
+        return {}
+    return parse_properties(path)
+
+
+def server_from_etc(etc_dir: str, port: Optional[int] = None, **kw):
+    """A PrestoTpuServer wired entirely from an etc/ directory —
+    the reference's deployment story (bin/launcher reads etc/)."""
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    conf = load_node_config(etc_dir)
+    catalogs = load_catalogs(etc_dir)
+    if not catalogs:
+        raise ValueError(
+            f"no catalogs found under {etc_dir}/catalog/*.properties"
+        )
+    if port is None:
+        port = int(conf.get("http-server.http.port", "0"))
+    mem = int(conf.get("query.max-memory-bytes", "0")) or None
+    default_catalog = conf.get(
+        "default-catalog", sorted(catalogs)[0]
+    )
+    page_rows = int(conf.get("page-rows", str(1 << 18)))
+    return PrestoTpuServer(
+        catalogs, port=port, default_catalog=default_catalog,
+        memory_budget_bytes=mem, page_rows=page_rows, **kw,
+    )

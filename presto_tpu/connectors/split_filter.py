@@ -52,3 +52,80 @@ class SplitFilterConnector:
         for split in splits:
             if split.row_count:
                 yield self._inner.page_for_split(split, columns)
+
+
+class HashSplitConnector:
+    """Hash-repartitioned scans: worker ``index`` of ``count`` sees
+    only the rows of each partitioned table whose PARTITION COLUMN
+    hashes to it — the DCN realization of the reference's
+    hash-repartition exchange (`ExchangeNode(REPARTITION)` →
+    `PartitionedOutputOperator` routing rows by hash(key) % n).
+
+    TPU-native divergence (documented): instead of routing serialized
+    pages between workers, each worker re-scans and masks — for the
+    generator connectors a scan IS a compute (SURVEY §8.2.6
+    scan==generate), so "receiving my partition" and "generating my
+    partition" are the same device program, with zero DCN page traffic
+    between workers. Tables co-partitioned on their join keys make
+    every partition-local join a partition of the global join; the
+    serde page plane still carries partial states worker→coordinator.
+    """
+
+    def __init__(self, inner, partition_cols, index: int, count: int):
+        self._inner = inner
+        self._partition_cols = dict(partition_cols)  # table -> column
+        self._index = index
+        self._count = count
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _mask_page(self, page, table: str, columns):
+        from presto_tpu.ops import hashing as H
+        from presto_tpu.ops import keys as K
+
+        import jax.numpy as jnp
+
+        col = self._partition_cols[table]
+        idx = list(columns).index(col)
+        blk = page.block(idx)
+        cols = K.equality_encoding(blk)
+        h = H.hash_columns(cols, [None] * len(cols))
+        mine = (h % jnp.uint64(self._count)) == jnp.uint64(self._index)
+        if blk.nulls is not None:
+            # null keys go to worker 0 so every row lands exactly once
+            mine = jnp.where(
+                blk.nulls, jnp.uint64(self._index) == jnp.uint64(0),
+                mine,
+            )
+        return page.with_valid(page.valid & mine)
+
+    def pages(
+        self,
+        table: str,
+        columns: Optional[Sequence[str]] = None,
+        target_rows: int = 1 << 20,
+        constraint=None,
+    ):
+        part_col = self._partition_cols.get(table)
+        if columns is None:
+            columns = list(self._inner.table_schema(table).column_names())
+        scan_cols = list(columns)
+        if part_col is not None and part_col not in scan_cols:
+            added = True
+            scan_cols.append(part_col)
+        else:
+            added = False
+        splits = self._inner.splits(table, target_rows)
+        if constraint:
+            splits = self._inner.prune_splits(table, splits, constraint)
+        for split in splits:
+            if not split.row_count:
+                continue
+            page = self._inner.page_for_split(split, scan_cols)
+            if part_col is not None:
+                page = self._mask_page(page, table, scan_cols)
+                if added:
+                    page = page.select_channels(
+                        range(len(scan_cols) - 1))
+            yield page

@@ -42,6 +42,7 @@ from presto_tpu.server.heartbeat import HeartbeatFailureDetector
 from presto_tpu.server.worker import (
     fanout_safe,
     find_partial_cut,
+    hash_fanout_plan,
     largest_table,
 )
 
@@ -82,12 +83,17 @@ class DcnRunner:
                  default_catalog: Optional[str] = None,
                  page_rows: int = 1 << 16,
                  fetch_retries: int = 3,
-                 session_props: Optional[Dict] = None):
+                 session_props: Optional[Dict] = None,
+                 partition_threshold: int = 1 << 17):
         from presto_tpu.runner import LocalRunner
         from presto_tpu.session import Session
 
         self.worker_uris = list(worker_uris)
         self.fetch_retries = fetch_retries
+        self.partition_threshold = partition_threshold
+        # introspection: distribution used by the last execute()
+        # ("hash" partitioned join | "roundrobin" | "local")
+        self.last_distribution = "local"
         self.session_props = dict(session_props or {})
         cat = default_catalog or next(iter(catalogs))
         self.runner = LocalRunner(
@@ -149,14 +155,29 @@ class DcnRunner:
         cut = find_partial_cut(plan)
         if cut is None:
             # no aggregation boundary: run locally (out of DCN scope)
+            self.last_distribution = "local"
             return self.runner.execute(sql).rows
         ex = self.runner.executor
+        # PARTITIONED JOIN first (the hash-repartition exchange: both
+        # big join sides co-partitioned by key hash, build state 1/N
+        # per worker); round-robin split-table fan-out (replicated
+        # builds) is the fallback shape
+        partition_cols = hash_fanout_plan(
+            cut, self.runner.catalogs,
+            partition_threshold=self.partition_threshold,
+        )
         split_table = largest_table(cut.source, self.runner.catalogs)
-        if split_table is None or not fanout_safe(cut, split_table):
+        if partition_cols is None and (
+            split_table is None or not fanout_safe(cut, split_table)
+        ):
             # non-decomposable shape (DISTINCT masks, outer/semi joins,
             # self-joins of the fact table, nested aggs): run locally
             # rather than wrong
+            self.last_distribution = "local"
             return self.runner.execute(sql).rows
+        self.last_distribution = (
+            "hash" if partition_cols is not None else "roundrobin"
+        )
         # coordinator-side final stage honors the same session the
         # workers were sent
         self.runner.apply_session()
@@ -173,6 +194,9 @@ class DcnRunner:
                 "splitCount": len(self.worker_uris),
                 "session": self.session_props,
             }
+            if partition_cols is not None:
+                payload["splitMode"] = "hash"
+                payload["partitionColumns"] = partition_cols
             try:
                 self._post_task(uri, payload)
             except (urllib.error.URLError, OSError) as e:

@@ -321,6 +321,58 @@ class RemoteSource(PhysicalNode):
         return ()
 
 
+def channel_width(node: PhysicalNode) -> Optional[int]:
+    """Output channel count for the provenance-walkable node shapes
+    (scan/filter/project/exchange/limit/inner-join trees)."""
+    if isinstance(node, (Filter, Exchange, Limit)):
+        return channel_width(node.source)
+    if isinstance(node, Project):
+        return len(node.exprs)
+    if isinstance(node, TableScan):
+        return len(node.columns)
+    if isinstance(node, HashJoin):
+        if node.join_type not in ("inner", "left", "right", "full"):
+            return None  # semi/anti output = left + one match channel
+        left = channel_width(node.left)
+        right = channel_width(node.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    return None
+
+
+def scan_column_of(node: PhysicalNode, ch: int):
+    """Provenance of channel ch: the (catalog, table, column) it
+    carries unchanged, walked through filters, limits, exchanges,
+    identity projections, and join concatenation — None when computed.
+    Shared by the DCN hash-repartition planner (which must prove a
+    join key IS a table column to co-partition scans on it) and any
+    other layout reasoning (reference analog: symbol -> column mapping
+    in table layouts)."""
+    from presto_tpu.expr.ir import InputRef
+
+    if isinstance(node, (Filter, Exchange, Limit)):
+        return scan_column_of(node.source, ch)
+    if isinstance(node, Project):
+        e = node.exprs[ch]
+        if isinstance(e, InputRef):
+            return scan_column_of(node.source, e.channel)
+        return None
+    if isinstance(node, TableScan):
+        return (node.catalog, node.table, node.columns[ch])
+    if isinstance(node, HashJoin):
+        if node.join_type not in ("inner", "left", "right", "full"):
+            return None  # semi/anti output = left + one match channel
+        # join output = left channels then right channels
+        left_w = channel_width(node.left)
+        if left_w is None:
+            return None
+        if ch < left_w:
+            return scan_column_of(node.left, ch)
+        return scan_column_of(node.right, ch - left_w)
+    return None
+
+
 def scan_column_unique(node: PhysicalNode, ch: int, catalogs) -> bool:
     """Whether channel ch of node provably carries a connector-declared
     unique column, walked through filters, limits, exchanges, and

@@ -184,14 +184,28 @@ class QueryManager:
         # interleave while the arbiter keeps the sum under budget
         runner = self._runner_factory(q.session)
         est = runner.estimate_memory(q.sql)
-        if not self.memory.acquire(est,
-                                   should_abort=lambda: q.cancelled):
-            self._record_completion(q)
-            return
+        group = getattr(q, "resource_group", None)
+        if group is not None and self.resource_groups is not None:
+            # per-group memory quotas gate before the global arbiter
+            # (reference: soft_memory_limit per resource group)
+            if not self.resource_groups.reserve_memory(
+                group, est, should_abort=lambda: q.cancelled
+            ):
+                self._record_completion(q)
+                return
         try:
-            self._execute(q, runner)
+            if not self.memory.acquire(
+                est, should_abort=lambda: q.cancelled
+            ):
+                self._record_completion(q)
+                return
+            try:
+                self._execute(q, runner)
+            finally:
+                self.memory.release(est)
         finally:
-            self.memory.release(est)
+            if group is not None and self.resource_groups is not None:
+                self.resource_groups.release_memory(group, est)
 
     def _execute(self, q: _Query, runner=None) -> None:
             if q.cancelled:

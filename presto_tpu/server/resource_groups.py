@@ -1,15 +1,19 @@
-"""Resource groups: admission control for inter-query concurrency.
+"""Hierarchical resource groups: admission control for inter-query
+concurrency, queueing, and memory.
 
 Reference: presto-main resourceGroups/* (InternalResourceGroupManager,
-ResourceGroupSpec) — hierarchical groups with hard_concurrency_limit and
-max_queued per group, selected per query by user/source; queries beyond
-the queue limit are rejected with QUERY_QUEUE_FULL. The TPU engine keeps
-the flat version (SURVEY §3.3: "simple admission queue first; full RG
-later"): named groups with concurrency + queue limits and user-pattern
-selectors. The device itself serializes execution (one query on the
-chip), so hard_concurrency here bounds how many queries may be
-in-flight (RUNNING or waiting on the device lock) rather than how many
-execute simultaneously.
+InternalResourceGroup, ResourceGroupSpec) — a TREE of groups, each with
+hard_concurrency_limit, max_queued, and soft_memory_limit; selectors
+pick a LEAF group per query (user regex here), and a query consumes a
+queue slot, then a concurrency slot, then memory, at EVERY level of its
+group path — a burst in one subgroup cannot starve its siblings beyond
+the parent's quota. Queries beyond a queue limit are rejected with
+QUERY_QUEUE_FULL.
+
+The device itself serializes execution (one query on the chip), so
+hard_concurrency bounds how many queries may be in-flight (RUNNING or
+waiting on the device/memory arbiter) rather than how many execute
+simultaneously.
 """
 
 from __future__ import annotations
@@ -17,18 +21,39 @@ from __future__ import annotations
 import dataclasses
 import re
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
 class ResourceGroupSpec:
-    """One group (reference: ResourceGroupSpec in resource-group JSON
-    config): selector is a regex over the session user."""
+    """One group node (reference: ResourceGroupSpec in the resource-
+    group JSON config). ``sub_groups`` makes it a tree; a query selects
+    the first matching LEAF depth-first. max_memory_bytes = 0 means no
+    memory quota at this level."""
 
     name: str
     user_pattern: str = ".*"
     hard_concurrency: int = 1
     max_queued: int = 100
+    max_memory_bytes: int = 0
+    sub_groups: Tuple["ResourceGroupSpec", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupSelection:
+    """A query's admitted path: root-to-leaf chain of specs plus the
+    dotted path names (reference: ResourceGroupId)."""
+
+    specs: Tuple[ResourceGroupSpec, ...]
+    paths: Tuple[str, ...]
+
+    @property
+    def leaf(self) -> ResourceGroupSpec:
+        return self.specs[-1]
+
+    @property
+    def name(self) -> str:
+        return self.paths[-1]
 
 
 class QueryQueueFullError(RuntimeError):
@@ -36,74 +61,146 @@ class QueryQueueFullError(RuntimeError):
 
 
 class ResourceGroupManager:
-    """Admission: pick the first matching group; reject when its queue is
-    full; callers acquire before running and release after."""
+    """Admission: select the first matching leaf (depth-first); a query
+    holds a queue slot, then a concurrency slot, then (optionally)
+    reserved memory at EVERY level of its path."""
 
     def __init__(self, groups: Optional[List[ResourceGroupSpec]] = None):
         self.groups = list(groups or [ResourceGroupSpec("global")])
         self._lock = threading.Lock()
-        self._running = {g.name: 0 for g in self.groups}
-        self._queued = {g.name: 0 for g in self.groups}
         self._cv = threading.Condition(self._lock)
+        self._running: Dict[str, int] = {}
+        self._queued: Dict[str, int] = {}
+        self._memory: Dict[str, int] = {}
+        self._all_paths: List[Tuple[str, ResourceGroupSpec]] = []
 
-    def select(self, user: str) -> ResourceGroupSpec:
+        def walk(g: ResourceGroupSpec, prefix: str):
+            path = f"{prefix}.{g.name}" if prefix else g.name
+            self._running[path] = 0
+            self._queued[path] = 0
+            self._memory[path] = 0
+            self._all_paths.append((path, g))
+            for s in g.sub_groups:
+                walk(s, path)
+
         for g in self.groups:
-            if re.fullmatch(g.user_pattern, user or ""):
-                return g
+            walk(g, "")
+
+    # ---------------------------------------------------------- selection
+    def select(self, user: str) -> GroupSelection:
+        def descend(g: ResourceGroupSpec, prefix: str):
+            if not re.fullmatch(g.user_pattern, user or ""):
+                return None
+            path = f"{prefix}.{g.name}" if prefix else g.name
+            if not g.sub_groups:
+                return ((g,), (path,))
+            for s in g.sub_groups:
+                found = descend(s, path)
+                if found is not None:
+                    return ((g,) + found[0], (path,) + found[1])
+            return None  # parent matched but no leaf did
+
+        for g in self.groups:
+            found = descend(g, "")
+            if found is not None:
+                return GroupSelection(found[0], found[1])
         raise QueryQueueFullError(
             f"no resource group matches user {user!r}"
         )
 
-    def admit(self, user: str) -> ResourceGroupSpec:
-        """Admission check at submit time: raises QueryQueueFullError when
-        the group's queue is at capacity (reference: the coordinator
-        rejects before planning)."""
-        g = self.select(user)
+    # ---------------------------------------------------------- admission
+    def admit(self, user: str) -> GroupSelection:
+        """Queue-slot check at submit time, at every level (reference:
+        the coordinator rejects before planning)."""
+        sel = self.select(user)
         with self._lock:
-            if self._queued[g.name] >= g.max_queued:
-                raise QueryQueueFullError(
-                    f"resource group {g.name!r} queue is full "
-                    f"({g.max_queued})"
-                )
-            self._queued[g.name] += 1
-        return g
+            for spec, path in zip(sel.specs, sel.paths):
+                if self._queued[path] >= spec.max_queued:
+                    raise QueryQueueFullError(
+                        f"resource group {path!r} queue is full "
+                        f"({spec.max_queued})"
+                    )
+            for path in sel.paths:
+                self._queued[path] += 1
+        return sel
 
-    def acquire(self, group: ResourceGroupSpec, should_abort=None) -> bool:
-        """Block until the group has a concurrency slot (QUEUED ->
-        RUNNING transition). should_abort() is polled so a query
-        canceled while queued releases its queue slot instead of
-        blocking forever and then consuming a run slot; returns False
-        when aborted (queue slot already released)."""
+    def acquire(self, sel: GroupSelection, should_abort=None) -> bool:
+        """Block until every level of the path has a concurrency slot
+        (QUEUED -> RUNNING). Returns False when aborted (queue slots
+        already released)."""
         with self._cv:
-            while self._running[group.name] >= group.hard_concurrency:
+            while any(
+                self._running[path] >= spec.hard_concurrency
+                for spec, path in zip(sel.specs, sel.paths)
+            ):
                 if should_abort is not None and should_abort():
-                    self._queued[group.name] -= 1
+                    for path in sel.paths:
+                        self._queued[path] -= 1
                     return False
                 self._cv.wait(timeout=0.05)
-            self._queued[group.name] -= 1
-            self._running[group.name] += 1
+            for path in sel.paths:
+                self._queued[path] -= 1
+                self._running[path] += 1
             return True
 
-    def release(self, group: ResourceGroupSpec) -> None:
+    def release(self, sel: GroupSelection) -> None:
         with self._cv:
-            self._running[group.name] -= 1
+            for path in sel.paths:
+                self._running[path] -= 1
             self._cv.notify_all()
 
-    def cancel_queued(self, group: ResourceGroupSpec) -> None:
-        """A query canceled before acquire gives its queue slot back."""
+    def cancel_queued(self, sel: GroupSelection) -> None:
+        """A query canceled before acquire gives its queue slots back."""
         with self._lock:
-            self._queued[group.name] -= 1
+            for path in sel.paths:
+                self._queued[path] -= 1
 
+    # ------------------------------------------------------------- memory
+    def reserve_memory(self, sel: GroupSelection, nbytes: int,
+                       should_abort=None) -> bool:
+        """Block until the estimate fits under every level's memory
+        quota (reference: soft_memory_limit gating eligibility). A
+        query larger than a quota alone is admitted only when that
+        group holds no other memory, mirroring the MemoryArbiter's
+        stance. Returns False when aborted."""
+        with self._cv:
+            while True:
+                blocked = False
+                for spec, path in zip(sel.specs, sel.paths):
+                    limit = spec.max_memory_bytes
+                    if not limit:
+                        continue
+                    used = self._memory[path]
+                    if used + nbytes > limit and used > 0:
+                        blocked = True
+                        break
+                if not blocked:
+                    for path in sel.paths:
+                        self._memory[path] += nbytes
+                    return True
+                if should_abort is not None and should_abort():
+                    return False
+                self._cv.wait(timeout=0.05)
+
+    def release_memory(self, sel: GroupSelection, nbytes: int) -> None:
+        with self._cv:
+            for path in sel.paths:
+                self._memory[path] -= nbytes
+            self._cv.notify_all()
+
+    # ----------------------------------------------------------- introspection
     def snapshot(self) -> List[dict]:
         with self._lock:
             return [
                 {
-                    "name": g.name,
+                    "name": path,
                     "userPattern": g.user_pattern,
                     "hardConcurrency": g.hard_concurrency,
                     "maxQueued": g.max_queued,
-                    "running": self._running[g.name],
-                    "queued": self._queued[g.name],
+                    "maxMemoryBytes": g.max_memory_bytes,
+                    "running": self._running[path],
+                    "queued": self._queued[path],
+                    "reservedMemoryBytes": self._memory[path],
                 }
-                for g in self.groups
+                for path, g in self._all_paths
             ]

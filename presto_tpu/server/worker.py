@@ -99,6 +99,103 @@ def fanout_safe(cut: P.Aggregation, split_table: str) -> bool:
     return state["ok"] and state["scans"] == 1
 
 
+def hash_fanout_plan(cut: P.Aggregation, catalogs,
+                     partition_threshold: int = 1 << 17):
+    """Co-partitioning spec for a PARTITIONED JOIN fan-out (the DCN
+    hash-repartition exchange; reference: AddExchanges choosing
+    REPARTITION and inserting hash exchanges on both join sides).
+
+    Returns {table: partition_column} covering every BIG scanned table
+    (row_count >= partition_threshold), or None when the shape does
+    not co-partition. Valid shape below the cut: Filter / Project /
+    Exchange / TableScan / INNER hash joins; every join with big
+    tables on BOTH sides must equi-join on single keys that are
+    provably those tables' columns (exec/plan.scan_column_of), and
+    each big table must receive exactly ONE partition column; small
+    tables replicate (broadcast side). Decomposability of the
+    aggregates themselves follows fanout_safe's rules (no DISTINCT
+    masks)."""
+    if any(s.mask is not None for s in cut.aggregates):
+        return None
+    parts: dict = {}
+    state = {"ok": True}
+
+    def big_tables_under(n) -> set:
+        out = set()
+
+        def walk(x):
+            if isinstance(x, P.TableScan):
+                if catalogs[x.catalog].row_count(x.table) >= \
+                        partition_threshold:
+                    out.add(x.table)
+                return
+            for c in x.children():
+                walk(c)
+
+        walk(n)
+        return out
+
+    def assign(table: str, column: str):
+        if parts.get(table, column) != column:
+            state["ok"] = False  # conflicting partition keys
+        parts[table] = column
+
+    def walk(n):
+        if not state["ok"]:
+            return
+        if isinstance(n, (P.Filter, P.Project, P.Exchange,
+                          P.TableScan)):
+            for c in n.children():
+                walk(c)
+            return
+        if isinstance(n, P.HashJoin):
+            if n.join_type != "inner":
+                state["ok"] = False
+                return
+            left_big = big_tables_under(n.left)
+            right_big = big_tables_under(n.right)
+            if left_big and right_big:
+                # partitioned join: both sides keyed by their own
+                # table columns, co-partitioned on this equi-key
+                if len(n.left_keys) < 1:
+                    state["ok"] = False
+                    return
+                lsrc = P.scan_column_of(n.left, n.left_keys[0])
+                rsrc = P.scan_column_of(n.right, n.right_keys[0])
+                if lsrc is None or rsrc is None:
+                    state["ok"] = False
+                    return
+                # dictionary codes are table-local (same rule as
+                # executor._keys_partitionable): equal string values
+                # would hash to different workers on each side —
+                # refuse string/dictionary-typed partition keys
+                from presto_tpu import types as T
+
+                for cat, table, col in (lsrc, rsrc):
+                    t = catalogs[cat].table_schema(
+                        table).column_type(col)
+                    if T.is_string(t) or t.is_dictionary_encoded:
+                        state["ok"] = False
+                        return
+                # the key must constrain EVERY big table on its side —
+                # a second big table not keyed by this join cannot be
+                # co-partitioned
+                if left_big != {lsrc[1]} or right_big != {rsrc[1]}:
+                    state["ok"] = False
+                    return
+                assign(f"{lsrc[0]}.{lsrc[1]}", lsrc[2])
+                assign(f"{rsrc[0]}.{rsrc[1]}", rsrc[2])
+            walk(n.left)
+            walk(n.right)
+            return
+        state["ok"] = False
+
+    walk(cut.source)
+    if not state["ok"] or len(parts) < 2:
+        return None
+    return parts
+
+
 def largest_table(node: P.PhysicalNode, catalogs) -> Optional[str]:
     """The fact table to split across workers: the scanned table with
     the most rows under this subtree (SOURCE_DISTRIBUTION pick)."""
@@ -294,15 +391,35 @@ class WorkerServer:
 
     def _run_task(self, task: _Task, req: Dict) -> None:
         try:
+            from presto_tpu.connectors.split_filter import (
+                HashSplitConnector,
+            )
             from presto_tpu.runner import LocalRunner
 
-            split_table = req["splitTable"]
             index, count = int(req["splitIndex"]), int(req["splitCount"])
-            catalogs = {
-                name: SplitFilterConnector(conn, split_table, index,
-                                           count)
-                for name, conn in self.catalogs.items()
-            }
+            if req.get("splitMode") == "hash":
+                # hash-repartition exchange: co-partitioned scans
+                # (see HashSplitConnector); the spec is keyed
+                # "catalog.table" so a same-named table in another
+                # catalog replicates untouched
+                part_cols = req["partitionColumns"]
+                catalogs = {
+                    name: HashSplitConnector(
+                        conn,
+                        {t.split(".", 1)[1]: c
+                         for t, c in part_cols.items()
+                         if t.split(".", 1)[0] == name},
+                        index, count,
+                    )
+                    for name, conn in self.catalogs.items()
+                }
+            else:
+                split_table = req["splitTable"]
+                catalogs = {
+                    name: SplitFilterConnector(conn, split_table,
+                                               index, count)
+                    for name, conn in self.catalogs.items()
+                }
             session = Session(catalog=self.default_catalog or
                               next(iter(catalogs)))
             for k, v in (req.get("session") or {}).items():

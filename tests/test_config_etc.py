@@ -1,0 +1,166 @@
+"""etc/ deployment config + hierarchical resource groups.
+
+Reference: presto-server's etc/config.properties +
+etc/catalog/*.properties (StaticCatalogStore), and resourceGroups/*
+nested quotas (InternalResourceGroup).
+"""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.config import (
+    load_catalogs,
+    load_node_config,
+    parse_properties,
+    server_from_etc,
+)
+from presto_tpu.server.resource_groups import (
+    QueryQueueFullError,
+    ResourceGroupManager,
+    ResourceGroupSpec,
+)
+
+
+@pytest.fixture()
+def etc(tmp_path):
+    (tmp_path / "catalog").mkdir()
+    (tmp_path / "config.properties").write_text(
+        "# node tier\n"
+        "http-server.http.port=0\n"
+        "default-catalog=tiny\n"
+    )
+    (tmp_path / "catalog" / "tiny.properties").write_text(
+        "connector.name=tpch\n"
+        "tpch.scale-factor=0.001\n"
+    )
+    (tmp_path / "catalog" / "mem.properties").write_text(
+        "connector.name=memory\n"
+    )
+    return str(tmp_path)
+
+
+def test_parse_properties(tmp_path):
+    p = tmp_path / "x.properties"
+    p.write_text("# c\n a = b \n\n! bang\nk=v=w\n")
+    assert parse_properties(str(p)) == {"a": "b", "k": "v=w"}
+    p.write_text("nokey\n")
+    with pytest.raises(ValueError, match="key=value"):
+        parse_properties(str(p))
+
+
+def test_load_catalogs(etc):
+    cats = load_catalogs(etc)
+    assert sorted(cats) == ["mem", "tiny"]
+    assert "lineitem" in cats["tiny"].tables()
+    assert load_node_config(etc)["default-catalog"] == "tiny"
+
+
+def test_load_catalogs_unknown_connector(tmp_path):
+    (tmp_path / "catalog").mkdir()
+    (tmp_path / "catalog" / "bad.properties").write_text(
+        "connector.name=hive\n"
+    )
+    with pytest.raises(ValueError, match="unknown connector.name"):
+        load_catalogs(str(tmp_path))
+
+
+def test_server_from_etc(etc):
+    srv = server_from_etc(etc)
+    srv.start()
+    try:
+        from presto_tpu.client import StatementClient
+
+        c = StatementClient(server=f"http://127.0.0.1:{srv.port}")
+        assert c.execute(
+            "select count(*) from nation"
+        ).rows[0][0] == 25
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- hierarchical groups
+
+def _tree():
+    return ResourceGroupManager([
+        ResourceGroupSpec(
+            "global", hard_concurrency=2, max_queued=10,
+            max_memory_bytes=1000,
+            sub_groups=(
+                ResourceGroupSpec("etl", user_pattern="etl_.*",
+                                  hard_concurrency=1, max_queued=1),
+                ResourceGroupSpec("adhoc", hard_concurrency=2,
+                                  max_queued=10,
+                                  max_memory_bytes=600),
+            ),
+        )
+    ])
+
+
+def test_leaf_selection_and_paths():
+    m = _tree()
+    s = m.select("etl_nightly")
+    assert s.paths == ("global", "global.etl")
+    s2 = m.select("alice")
+    assert s2.paths == ("global", "global.adhoc")
+
+
+def test_queue_limit_at_every_level():
+    m = _tree()
+    a = m.admit("etl_1")
+    assert m.acquire(a)
+    b = m.admit("etl_2")  # queued in global.etl (limit 1)
+    with pytest.raises(QueryQueueFullError, match="global.etl"):
+        m.admit("etl_3")
+    m.cancel_queued(b)
+    m.release(a)
+
+
+def test_parent_concurrency_caps_children():
+    # global allows 2; adhoc allows 2; etl allows 1 — a 3rd query
+    # blocks on the PARENT even though adhoc has a free slot
+    m = _tree()
+    a = m.admit("alice")
+    assert m.acquire(a)
+    b = m.admit("etl_x")
+    assert m.acquire(b)
+    c = m.admit("bob")
+    got = []
+    t = threading.Thread(target=lambda: got.append(m.acquire(c)))
+    t.start()
+    time.sleep(0.15)
+    assert not got, "third query must wait on the parent quota"
+    m.release(a)
+    t.join(timeout=2)
+    assert got == [True]
+    m.release(b)
+    m.release(c)
+
+
+def test_memory_quota_per_level():
+    m = _tree()
+    a = m.admit("alice")
+    assert m.acquire(a)
+    assert m.reserve_memory(a, 500)
+    b = m.admit("bob")
+    assert m.acquire(b)
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(m.reserve_memory(b, 500))
+    )
+    t.start()
+    time.sleep(0.15)
+    assert not done, "500+500 exceeds adhoc's 600-byte quota"
+    m.release_memory(a, 500)
+    t.join(timeout=2)
+    assert done == [True]
+    m.release_memory(b, 500)
+    m.release(a)
+    m.release(b)
+
+
+def test_snapshot_reports_tree():
+    m = _tree()
+    names = [s["name"] for s in m.snapshot()]
+    assert names == ["global", "global.etl", "global.adhoc"]

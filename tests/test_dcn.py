@@ -179,3 +179,54 @@ def test_session_props_reach_both_halves(workers, single):
     # the coordinator-side final stage honored the session (spill knob
     # reached the shared executor through apply_session)
     assert coord.runner.executor.spill_bytes == 1 << 15
+
+
+def test_partitioned_join_across_workers(workers, single):
+    """VERDICT r3 #5: a PARTITIONED join (both sides hash-split on the
+    join key — the DCN repartition exchange) across 2 workers matches
+    single-process. partition_threshold=1 forces every scanned table
+    into the co-partitioned set at this tiny SF."""
+    # threshold between customer (1.5k) and orders (15k) at SF0.01:
+    # orders+lineitem co-partition on orderkey, customer replicates.
+    # (threshold=1 would make customer "big" too — orders would then
+    # need BOTH o_custkey and o_orderkey partition keys, which the
+    # analyzer correctly refuses.)
+    coord = DcnRunner({"tpch": TpchConnector(SF)}, workers,
+                      default_catalog="tpch", page_rows=PAGE_ROWS,
+                      partition_threshold=10_000)
+    want = single.execute(QUERIES[3]).rows
+    got = coord.execute(QUERIES[3])
+    assert coord.last_distribution == "hash"
+    assert rows_equal(want, got), "partitioned Q3 diverged"
+
+
+def test_partitioned_join_covers_null_keys(workers, single):
+    # rows with NULL partition keys land on exactly one worker; an
+    # inner join drops them either way but the partial agg below the
+    # cut must not double-count them
+    coord = DcnRunner({"tpch": TpchConnector(SF)}, workers,
+                      default_catalog="tpch", page_rows=PAGE_ROWS,
+                      partition_threshold=10_000)
+    q = ("select o_orderpriority, count(*), sum(l_quantity) "
+         "from orders, lineitem where o_orderkey = l_orderkey "
+         "group by o_orderpriority")
+    want = single.execute(q).rows
+    got = coord.execute(q)
+    assert coord.last_distribution == "hash"
+    assert rows_equal(want, got)
+
+
+def test_hash_fanout_shape_analysis(single):
+    from presto_tpu.server.worker import find_partial_cut, hash_fanout_plan
+
+    plan = single.plan(QUERIES[3])
+    cut = find_partial_cut(plan)
+    # threshold=1: customer+orders+lineitem all "big" — orders would
+    # need both o_custkey and o_orderkey, so the analyzer must refuse
+    assert hash_fanout_plan(cut, single.catalogs,
+                            partition_threshold=1) is None
+    # realistic threshold: orders+lineitem co-partition on orderkey
+    parts = hash_fanout_plan(cut, single.catalogs,
+                             partition_threshold=10_000)
+    assert parts == {"tpch.orders": "o_orderkey",
+                     "tpch.lineitem": "l_orderkey"}
