@@ -79,11 +79,16 @@ RUNGS = [
     # c_nationkey fan-out join is gone (sql/planner.py
     # _build_join_tree)
     ("q5_sf1", "tpch", 5, 1.0, BIG_PAGES),
-    ("q3_sf10", "tpch", 3, 10.0, SF10_PROPS),
-    ("q5_sf10", "tpch", 5, 10.0, SF10_PROPS),
     # BASELINE rung 5 (TPC-DS). SF0.25 keeps the largest join build
     # (store_returns, next_pow2 of 1.32M slots) under the same line.
     ("q17_sf025", "tpcds", 17, 0.25, ()),
+    # LAST on purpose: at SF10 the partitioned-join pipeline hangs in a
+    # device call on this axon runtime (round-4 bisect: all ~43
+    # programs compile, then the first execution never completes — the
+    # >=4M-row fault family). Ordered last so the global budget bounds
+    # the loss; recorded as a timeout rather than fictional numbers.
+    ("q3_sf10", "tpch", 3, 10.0, SF10_PROPS),
+    ("q5_sf10", "tpch", 5, 10.0, SF10_PROPS),
 ]
 HEADLINE = "q1_sf1"
 ORACLE_SF = 0.01  # small-SF correctness cross-check (fast)
@@ -190,6 +195,9 @@ def _group_cap(group) -> int:
     for _name, suite, qid, sf, _props in group:
         is_join = (suite, qid) not in (("tpch", 1), ("tpch", 6))
         cap += 420 if is_join else 120
+        if suite == "tpcds":
+            # Q17's 8-table cross-channel join compiles ~600s fresh
+            cap += 600
         if sf >= 10:
             cap += 480 if is_join else 120
         if sf >= 100:
@@ -279,6 +287,13 @@ def main() -> int:
                  "BENCH_SQLITE_BUDGET_S": str(sq_budget)},
         )
         cache = info or {}
+        if not cache:
+            # child died mid-compute: fall back to the persisted cache
+            # so already-measured baselines still publish
+            bp = os.path.join(REPO, "bench_baseline.json")
+            if os.path.exists(bp):
+                with open(bp) as f:
+                    cache = json.load(f)
         for name, suite, qid, sf, _props in RUNGS:
             prefix = "" if suite == "tpch" else f"{suite}_"
             key = f"{prefix}q{qid}_sf{sf}"
@@ -422,10 +437,17 @@ def group_child(only_names) -> int:
         pages, flags = run_device()
         compile_s = time.time() - t0
         times = []
-        for _ in range(REPS):
+        # adaptive reps: a rung whose first timed run is already slow
+        # gets one rep — median-of-3 precision is not worth 2 extra
+        # minutes of budget on a 60s+ rung
+        reps = REPS
+        for i in range(reps):
             t0 = time.time()
             pages, flags = run_device()
-            times.append(time.time() - t0)
+            dt = time.time() - t0
+            times.append(dt)
+            if i == 0 and dt > 60:
+                break
         steady = statistics.median(times)
         # the last timed run doubles as the validation run: same plan,
         # same initial capacities; pages/flags decode at the end
@@ -673,6 +695,15 @@ def sqlite_child() -> int:
                 f"{c} {styp(schema.column_type(c))}" for c in cols
             )
             db.execute(f"CREATE TABLE {table} ({decl})")
+            # join-key indexes: without them sqlite nested-loops the
+            # multi-way joins (observed: Q5 SF1 > 35 min un-indexed);
+            # indexing is standard practice for a comparison engine
+            # and makes the baseline FAIRER to sqlite, not worse
+            for c in cols:
+                if c.endswith("key") or c.endswith("_sk"):
+                    db.execute(
+                        f"CREATE INDEX idx_{table}_{c} ON {table}({c})"
+                    )
             ins = (f"INSERT INTO {table} VALUES "
                    f"({', '.join('?' for _ in cols)})")
             for page in connector.pages(table, cols):
@@ -707,7 +738,10 @@ def sqlite_child() -> int:
         key = f"{prefix}q{qid}_sf{sf}"
         if cache.get(key) is not None or sf > MAX_SQLITE_SF:
             continue
-        if time.time() > deadline - 60:
+        if time.time() > deadline - 600:
+            # one uncached rung costs MINUTES (table load + query);
+            # a 60s margin would start a rung it cannot finish and the
+            # orchestrator would lose the whole child to the hard kill
             print(f"# sqlite {key}: skipped (budget)", file=sys.stderr)
             continue
         try:
@@ -728,6 +762,12 @@ def sqlite_child() -> int:
             t0 = time.time()
             db.execute(sql).fetchall()
             cache[key] = min(first, time.time() - t0)
+            # persist per entry: a later rung's timeout must not lose
+            # this one's minutes of work
+            with open(cache_path, "w") as f:
+                json.dump(
+                    {k: v for k, v in cache.items() if v is not None},
+                    f, indent=1, sort_keys=True)
         except Exception:  # pragma: no cover - never poison the cache
             cache[key] = None
     with open(cache_path, "w") as f:

@@ -60,8 +60,9 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="start an in-process coordinator first")
     ap.add_argument("--port", type=int, default=None,
-                    help="listen port for --serve (default: the "
-                    "etc config's http-server.http.port, else 8080)")
+                    help="listen port for --serve (default: the etc "
+                    "config's http-server.http.port when --etc-dir is "
+                    "given, else 8080)")
     ap.add_argument("--scale", type=float, default=0.01,
                     help="tpch catalog scale factor for --serve")
     ap.add_argument("--etc-dir",

@@ -130,7 +130,7 @@ def server_from_etc(etc_dir: str, port: Optional[int] = None, **kw):
             f"no catalogs found under {etc_dir}/catalog/*.properties"
         )
     if port is None:
-        port = int(conf.get("http-server.http.port", "0"))
+        port = int(conf.get("http-server.http.port", "8080"))
     mem = int(conf.get("query.max-memory-bytes", "0")) or None
     default_catalog = conf.get(
         "default-catalog", sorted(catalogs)[0]

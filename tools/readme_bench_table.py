@@ -1,0 +1,63 @@
+"""Render README's Measured table FROM the committed BENCH_DETAILS.json
+(VERDICT r3 #10: the docs must be generated from the artifact, never
+hand-copied). Prints a markdown table; `--write` splices it into
+README.md between the BENCH-TABLE markers.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BEGIN = "<!-- BENCH-TABLE BEGIN (tools/readme_bench_table.py) -->"
+END = "<!-- BENCH-TABLE END -->"
+
+
+def render() -> str:
+    with open(os.path.join(REPO, "BENCH_DETAILS.json")) as f:
+        d = json.load(f)
+    lines = [
+        BEGIN,
+        "| rung | steady (s) | rows | validated | vs sqlite |",
+        "|---|---|---|---|---|",
+    ]
+    for name in sorted(d.get("rungs", {})):
+        r = d["rungs"][name]
+        if r.get("steady_s") is not None:
+            steady = f"{r['steady_s']:.3f}"
+        else:
+            steady = f"— ({(r.get('time_error') or '?')[:40]})"
+        rows = r.get("result_rows", "—")
+        valid = "yes" if r.get("valid") else "no"
+        sp = r.get("speedup_vs_sqlite")
+        sp = f"{sp}x" if sp else "—"
+        lines.append(f"| {name} | {steady} | {rows} | {valid} | {sp} |")
+    lines.append(
+        f"\nHonest drain-protocol timing (see ROOFLINE.md); backend "
+        f"{d.get('backend', '?')} on {d.get('device', '?')}."
+    )
+    lines.append(END)
+    return "\n".join(lines)
+
+
+def main() -> int:
+    table = render()
+    if "--write" in sys.argv:
+        path = os.path.join(REPO, "README.md")
+        src = open(path).read()
+        if BEGIN in src and END in src:
+            head = src[: src.index(BEGIN)]
+            tail = src[src.index(END) + len(END):]
+            open(path, "w").write(head + table + tail)
+            print("README.md updated")
+        else:
+            print("markers not found in README.md", file=sys.stderr)
+            return 1
+    else:
+        print(table)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
