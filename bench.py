@@ -29,7 +29,7 @@ runs each phase as a bounded subprocess holding the chip exclusively:
   3. --sqlite-child: wall-clock sqlite3 baselines on CPU jax (cached in
      bench_baseline.json; the child never touches the TPU).
 
-A global deadline (BENCH_BUDGET_S, default 2400s) bounds the ladder:
+A global deadline (BENCH_BUDGET_S, default 1200s) bounds the ladder:
 each phase gets min(its cap, remaining budget); whatever happens, the
 final driver JSON line prints (phases skipped for budget are recorded
 in BENCH_DETAILS.json, never silently dropped).
@@ -82,14 +82,21 @@ RUNGS = [
     # BASELINE rung 5 (TPC-DS). SF0.25 keeps the largest join build
     # (store_returns, next_pow2 of 1.32M slots) under the same line.
     ("q17_sf025", "tpcds", 17, 0.25, ()),
-    # LAST on purpose: at SF10 the partitioned-join pipeline hangs in a
-    # device call on this axon runtime (round-4 bisect: all ~43
-    # programs compile, then the first execution never completes — the
-    # >=4M-row fault family). Ordered last so the global budget bounds
-    # the loss; recorded as a timeout rather than fictional numbers.
+]
+# At SF10 the partitioned-join pipeline has hung in a device call on
+# this axon runtime (round-4 bisect: all ~43 programs compile, then the
+# first execution never completes — the >=4M-row fault family). Two
+# consecutive driver benches (r3, r4) died rc=124 partly because these
+# rungs burned ~2040s of group cap before the global kill. They are
+# EXCLUDED by default and recorded as skipped in BENCH_DETAILS.json;
+# set BENCH_INCLUDE_SF10_JOINS=1 to opt in after re-verifying the hang
+# is fixed (see tools/bisect_hang.py).
+SF10_JOIN_RUNGS = [
     ("q3_sf10", "tpch", 3, 10.0, SF10_PROPS),
     ("q5_sf10", "tpch", 5, 10.0, SF10_PROPS),
 ]
+if os.environ.get("BENCH_INCLUDE_SF10_JOINS") == "1":
+    RUNGS = RUNGS + SF10_JOIN_RUNGS
 HEADLINE = "q1_sf1"
 ORACLE_SF = 0.01  # small-SF correctness cross-check (fast)
 MAX_SQLITE_SF = 1.0  # sqlite cannot hold SF10 in RAM in reasonable time
@@ -208,8 +215,17 @@ def _group_cap(group) -> int:
 def main() -> int:
     import time
 
-    budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+    # 1200s default: the driver's own (unknown) outer window killed the
+    # r3 AND r4 ladders at a harder 2400s budget before the finally
+    # could print — the in-process guarantee cannot survive an outer
+    # SIGKILL, so the whole ladder must finish comfortably early.
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1200"))
     deadline = time.time() + budget
+    # the oracle phase is BASELINE.md's per-rung correctness gate; r4
+    # skipped it for budget. Reserve its slice up front so the timing
+    # groups cannot starve it.
+    oracle_reserve = float(os.environ.get("BENCH_ORACLE_RESERVE_S", "240"))
+    timing_deadline = deadline - oracle_reserve
     # Stale results must not survive an early child crash: start clean.
     if os.path.exists(DETAILS_PATH):
         os.remove(DETAILS_PATH)
@@ -220,7 +236,7 @@ def main() -> int:
         # (observed round 3: a q3_sf10 fault killed queued timings).
         for group in _groups():
             names = [g[0] for g in group]
-            remaining = deadline - time.time()
+            remaining = timing_deadline - time.time()
             if remaining < 90:
                 details = _read_details()
                 for n in names:
@@ -243,7 +259,7 @@ def main() -> int:
                 # transient axon compile-service failures (HTTP 500 /
                 # connection resets) deserve ONE retry when budget
                 # remains; a timeout does not (it would double-spend)
-                remaining = deadline - time.time()
+                remaining = timing_deadline - time.time()
                 if remaining > 120:
                     print(f"# group {names}: retrying after: "
                           f"{err[:120]}", file=sys.stderr)
@@ -265,6 +281,14 @@ def main() -> int:
                         r["validate_error"] = err
                 _write_details(details)
                 print(f"# group {names} failed: {err}", file=sys.stderr)
+        if os.environ.get("BENCH_INCLUDE_SF10_JOINS") != "1":
+            # excluded rungs are recorded, never silently dropped
+            for name, *_rest in SF10_JOIN_RUNGS:
+                details["rungs"].setdefault(name, {})["time_error"] = (
+                    "skipped by default: known axon device hang on the "
+                    "SF10 partitioned-join pipeline "
+                    "(BENCH_INCLUDE_SF10_JOINS=1 to opt in)"
+                )
         for name, *_rest in RUNGS:
             r = details["rungs"].setdefault(name, {})
             r["valid"] = bool(
@@ -278,8 +302,13 @@ def main() -> int:
             print("# all timing children failed", file=sys.stderr)
             return 1
 
-        # ---- phase 3: sqlite baselines on CPU (cached, so usually ~0s)
-        sq_budget = max(60, min(900, deadline - time.time()))
+        # ---- phase 3: sqlite baselines on CPU (cached, so usually ~0s;
+        # bench_baseline.json is committed pre-populated — an uncached
+        # entry is the exception, so the cap stays small and the oracle
+        # reserve is honored)
+        sq_budget = max(
+            60, min(300, deadline - oracle_reserve - time.time())
+        )
         info, err = _run_child(
             [sys.executable, __file__, "--sqlite-child"],
             timeout=sq_budget + 30,
@@ -305,12 +334,12 @@ def main() -> int:
                 )
         _write_details(details)
 
-        # ---- phase 4: oracle child (engine vs sqlite at small SF);
-        # runs last — the test suite already proves correctness at
-        # small SF, so this is the first phase to drop under budget
+        # ---- phase 4: oracle child (engine vs sqlite at small SF) —
+        # BASELINE.md's per-rung correctness gate, protected by the
+        # up-front oracle_reserve so it actually runs (r4 skipped it)
         details["oracle_sf"] = ORACLE_SF
         remaining = deadline - time.time()
-        if remaining < 120:
+        if remaining < 60:
             details["oracle_ok"] = {"skipped": "bench budget exhausted"}
         else:
             info, err = _run_child(

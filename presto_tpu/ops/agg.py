@@ -389,8 +389,14 @@ def _mm_sum_int(
     dot (per-limb group sums <= 15 * n < 2^31 for any n <= 2^27), then
     recombine with wrapping u64 shifts — addition mod 2^64 distributes
     over the limb decomposition, so the result equals the two's-
-    complement int64 sum exactly, negatives included."""
-    u = jax.lax.bitcast_convert_type(data.astype(jnp.int64), jnp.uint64)
+    complement int64 sum exactly, negatives included.
+
+    int64<->uint64 moves use astype (two's-complement wrapping
+    conversion: identical bits) rather than bitcast_convert_type — the
+    axon compile service SIGSEGVs on 64-bit bitcasts (see
+    exec/executor._collect_encode), and astype avoids the op class
+    entirely."""
+    u = data.astype(jnp.int64).astype(jnp.uint64)
     limbs = jnp.stack(
         [((u >> jnp.uint64(4 * k)) & jnp.uint64(0xF)).astype(jnp.int8)
          for k in range(16)]
@@ -405,7 +411,7 @@ def _mm_sum_int(
         acc.astype(jnp.uint64) * shifts[:, None], axis=0,
         dtype=jnp.uint64,
     )
-    return jax.lax.bitcast_convert_type(total, jnp.int64)
+    return total.astype(jnp.int64)
 
 
 _MM_BACKEND: Optional[bool] = None

@@ -378,10 +378,12 @@ class CollectStateType(SqlType):
     map_agg / approx_percentile): Block data is a [cap, K] int64 slot
     matrix; a sibling BIGINT count column says how many slots each
     group uses (reference: operator/aggregation/ArrayAggregation-
-    Function's grouped BlockBuilder state). Values bit-encode into
-    int64 (doubles bitcast, dictionary-coded types by code — the
-    dictionary rides the Block); K is the array_agg_max_elements
-    session property."""
+    Function's grouped BlockBuilder state). Values encode into int64
+    (doubles via the order-preserving arithmetic sign/exponent/mantissa
+    pack in exec/executor._collect_encode — NO 64-bit bitcast, which
+    the axon compile service cannot lower; dictionary-coded types by
+    code, the dictionary riding the Block); K is the
+    array_agg_max_elements session property."""
 
     element: SqlType = dataclasses.field(default_factory=UnknownType)
     K: int = 1024
