@@ -93,13 +93,43 @@ class GeneratorConnector:
             self._gen_cache[key] = jax.jit(self.gen_body(table, n, names))
         return self._gen_cache[key]
 
+    def _lazy_rows(self, table: str, start, n: int):
+        """The table's _Lazy over rows [start, start+n). Tables whose
+        generation is elementwise in the row index expose
+        ``_gen_<table>_at(idx)`` (any int64 index array); the contiguous
+        form derives from it. Tables with slot structure (lineitem)
+        keep a dedicated ``_gen_<table>(start, n)``."""
+        at = getattr(self, f"_gen_{table}_at", None)
+        if at is not None:
+            import jax.numpy as jnp
+
+            return at(start + jnp.arange(n, dtype=jnp.int64))
+        return getattr(self, f"_gen_{table}")(start, n)
+
     def gen_body(self, table: str, n: int, names: tuple):
         """Traceable chunk generator (Connector.gen_body): pure function of
         the traced start row, safe inside jit or shard_map."""
-        gen = getattr(self, f"_gen_{table}")
 
         def fn(start):
-            lazy = gen(start, n)
+            lazy = self._lazy_rows(table, start, n)
+            return (
+                tuple(lazy.get(nm) for nm in names),
+                lazy.get("__valid__"),
+            )
+
+        return fn
+
+    def gen_at(self, table: str, names: Tuple[str, ...]):
+        """Traceable random-access generator (Connector.gen_at): pure
+        function of an arbitrary int64 row-index array. Exists exactly
+        for tables whose columns are elementwise in the row index
+        (``_gen_<table>_at``); None otherwise."""
+        at = getattr(self, f"_gen_{table}_at", None)
+        if at is None:
+            return None
+
+        def fn(idx):
+            lazy = at(idx)
             return (
                 tuple(lazy.get(nm) for nm in names),
                 lazy.get("__valid__"),
@@ -192,6 +222,43 @@ class Connector:
         generates its own split on-device. Return None if the connector
         can only produce host pages (the executor then stages host data
         shard by shard)."""
+        return None
+
+    def gen_at(self, table: str, names: Tuple[str, ...]):
+        """Optional traceable RANDOM-ACCESS generator: a pure function
+        ``row_idx_array -> (tuple of column arrays, valid mask)`` that
+        produces the named columns at arbitrary row indices (clipped to
+        the table by the caller). With key_inverse this is what makes a
+        join against this table build-free: the executor computes build
+        row ids from probe keys arithmetically and GENERATES the carried
+        columns at those ids — no hash table, no gathers (the reference's
+        LookupJoinOperator collapses to pure compute). None if the table
+        cannot be generated at scattered indices."""
+        return None
+
+    def key_inverse(self, table: str, column: str):
+        """Optional traceable inverse of a unique key column: a pure
+        function ``vals -> (row_idx int64 array, found bool array)``
+        with the contract that for every value v present in the column,
+        ``row_idx`` is the exact table row holding v and found is True;
+        for any v not present found is False (row_idx may be anything —
+        callers clip before generating). The closed-form analog of the
+        reference's LookupSource for deterministic generator tables;
+        None when no closed form exists (the engine then builds a real
+        hash index)."""
+        return None
+
+    def key_window_inverse(self, table: str, column: str):
+        """Optional traceable WINDOWED inverse: ``(fn, L)`` where
+        ``fn(vals) -> (base_idx, found)`` and every table row whose
+        column equals v lies in rows [base_idx, base_idx + L). For
+        slot-structured fact tables (ticket/order-major layouts) this
+        pins a join key to a small static candidate window; the engine
+        resolves the exact row by generating the remaining key columns
+        at each of the L candidates (exec/executor: windowed generated
+        join). The (column,...) keys tested against the window must
+        together be unique per table row. None when the column has no
+        window structure."""
         return None
 
     def pages(

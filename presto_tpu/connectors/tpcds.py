@@ -431,6 +431,66 @@ class TpcdsConnector(GeneratorConnector, Connector):
         }
         return simple.get((table, column))
 
+    def key_inverse(self, table: str, column: str):
+        """Closed-form key->row inverses (Connector.key_inverse): every
+        dimension surrogate key is row+1 (or row+JULIAN_BASE for
+        date_dim, row for time_dim) — the basis of the build-free
+        generated join against the dims."""
+        offsets = {
+            ("date_dim", "d_date_sk"): JULIAN_BASE,
+            ("item", "i_item_sk"): 1,
+            ("store", "s_store_sk"): 1,
+            ("customer", "c_customer_sk"): 1,
+            ("customer_address", "ca_address_sk"): 1,
+            ("customer_demographics", "cd_demo_sk"): 1,
+            ("household_demographics", "hd_demo_sk"): 1,
+            ("income_band", "ib_income_band_sk"): 1,
+            ("promotion", "p_promo_sk"): 1,
+            ("warehouse", "w_warehouse_sk"): 1,
+            ("ship_mode", "sm_ship_mode_sk"): 1,
+            ("reason", "r_reason_sk"): 1,
+            ("time_dim", "t_time_sk"): 0,
+            ("call_center", "cc_call_center_sk"): 1,
+            ("catalog_page", "cp_catalog_page_sk"): 1,
+            ("web_site", "web_site_sk"): 1,
+            ("web_page", "wp_web_page_sk"): 1,
+        }
+        off = offsets.get((table, column))
+        if off is None:
+            return None
+        n = self.row_count(table)
+
+        def inv(vals, off=off, n=n):
+            ridx = vals - off
+            return ridx, (ridx >= 0) & (ridx < n)
+
+        return inv
+
+    def key_window_inverse(self, table: str, column: str):
+        """Ticket/order numbers pin a fact row to its MAX_LINES-slot
+        window (slot = (ticket-1)*MAX_LINES + line): the windowed
+        generated join resolves the line by generating the remaining
+        key columns at the 11 candidates — even fact⋈fact joins
+        (store_sales ⋈ store_returns on ticket+item, Q17/Q64) run
+        build-free."""
+        tickets = {
+            ("store_sales", "ss_ticket_number"): self.n_ticket,
+            ("store_returns", "sr_ticket_number"): self.n_ticket,
+            ("catalog_sales", "cs_order_number"): self.n_corder,
+            ("catalog_returns", "cr_order_number"): self.n_corder,
+            ("web_sales", "ws_order_number"): self.n_worder,
+            ("web_returns", "wr_order_number"): self.n_worder,
+        }
+        n = tickets.get((table, column))
+        if n is None:
+            return None
+
+        def inv(vals, n=n):
+            base = (vals - 1) * MAX_LINES
+            return base, (vals >= 1) & (vals <= n)
+
+        return inv, MAX_LINES
+
     def _build_dictionaries(self):
         return {
             "date_dim": {
@@ -559,8 +619,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
 
     # ------------------------------------------------------ dimension gens
 
-    def _gen_date_dim(self, start, n: int) -> _Lazy:
-        idx = start + jnp.arange(n, dtype=jnp.int64)  # days since 1900
+    def _gen_date_dim_at(self, idx) -> _Lazy:
         lz = _Lazy()
 
         @functools.lru_cache(maxsize=1)
@@ -587,11 +646,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("d_holiday", lambda: (_unif(
             idx, "date_dim", "holiday", 0, 99) < 5).astype(jnp.int32))
         lz.put("d_fy_year", lambda: ymd()[0].astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_item(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_item_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("i_item_sk", lambda: sk)
         lz.put("i_item_id", lambda: (sk - 1).astype(jnp.int32))
@@ -629,11 +688,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
             sk, "item", "units", 0, len(ITEM_UNITS) - 1).astype(jnp.int32))
         lz.put("i_product_name", lambda: _unif(
             sk, "item", "pname", 0, 8191).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_store(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_store_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("s_store_sk", lambda: sk)
         lz.put("s_store_id", lambda: (sk - 1).astype(jnp.int32))
@@ -649,7 +708,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
             sk, "store", "manager", 0, 511).astype(jnp.int32))
         lz.put("s_market_id", lambda: _unif(
             sk, "store", "market", 1, 10).astype(jnp.int32))
-        lz.put("s_company_id", lambda: jnp.ones((n,), dtype=jnp.int32))
+        lz.put("s_company_id", lambda: jnp.ones_like(idx, dtype=jnp.int32))
         lz.put("s_city", lambda: _unif(
             sk, "store", "city", 0, 1023).astype(jnp.int32))
         lz.put("s_county", lambda: _unif(
@@ -662,11 +721,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
             sk, "store", "gmt", 5, 8))
         lz.put("s_tax_precentage", lambda: _unif(
             sk, "store", "tax", 0, 11))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_customer(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_customer_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
 
         def first_sales_day():
@@ -698,11 +757,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
             sk, "customer", "bmonth", 1, 12).astype(jnp.int32))
         lz.put("c_birth_day", lambda: _unif(
             sk, "customer", "bday", 1, 28).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_customer_address(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_customer_address_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("ca_address_sk", lambda: sk)
         lz.put("ca_address_id", lambda: (sk - 1).astype(jnp.int32))
@@ -722,18 +781,18 @@ class TpcdsConnector(GeneratorConnector, Connector):
         ).astype(jnp.int32))
         lz.put("ca_zip", lambda: _unif(
             sk, "customer_address", "zip", 0, 4095).astype(jnp.int32))
-        lz.put("ca_country", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("ca_country", lambda: jnp.zeros_like(idx, dtype=jnp.int32))
         lz.put("ca_gmt_offset", lambda: -jnp.int64(100) * _unif(
             sk, "customer_address", "gmt", 5, 8))
         lz.put("ca_location_type", lambda: _unif(
             sk, "customer_address", "loctype", 0, 2).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_customer_demographics(self, start, n: int) -> _Lazy:
+    def _gen_customer_demographics_at(self, idx) -> _Lazy:
         """Mixed-radix decode of the spec's full cross product:
         2 x 5 x 7 x 20 x 4 x 7 x 7 x 7 = 1,920,800."""
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        sk = idx + 1
         x = sk - 1
         lz = _Lazy()
         gender = x % 2
@@ -760,12 +819,12 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("cd_dep_count", lambda: dep.astype(jnp.int32))
         lz.put("cd_dep_employed_count", lambda: depemp.astype(jnp.int32))
         lz.put("cd_dep_college_count", lambda: depcol.astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_household_demographics(self, start, n: int) -> _Lazy:
+    def _gen_household_demographics_at(self, idx) -> _Lazy:
         """20 income bands x 6 buy potentials x 10 dep x 6 vehicles."""
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+        sk = idx + 1
         x = sk - 1
         lz = _Lazy()
         lz.put("hd_demo_sk", lambda: sk)
@@ -775,35 +834,35 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("hd_dep_count", lambda: ((x // 120) % 10).astype(jnp.int32))
         lz.put("hd_vehicle_count",
                lambda: ((x // 1200) % 6).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_income_band(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_income_band_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("ib_income_band_sk", lambda: sk)
         lz.put("ib_lower_bound", lambda: (
             (sk - 1) * 10_000 + jnp.where(sk > 1, 1, 0)).astype(jnp.int32))
         lz.put("ib_upper_bound", lambda: (sk * 10_000).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_promotion(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_promotion_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("p_promo_sk", lambda: sk)
         lz.put("p_promo_id", lambda: (sk - 1).astype(jnp.int32))
         lz.put("p_promo_name", lambda: (
             (sk - 1) % len(PROMO_NAMES)).astype(jnp.int32))
-        lz.put("p_cost", lambda: jnp.full((n,), 100_000, dtype=jnp.int64))
-        lz.put("p_response_target", lambda: jnp.ones((n,), dtype=jnp.int32))
+        lz.put("p_cost", lambda: jnp.full_like(idx, 100_000))
+        lz.put("p_response_target", lambda: jnp.ones_like(idx, dtype=jnp.int32))
         lz.put("p_channel_dmail", lambda: _unif(
             sk, "promotion", "dmail", 0, 1).astype(jnp.int32))
         lz.put("p_channel_email", lambda: _unif(
             sk, "promotion", "email", 0, 1).astype(jnp.int32))
         lz.put("p_channel_tv", lambda: _unif(
             sk, "promotion", "tv", 0, 1).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
     # ----------------------------------------------------- store channel
@@ -870,8 +929,8 @@ class TpcdsConnector(GeneratorConnector, Connector):
             coupon=coupon, net_paid=net_paid, ext_tax=ext_tax, **tv,
         )
 
-    def _gen_store_sales(self, start, n: int) -> _Lazy:
-        slot = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_store_sales_at(self, idx) -> _Lazy:
+        slot = idx
         lz = _Lazy()
 
         @functools.lru_cache(maxsize=1)
@@ -939,8 +998,8 @@ class TpcdsConnector(GeneratorConnector, Connector):
         out["sv"] = sv
         return out
 
-    def _gen_store_returns(self, start, n: int) -> _Lazy:
-        slot = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_store_returns_at(self, idx) -> _Lazy:
+        slot = idx
         lz = _Lazy()
 
         @functools.lru_cache(maxsize=1)
@@ -1030,8 +1089,8 @@ class TpcdsConnector(GeneratorConnector, Connector):
             promo=_unif(key, "catalog_sales", "promo", 1, self.n_promo),
         )
 
-    def _gen_catalog_sales(self, start, n: int) -> _Lazy:
-        slot = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_catalog_sales_at(self, idx) -> _Lazy:
+        slot = idx
         lz = _Lazy()
 
         @functools.lru_cache(maxsize=1)
@@ -1063,7 +1122,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
                lambda: cv()["qty"] * cv()["whole"])
         lz.put("cs_ext_list_price", lambda: cv()["qty"] * cv()["lst"])
         lz.put("cs_ext_tax", lambda: cv()["ext_tax"])
-        lz.put("cs_coupon_amt", lambda: jnp.zeros((n,), dtype=jnp.int64))
+        lz.put("cs_coupon_amt", lambda: jnp.zeros_like(idx, dtype=jnp.int64))
         lz.put("cs_ext_ship_cost", lambda: _unif(
             slot, "catalog_sales", "shipcost", 0, 5_000))
         lz.put("cs_net_paid", lambda: cv()["net_paid"])
@@ -1074,8 +1133,8 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("__valid__", lambda: cv()["valid"])
         return lz
 
-    def _gen_catalog_returns(self, start, n: int) -> _Lazy:
-        slot = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_catalog_returns_at(self, idx) -> _Lazy:
+        slot = idx
         lz = _Lazy()
 
         @functools.lru_cache(maxsize=1)
@@ -1118,8 +1177,8 @@ class TpcdsConnector(GeneratorConnector, Connector):
     # (round 3: the 24-table census — web channel, inventory, and the
     # small dimensions the long-tail queries touch)
 
-    def _gen_warehouse(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_warehouse_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("w_warehouse_sk", lambda: sk)
         lz.put("w_warehouse_id", lambda: (sk - 1).astype(jnp.int32))
@@ -1136,14 +1195,14 @@ class TpcdsConnector(GeneratorConnector, Connector):
         ).astype(jnp.int32))
         lz.put("w_zip", lambda: _unif(
             sk, "warehouse", "zip", 0, 4095).astype(jnp.int32))
-        lz.put("w_country", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("w_country", lambda: jnp.zeros_like(idx, dtype=jnp.int32))
         lz.put("w_gmt_offset", lambda: -jnp.int64(100) * _unif(
             sk, "warehouse", "gmt", 5, 8))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_ship_mode(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_ship_mode_at(self, idx) -> _Lazy:
+        sk = idx + 1
         x = sk - 1
         lz = _Lazy()
         lz.put("sm_ship_mode_sk", lambda: sk)
@@ -1156,24 +1215,24 @@ class TpcdsConnector(GeneratorConnector, Connector):
             x % len(SHIP_CARRIERS)).astype(jnp.int32))
         lz.put("sm_contract", lambda: _unif(
             sk, "ship_mode", "contract", 0, 1023).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_reason(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_reason_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("r_reason_sk", lambda: sk)
         lz.put("r_reason_id", lambda: (sk - 1).astype(jnp.int32))
         lz.put("r_reason_desc", lambda: (
             (sk - 1) % len(REASON_DESCS)).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_time_dim(self, start, n: int) -> _Lazy:
+    def _gen_time_dim_at(self, idx) -> _Lazy:
         """86,400 rows, one per second of day; every column decodes
         arithmetically from t_time_sk (like date_dim from the day
         index)."""
-        sk = start + jnp.arange(n, dtype=jnp.int64)
+        sk = idx
         hour = sk // 3600
         lz = _Lazy()
         lz.put("t_time_sk", lambda: sk)
@@ -1191,11 +1250,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
             jnp.where((hour >= 11) & (hour <= 13), 2,
                       jnp.where((hour >= 17) & (hour <= 19), 3, 0)),
         ).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_call_center(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_call_center_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("cc_call_center_sk", lambda: sk)
         lz.put("cc_call_center_id", lambda: (sk - 1).astype(jnp.int32))
@@ -1218,11 +1277,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("cc_state", lambda: _unif(
             sk, "call_center", "state", 0, len(STATES) - 1
         ).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_catalog_page(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_catalog_page_at(self, idx) -> _Lazy:
+        sk = idx + 1
         pages_per_cat = 108  # spec: ~108 pages per catalog number
         lz = _Lazy()
         lz.put("cp_catalog_page_sk", lambda: sk)
@@ -1231,7 +1290,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
                + SALES_START + ((sk - 1) // pages_per_cat) * 30)
         lz.put("cp_end_date_sk", lambda: jnp.int64(JULIAN_BASE)
                + SALES_START + ((sk - 1) // pages_per_cat) * 30 + 90)
-        lz.put("cp_department", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("cp_department", lambda: jnp.zeros_like(idx, dtype=jnp.int32))
         lz.put("cp_catalog_number", lambda: (
             (sk - 1) // pages_per_cat + 1).astype(jnp.int32))
         lz.put("cp_catalog_page_number", lambda: (
@@ -1240,11 +1299,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
             sk, "catalog_page", "desc", 0, 4095).astype(jnp.int32))
         lz.put("cp_type", lambda: (
             (sk - 1) % 3).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_web_site(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_web_site_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("web_site_sk", lambda: sk)
         lz.put("web_site_id", lambda: (sk - 1).astype(jnp.int32))
@@ -1264,11 +1323,11 @@ class TpcdsConnector(GeneratorConnector, Connector):
             sk, "web_site", "gmt", 5, 8))
         lz.put("web_tax_percentage", lambda: _unif(
             sk, "web_site", "tax", 0, 12))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_web_page(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_web_page_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         lz.put("wp_web_page_sk", lambda: sk)
         lz.put("wp_web_page_id", lambda: (sk - 1).astype(jnp.int32))
@@ -1280,7 +1339,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
             sk, "web_page", "autogen", 0, 1).astype(jnp.int32))
         lz.put("wp_customer_sk", lambda: _unif(
             sk, "web_page", "cust", 1, self.n_customer))
-        lz.put("wp_url", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("wp_url", lambda: jnp.zeros_like(idx, dtype=jnp.int32))
         lz.put("wp_type", lambda: (
             (sk - 1) % len(WP_TYPES)).astype(jnp.int32))
         lz.put("wp_char_count", lambda: _unif(
@@ -1289,13 +1348,12 @@ class TpcdsConnector(GeneratorConnector, Connector):
             sk, "web_page", "links", 2, 25).astype(jnp.int32))
         lz.put("wp_image_count", lambda: _unif(
             sk, "web_page", "images", 1, 7).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_inventory(self, start, n: int) -> _Lazy:
+    def _gen_inventory_at(self, idx) -> _Lazy:
         """Weekly (date x item x warehouse) cross product, decoded
         mixed-radix from the row index — the spec's weekly snapshots."""
-        idx = start + jnp.arange(n, dtype=jnp.int64)
         wh = idx % self.n_warehouse
         rest = idx // self.n_warehouse
         item = rest % self.n_item
@@ -1307,7 +1365,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("inv_warehouse_sk", lambda: wh + 1)
         lz.put("inv_quantity_on_hand", lambda: _unif(
             idx, "inventory", "qoh", 0, 1_000).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
     # ------------------------------------------------------ web channel
@@ -1345,8 +1403,8 @@ class TpcdsConnector(GeneratorConnector, Connector):
             promo=_unif(key, "web_sales", "promo", 1, self.n_promo),
         )
 
-    def _gen_web_sales(self, start, n: int) -> _Lazy:
-        slot = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_web_sales_at(self, idx) -> _Lazy:
+        slot = idx
         lz = _Lazy()
 
         @functools.lru_cache(maxsize=1)
@@ -1384,7 +1442,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
                lambda: wv()["qty"] * wv()["whole"])
         lz.put("ws_ext_list_price", lambda: wv()["qty"] * wv()["lst"])
         lz.put("ws_ext_tax", lambda: wv()["ext_tax"])
-        lz.put("ws_coupon_amt", lambda: jnp.zeros((n,), dtype=jnp.int64))
+        lz.put("ws_coupon_amt", lambda: jnp.zeros_like(idx, dtype=jnp.int64))
         lz.put("ws_ext_ship_cost", lambda: _unif(
             slot, "web_sales", "shipcost", 0, 5_000))
         lz.put("ws_net_paid", lambda: wv()["net_paid"])
@@ -1395,8 +1453,8 @@ class TpcdsConnector(GeneratorConnector, Connector):
         lz.put("__valid__", lambda: wv()["valid"])
         return lz
 
-    def _gen_web_returns(self, start, n: int) -> _Lazy:
-        slot = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_web_returns_at(self, idx) -> _Lazy:
+        slot = idx
         lz = _Lazy()
 
         @functools.lru_cache(maxsize=1)

@@ -484,21 +484,51 @@ class TpchConnector(GeneratorConnector, Connector):
             ("region", "r_regionkey"): lambda v: v,
         }.get((table, column))
 
-    # ---- per-table generators: return a _Lazy of column thunks over
-    # traced global row keys. All values are pure functions of row keys.
+    def key_inverse(self, table: str, column: str):
+        """Closed-form key->row inverses (Connector.key_inverse): every
+        TPC-H key column is an arithmetic function of the row index (spec
+        4.2.3 layouts), so the inverse is pure per-element compute —
+        the basis of the build-free generated join."""
+        n = self.row_count(table) if table in self._schemas else 0
 
-    def _gen_region(self, start, n: int) -> _Lazy:
-        idx = start + jnp.arange(n, dtype=jnp.int64)
+        def dense_from_1(vals):  # key = row + 1
+            found = (vals >= 1) & (vals <= n)
+            return vals - 1, found
+
+        def dense_from_0(vals):  # key = row
+            found = (vals >= 0) & (vals < n)
+            return vals, found
+
+        def okey_inv(vals):  # sparse keys, 8 used per 32 (mk_sparse)
+            m = vals - 1
+            oidx = (m // 32) * 8 + m % 32
+            found = (vals >= 1) & (m % 32 < 8) & (oidx < n)
+            return oidx, found
+
+        return {
+            ("region", "r_regionkey"): dense_from_0,
+            ("nation", "n_nationkey"): dense_from_0,
+            ("part", "p_partkey"): dense_from_1,
+            ("supplier", "s_suppkey"): dense_from_1,
+            ("customer", "c_custkey"): dense_from_1,
+            ("orders", "o_orderkey"): okey_inv,
+        }.get((table, column))
+
+    # ---- per-table generators: return a _Lazy of column thunks over
+    # traced global row keys. All values are pure functions of row keys
+    # (elementwise in the row-index array — the _at forms serve both
+    # contiguous scans and the generated join's random access).
+
+    def _gen_region_at(self, idx) -> _Lazy:
         lz = _Lazy()
         lz.put("r_regionkey", lambda: idx)
         lz.put("r_name", lambda: idx.astype(jnp.int32))
         lz.put("r_comment", lambda: _unif(
             idx, "region", "comment", 0, 511).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
-    def _gen_nation(self, start, n: int) -> _Lazy:
-        idx = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_nation_at(self, idx) -> _Lazy:
         region_map = jnp.asarray(
             np.array([r for _, r in NATIONS], dtype=np.int64)
         )
@@ -508,7 +538,7 @@ class TpchConnector(GeneratorConnector, Connector):
         lz.put("n_regionkey", lambda: region_map[jnp.clip(idx, 0, 24)])
         lz.put("n_comment", lambda: _unif(
             idx, "nation", "comment", 0, 511).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(idx, dtype=jnp.bool_))
         return lz
 
     @staticmethod
@@ -521,8 +551,8 @@ class TpchConnector(GeneratorConnector, Connector):
             + jnp.int64(100) * (pk % jnp.int64(1000))
         )
 
-    def _gen_part(self, start, n: int) -> _Lazy:
-        pk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_part_at(self, idx) -> _Lazy:
+        pk = idx + 1
         lz = _Lazy()
         lz.put("p_partkey", lambda: pk)
         lz.put("p_name", lambda: _unif(
@@ -545,11 +575,11 @@ class TpchConnector(GeneratorConnector, Connector):
         lz.put("p_retailprice", lambda: self._retail_price_cents(pk))
         lz.put("p_comment", lambda: _unif(
             pk, "part", "comment", 0, 2047).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(pk, dtype=jnp.bool_))
         return lz
 
-    def _gen_supplier(self, start, n: int) -> _Lazy:
-        sk = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_supplier_at(self, idx) -> _Lazy:
+        sk = idx + 1
         lz = _Lazy()
         nation = lambda: _unif(sk, "supplier", "nationkey", 0, 24)  # noqa
         lz.put("s_suppkey", lambda: sk)
@@ -565,7 +595,7 @@ class TpchConnector(GeneratorConnector, Connector):
             sk, "supplier", "acctbal", -99_999, 999_999))
         lz.put("s_comment", lambda: _unif(
             sk, "supplier", "comment", 0, 2047).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(sk, dtype=jnp.bool_))
         return lz
 
     def _ps_suppkey(self, pk: jnp.ndarray, i: jnp.ndarray) -> jnp.ndarray:
@@ -573,8 +603,7 @@ class TpchConnector(GeneratorConnector, Connector):
         S = jnp.int64(self.n_supplier)
         return (pk + i * (S // 4 + (pk - 1) // S)) % S + 1
 
-    def _gen_partsupp(self, start, n: int) -> _Lazy:
-        idx = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_partsupp_at(self, idx) -> _Lazy:
         pk = idx // 4 + 1
         i = idx % 4
         key = pk * 4 + i
@@ -587,11 +616,11 @@ class TpchConnector(GeneratorConnector, Connector):
             key, "partsupp", "supplycost", 100, 100_000))
         lz.put("ps_comment", lambda: _unif(
             key, "partsupp", "comment", 0, 2047).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(pk, dtype=jnp.bool_))
         return lz
 
-    def _gen_customer(self, start, n: int) -> _Lazy:
-        ck = start + jnp.arange(1, n + 1, dtype=jnp.int64)
+    def _gen_customer_at(self, idx) -> _Lazy:
+        ck = idx + 1
         nation = lambda: _unif(ck, "customer", "nationkey", 0, 24)  # noqa
         lz = _Lazy()
         lz.put("c_custkey", lambda: ck)
@@ -609,7 +638,7 @@ class TpchConnector(GeneratorConnector, Connector):
             ck, "customer", "mktsegment", 0, 4).astype(jnp.int32))
         lz.put("c_comment", lambda: _unif(
             ck, "customer", "comment", 0, 4095).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__", lambda: jnp.ones_like(ck, dtype=jnp.bool_))
         return lz
 
     # ---- orders + lineitem share per-order line computations
@@ -653,8 +682,8 @@ class TpchConnector(GeneratorConnector, Connector):
             charge=charge,
         )
 
-    def _gen_orders(self, start, n: int) -> _Lazy:
-        oidx = start + jnp.arange(n, dtype=jnp.int64)
+    def _gen_orders_at(self, oidx) -> _Lazy:
+        n = oidx.shape[0]
         okey = self._orderkey(oidx)
         lz = _Lazy()
 
@@ -693,10 +722,12 @@ class TpchConnector(GeneratorConnector, Connector):
             okey, "orders", "priority", 0, 4).astype(jnp.int32))
         lz.put("o_clerk", lambda: _unif(
             okey, "orders", "clerk", 0, self.n_clerk - 1).astype(jnp.int32))
-        lz.put("o_shippriority", lambda: jnp.zeros((n,), dtype=jnp.int32))
+        lz.put("o_shippriority",
+               lambda: jnp.zeros_like(okey, dtype=jnp.int32))
         lz.put("o_comment", lambda: _unif(
             okey, "orders", "comment", 0, 8191).astype(jnp.int32))
-        lz.put("__valid__", lambda: jnp.ones((n,), dtype=jnp.bool_))
+        lz.put("__valid__",
+               lambda: jnp.ones_like(okey, dtype=jnp.bool_))
         return lz
 
     def _gen_lineitem(self, start, n: int) -> _Lazy:

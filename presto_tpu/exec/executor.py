@@ -265,6 +265,16 @@ class Executor:
         # property); pallas_joins_used is observability for tests
         self.pallas_join = False
         self.pallas_joins_used = 0
+        # build-free generated joins (generated_join_enabled session
+        # property); generated_joins_used is observability for tests
+        self.generated_join = True
+        self.generated_joins_used = 0
+        # blocking-aggregation sizing heuristics (session properties
+        # agg_optimistic_rows / agg_compact_enabled): start group
+        # capacities tight and densify join-sparse inputs, both guarded
+        # by the overflow-retry ladder
+        self.agg_optimistic_rows = 1 << 18
+        self.agg_compact = True
         # DCN ingest registry: RemoteSource.key -> callable yielding
         # host pages (reference: ExchangeClient wiring per task)
         self.remote_sources: Dict[str, object] = {}
@@ -855,6 +865,15 @@ class Executor:
         # page_rows (join-output pages can exceed it); the per-page
         # min(..., page.capacity) below bounds each launch
         cap = _next_pow2(node.capacity * self._capacity_boost)
+        # optimistic clamp: the planner's capacity estimate has no
+        # selectivity model and routinely over-estimates 100x (Q3's
+        # 1.1M-orderkey estimate vs 11k real groups); every sort/
+        # scatter in the grouped path scales with capacity, so start
+        # tight — the boost ladder grows past it when real cardinality
+        # overflows (same escape as every capacity decision here)
+        if self.agg_optimistic_rows:
+            cap = min(cap, _next_pow2(
+                self.agg_optimistic_rows * self._capacity_boost))
         partial_fn = self._jit(
             ("agg_partial", node, self._collect_k_eff),
             functools.partial(
@@ -890,7 +909,7 @@ class Executor:
         )
         fold = _FoldBuffer(self, merge_fn, fold_cap, max_iters,
                            2 * fold_cap)
-        for page in self.pages(node.source):
+        for page in self._agg_source_pages(node):
             # distinct groups <= rows, so clip the capacity to the page
             out, overflow = partial_fn(
                 page, min(cap, _next_pow2(page.capacity)), max_iters
@@ -917,6 +936,54 @@ class Executor:
         out, overflow = final_fn(merged, fcap, max_iters)
         self._pending_overflow.append(overflow)
         yield out
+
+    def _agg_source_pages(self, node: P.Aggregation) -> Iterator[Page]:
+        """Aggregation input stream, densified through a rolling
+        compaction buffer when the source subtree contains a join: join
+        output pages keep probe capacity but are usually mostly-invalid
+        (build filters + match rate), and every sort/scatter in the
+        blocking aggregation scales with SLOT count, not valid rows.
+        Each input page merge-compacts into one accumulator page (a
+        stable argsort + output-sized gathers — cheap), so the
+        aggregation usually runs ONCE over one dense page instead of
+        once per sparse page plus merges. Rows beyond the accumulator
+        flag overflow and ride the boosted-retry ladder (reference
+        analog: every Presto operator re-compacts via PageBuilder —
+        pages are always dense there)."""
+        yield from self._compacted_stream(node.source, node)
+
+    def _compacted_stream(self, src: P.PhysicalNode,
+                          key_node) -> Iterator[Page]:
+        if not self.agg_compact or not _subtree_has_join(src):
+            yield from self.pages(src)
+            return
+        C = _next_pow2(
+            max(self.agg_optimistic_rows or (1 << 18), 8192)
+            * self._capacity_boost
+        )
+        if C > (1 << 21):
+            # the accumulator itself would approach the axon >=4M-row
+            # fault line — a stream this dense gains nothing from
+            # compaction; fall back to the plain per-page flow
+            yield from self.pages(src)
+            return
+        first = self._jit(
+            ("stream_compact1", key_node), _compact_with_flag,
+            static_argnums=(1,),
+        )
+        merge = self._jit(
+            ("stream_compact2", key_node), _merge_compact_flag,
+            static_argnums=(2,),
+        )
+        acc = None
+        for page in self.pages(src):
+            if acc is None:
+                acc, overflow = first(page, C)
+            else:
+                acc, overflow = merge(acc, page, C)
+            self._pending_overflow.append(overflow)
+        if acc is not None:
+            yield acc
 
     def _exec_agg_partitioned(
         self, node: P.Aggregation, parts: int, in_types, layouts
@@ -1059,7 +1126,7 @@ class Executor:
             _FoldBuffer(self, merge_fn, pcap, max_iters, 2 * pcap)
             for _ in range(parts)
         ]
-        for page in self.pages(node.source):
+        for page in self._agg_source_pages(node):
             out, overflow = partial_fn(
                 page, min(cap, _next_pow2(page.capacity)), max_iters
             )
@@ -1242,9 +1309,170 @@ class Executor:
         return self._stream_cache[key].stream
 
     # --------------------------------------------------------------- join
+    def _generated_join_info(self, node: P.HashJoin, left_types):
+        """Eligibility for the build-free GENERATED join: the build
+        subtree is a Filter/Project/Exchange chain over a TableScan of a
+        connector that can (a) invert the join-key column in closed form
+        (Connector.key_inverse) and (b) generate its columns at
+        arbitrary row indices (Connector.gen_at). Then probe keys map to
+        build TABLE rows arithmetically and the carried columns are
+        GENERATED at those rows — the join holds zero device state: no
+        hash table, no searchsorted, no HBM gathers, no capacity
+        overflow, no partitioning at any scale factor.
+
+        This is the TPU-native collapse of the reference's
+        HashBuilderOperator + LookupJoinOperator for deterministic
+        generator tables ("scan == generate", SURVEY §8.2.6, taken to
+        its logical end: "lookup == generate")."""
+        if not self.generated_join:
+            return None
+        if node.join_type not in ("inner", "left"):
+            return None
+
+        def plain_int(t) -> bool:
+            return not (
+                T.is_string(t) or t.is_dictionary_encoded
+                or T.is_floating(t)
+                or (isinstance(t, T.DecimalType) and not t.is_short)
+            )
+
+        if not all(plain_int(left_types[c]) for c in node.left_keys):
+            return None
+        # walk the build chain down to its scan (key-channel-agnostic)
+        chain: List[P.PhysicalNode] = []
+        cur = node.right
+        from presto_tpu.expr.ir import InputRef
+
+        while True:
+            if isinstance(cur, (P.Filter, P.Exchange, P.Project)):
+                chain.append(cur)
+                cur = cur.source
+            elif isinstance(cur, P.TableScan):
+                break
+            else:
+                return None
+
+        def resolve(ch: int) -> Optional[int]:
+            # build-root channel -> scan channel through the projects
+            for nd in chain:
+                if isinstance(nd, P.Project):
+                    e = nd.exprs[ch]
+                    if not isinstance(e, InputRef):
+                        return None
+                    ch = e.channel
+            return ch
+
+        conn = self.catalogs[cur.catalog]
+        n_rows = conn.row_count(cur.table)
+        gen = conn.gen_at(cur.table, cur.columns)
+        if gen is None or n_rows <= 0:
+            return None
+        # ONE key must invert in closed form; the remaining key pairs
+        # become equality checks against the generated build columns
+        inv, pivot, window, gen_keys = None, None, 1, None
+        for j, rk in enumerate(node.right_keys):
+            sc = resolve(rk)
+            if sc is None:
+                continue
+            inv = conn.key_inverse(cur.table, cur.columns[sc])
+            if inv is not None:
+                pivot = j
+                break
+        if inv is None and self._capacity_boost == 1:
+            # windowed inverse (slot-structured fact tables): the pivot
+            # key pins an L-slot candidate window; the OTHER keys must
+            # resolve to scan columns so the kernel can generate them
+            # per candidate and pick the unique full-key match. A probe
+            # row matching >1 candidates (key set not unique in data)
+            # raises the deferred flag and the boosted retry takes the
+            # general join — windowed is ineligible at boost > 1.
+            for j, rk in enumerate(node.right_keys):
+                sc = resolve(rk)
+                if sc is None:
+                    continue
+                wi = conn.key_window_inverse(cur.table, cur.columns[sc])
+                if wi is None:
+                    continue
+                extra_sc = [
+                    resolve(rkk)
+                    for jj, rkk in enumerate(node.right_keys) if jj != j
+                ]
+                if not extra_sc or any(s is None for s in extra_sc):
+                    # no extra keys to pin the line (near-certain
+                    # multi-match), or unresolvable ones
+                    continue
+                inv, window = wi
+                pivot = j
+                gen_keys = conn.gen_at(
+                    cur.table, tuple(cur.columns[s] for s in extra_sc)
+                )
+                break
+        if inv is None or (window > 1 and gen_keys is None):
+            return None
+        extra_pairs = tuple(
+            (lk, rk)
+            for j, (lk, rk) in enumerate(
+                zip(node.left_keys, node.right_keys))
+            if j != pivot
+        )
+        schema = conn.table_schema(cur.table)
+        scan_types = tuple(schema.column_type(c) for c in cur.columns)
+        dicts = getattr(conn, "_dicts", {}).get(cur.table, {})
+        scan_dicts = tuple(dicts.get(c) for c in cur.columns)
+        # replay the chain top-down over generated pages (bottom-up in
+        # plan order = reversed walk order)
+        chain_fns = []
+        for nd in reversed(chain):
+            if isinstance(nd, P.Filter):
+                chain_fns.append(functools.partial(
+                    _replay_filter, nd.predicate))
+            elif isinstance(nd, P.Project):
+                chain_fns.append(functools.partial(
+                    _project_page, nd.exprs))
+            # Exchange: no-op locally
+        return (node.left_keys[pivot], extra_pairs, inv, window,
+                gen_keys, gen, scan_types, scan_dicts,
+                tuple(chain_fns), n_rows)
+
+    def _exec_join_generated(self, node: P.HashJoin, info
+                             ) -> Iterator[Page]:
+        (pivot_ch, extra_pairs, inv, window, gen_keys, gen,
+         scan_types, scan_dicts, chain_fns, n_rows) = info
+        self.generated_joins_used += 1
+        if window == 1:
+            fn = self._jit(
+                ("genjoin", node),
+                functools.partial(
+                    _generated_join_page, pivot_ch, extra_pairs,
+                    node.join_type, inv, gen, scan_types, scan_dicts,
+                    chain_fns, n_rows,
+                ),
+            )
+            for page in self.pages(node.left):
+                yield fn(page)
+            return
+        fn = self._jit(
+            ("genjoin_win", node),
+            functools.partial(
+                _generated_join_window_page, pivot_ch, extra_pairs,
+                node.join_type, inv, window, gen_keys, gen, scan_types,
+                scan_dicts, chain_fns, n_rows,
+            ),
+        )
+        for page in self.pages(node.left):
+            out, multi = fn(page)
+            # >1 in-window matches for some probe row: the key set is
+            # not unique in the data — retry takes the general join
+            self._pending_overflow.append(multi)
+            yield out
+
     def _exec_join(self, node: P.HashJoin) -> Iterator[Page]:
         left_types = self.output_types(node.left)
         right_types = self.output_types(node.right)
+        gj = self._generated_join_info(node, left_types)
+        if gj is not None:
+            yield from self._exec_join_generated(node, gj)
+            return
         # <=1 match per probe row when ANY build key scans a connector-
         # declared unique column (equality on a unique column alone
         # pins the row): join output can never exceed the probe page,
@@ -2311,6 +2539,150 @@ def _null_blocks(types: List[T.SqlType], cap: int) -> List[Block]:
         )
         for b in page.blocks
     ]
+
+
+def _replay_filter(predicate, page: Page) -> Page:
+    return evaluate_filter(predicate, page, jnp)
+
+
+def _subtree_has_join(node: P.PhysicalNode) -> bool:
+    if isinstance(node, (P.HashJoin, P.CrossJoin)):
+        return True
+    return any(_subtree_has_join(c) for c in node.children())
+
+
+def _compact_with_flag(page: Page, cap: int):
+    """compact_page plus the dropped-rows overflow flag (kernel)."""
+    return (
+        compact_page(page, cap),
+        page.num_rows() > cap,
+    )
+
+
+def _merge_compact_flag(acc: Page, page: Page, cap: int):
+    """Fold one more page into the rolling dense accumulator (kernel):
+    concat + stable compaction back to cap, flagging dropped rows."""
+    both = concat_all([acc, page])
+    return (
+        compact_page(both, cap),
+        both.num_rows() > cap,
+    )
+
+
+def _generated_join_page(left_key_ch, extra_pairs, join_type, inv, gen,
+                         scan_types, scan_dicts, chain_fns, n_rows,
+                         page: Page) -> Page:
+    """Build-free generated join (kernel): probe keys -> build table
+    rows via the connector's closed-form inverse; carried build columns
+    GENERATED at those rows; the build side's Filter/Project chain
+    replayed over the generated blocks. Pure per-element compute — the
+    output page is the probe page extended in place (<=1 match per
+    probe row by the key_inverse uniqueness contract), so capacities
+    are exact and no overflow flag exists."""
+    kblk = page.block(left_key_ch)
+    vals = kblk.data.astype(jnp.int64)
+    ridx, found = inv(vals)
+    if kblk.nulls is not None:
+        found = found & ~kblk.nulls
+    idx = jnp.clip(ridx, 0, max(n_rows - 1, 0))
+    datas, gvalid = gen(idx)
+    blocks = tuple(
+        Block(data=d, type=t, nulls=None, dictionary=dic)
+        for d, t, dic in zip(datas, scan_types, scan_dicts)
+    )
+    bpage = Page(blocks=blocks, valid=found & gvalid)
+    for fn in chain_fns:
+        bpage = fn(bpage)
+    matched = bpage.valid
+    # non-pivot key pairs: equality against the generated build columns
+    # (SQL semantics: NULL on either side never matches)
+    for lk, rk in extra_pairs:
+        lblk, rblk = page.block(lk), bpage.block(rk)
+        eq = lblk.data.astype(jnp.int64) == rblk.data.astype(jnp.int64)
+        if lblk.nulls is not None:
+            eq = eq & ~lblk.nulls
+        if rblk.nulls is not None:
+            eq = eq & ~rblk.nulls
+        matched = matched & eq
+    if join_type == "left":
+        right_blocks = tuple(
+            Block(
+                data=b.data, type=b.type,
+                nulls=(~matched if b.nulls is None
+                       else (b.nulls | ~matched)),
+                dictionary=b.dictionary,
+            )
+            for b in bpage.blocks
+        )
+        out_valid = page.valid
+    else:  # inner
+        right_blocks = bpage.blocks
+        out_valid = page.valid & matched
+    return Page(blocks=page.blocks + right_blocks, valid=out_valid)
+
+
+def _generated_join_window_page(left_key_ch, extra_pairs, join_type, inv,
+                                window, gen_keys, gen, scan_types,
+                                scan_dicts, chain_fns, n_rows,
+                                page: Page):
+    """Windowed generated join (kernel): the pivot key pins an L-slot
+    candidate window of the slot-structured build table; the remaining
+    key columns are GENERATED at each candidate to resolve the unique
+    matching row, then the full carried columns generate at the
+    resolved rows — fact⋈fact joins (ss ⋈ sr on ticket+item) with zero
+    build state. Returns (page, multi_flag): multi_flag trips when some
+    probe row matched >1 candidates (key set not unique in the data) —
+    the caller defers it to the overflow ladder, whose retry takes the
+    general expanding join."""
+    kblk = page.block(left_key_ch)
+    vals = kblk.data.astype(jnp.int64)
+    base, found = inv(vals)
+    if kblk.nulls is not None:
+        found = found & ~kblk.nulls
+    probe_extras = []
+    for lk, _rk in extra_pairs:
+        b = page.block(lk)
+        if b.nulls is not None:
+            found = found & ~b.nulls
+        probe_extras.append(b.data.astype(jnp.int64))
+    resolved = jnp.zeros_like(vals)
+    any_match = jnp.zeros(vals.shape, dtype=jnp.bool_)
+    multi = jnp.zeros(vals.shape, dtype=jnp.bool_)
+    for k in range(window):
+        cand = jnp.clip(base + k, 0, max(n_rows - 1, 0))
+        in_range = (base + k >= 0) & (base + k < n_rows)
+        kdatas, kvalid = gen_keys(cand)
+        mk = found & kvalid & in_range
+        for pv, kd in zip(probe_extras, kdatas):
+            mk = mk & (pv == kd.astype(jnp.int64))
+        multi = multi | (mk & any_match)
+        resolved = jnp.where(mk & ~any_match, cand, resolved)
+        any_match = any_match | mk
+    datas, gvalid = gen(resolved)
+    blocks = tuple(
+        Block(data=d, type=t, nulls=None, dictionary=dic)
+        for d, t, dic in zip(datas, scan_types, scan_dicts)
+    )
+    bpage = Page(blocks=blocks, valid=any_match & gvalid)
+    for fn in chain_fns:
+        bpage = fn(bpage)
+    matched = bpage.valid
+    if join_type == "left":
+        right_blocks = tuple(
+            Block(
+                data=b.data, type=b.type,
+                nulls=(~matched if b.nulls is None
+                       else (b.nulls | ~matched)),
+                dictionary=b.dictionary,
+            )
+            for b in bpage.blocks
+        )
+        out_valid = page.valid
+    else:  # inner
+        right_blocks = bpage.blocks
+        out_valid = page.valid & matched
+    out = Page(blocks=page.blocks + right_blocks, valid=out_valid)
+    return out, jnp.any(multi)
 
 
 def _build_join_index(left_keys, right_keys, page: Page, build: Page):

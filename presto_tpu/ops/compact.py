@@ -45,20 +45,31 @@ def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
     If out_capacity < num valid rows, overflow rows are silently dropped —
     callers that can overflow must check num_rows first (the compiled-branch
     escape described in SURVEY §8.2.1).
+
+    Implementation is one stable argsort of the validity mask (valid rows
+    first, original order preserved) followed by per-column GATHERS of the
+    output prefix — scatter is the slowest primitive on TPU (~14M rows/s)
+    while sort+gather run at 140M/25M rows/s, and the gathers are sized by
+    the OUTPUT capacity, so compacting sparse pages down is nearly free.
     """
     cap_out = out_capacity or page.capacity
-    targets, out_valid, _ = compact_indices(page.valid, cap_out)
+    n = page.capacity
+    order = jnp.argsort(~page.valid, stable=True)
+    num = jnp.sum(page.valid.astype(jnp.int64))
+    if cap_out <= n:
+        src = order[:cap_out]
+    else:
+        src = jnp.concatenate(
+            [order, jnp.zeros((cap_out - n,), dtype=order.dtype)]
+        )
+    out_valid = jnp.arange(cap_out, dtype=jnp.int64) < num
     new_blocks = []
     for blk in page.blocks:
         if isinstance(blk.data, tuple):
-            data = tuple(scatter_column(d, targets, cap_out) for d in blk.data)
+            data = tuple(d[src] for d in blk.data)
         else:
-            data = scatter_column(blk.data, targets, cap_out)
-        nulls = (
-            scatter_column(blk.nulls, targets, cap_out)
-            if blk.nulls is not None
-            else None
-        )
+            data = blk.data[src]
+        nulls = blk.nulls[src] if blk.nulls is not None else None
         new_blocks.append(blk.with_data(data, nulls=nulls))
     return Page(blocks=tuple(new_blocks), valid=out_valid)
 

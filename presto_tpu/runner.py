@@ -197,6 +197,12 @@ class LocalRunner:
         else:
             ex.page_rows = self._ctor_page_rows
         ex.collect_k = int(self.session.get("array_agg_max_elements"))
+        ex.agg_optimistic_rows = int(
+            self.session.get("agg_optimistic_rows"))
+        ex.agg_compact = bool(
+            self.session.get("agg_compact_enabled"))
+        ex.generated_join = bool(
+            self.session.get("generated_join_enabled"))
 
     def estimate_memory(self, sql: str) -> int:
         """Crude peak-HBM estimate for admission control (reference:

@@ -123,6 +123,33 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0,
         ),
         PropertyMetadata(
+            "generated_join_enabled",
+            "allow the build-free generated join (closed-form key "
+            "inverse + generate-at-index) for eligible joins over "
+            "generator-connector tables; off forces the materialized "
+            "build paths (hash/sort/Pallas/partitioned)",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "agg_optimistic_rows",
+            "optimistic group-capacity clamp for blocking aggregations: "
+            "state buffers start at min(planner estimate, this) and grow "
+            "on the overflow-retry ladder; sorts/scatters in the grouped "
+            "path scale with capacity, so a tight start is much faster "
+            "when the planner over-estimates (0 = trust the estimate)",
+            int, 1 << 18,
+        ),
+        PropertyMetadata(
+            "agg_compact_enabled",
+            "when an aggregation consumes a join's output, densify the "
+            "input stream through a rolling compacted accumulator of "
+            "agg_optimistic_rows capacity first (join outputs are "
+            "capacity-sparse; blocking-op cost scales with slots, not "
+            "valid rows). Rows beyond the accumulator ride the "
+            "overflow-retry ladder",
+            bool, True,
+        ),
+        PropertyMetadata(
             "max_join_build_rows",
             "partition a join whenever the build-side row estimate "
             "exceeds this many rows, regardless of the byte threshold "
@@ -176,6 +203,11 @@ class Session:
         if prop is None:
             raise KeyError(f"unknown session property: {name}")
         return self._values.get(name, prop.default)
+
+    def unset(self, name: str) -> None:
+        """Remove an override so the default shows again (reference:
+        RESET SESSION)."""
+        self._values.pop(name, None)
 
     def is_set(self, name: str) -> bool:
         """True when the property was explicitly set (SET SESSION /

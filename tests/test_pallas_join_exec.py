@@ -26,6 +26,9 @@ def base(conn):
 def pallas(conn):
     r = LocalRunner({"tpch": conn}, page_rows=1 << 13)
     r.session.set("pallas_join_enabled", "true")
+    # these tests assert the PALLAS path engages; the build-free
+    # generated join (default) would preempt it for generator tables
+    r.session.set("generated_join_enabled", False)
     return r
 
 

@@ -183,6 +183,9 @@ def test_partitioned_join_restreams_from_store(base):
     # low enough that the outer (customer-build) join partitions too
     r.session.set("spill_threshold_bytes", 1 << 12)
     calls = {"orders": 0, "lineitem": 0}
+    # these tests exercise the partitioned/materialized build machinery;
+    # the build-free generated join (default) would bypass it entirely
+    r.session.set("generated_join_enabled", False)
     orig = conn2.pages
 
     def counting(table, *a, **k):
@@ -214,6 +217,9 @@ def test_max_join_build_rows_partitions_without_byte_threshold(base):
     conn2 = TpchConnector(0.01)
     r = LocalRunner({"tpch": conn2}, page_rows=1 << 13)
     r.session.set("max_join_build_rows", 2000)  # orders has 15000 rows
+    # these tests exercise the partitioned/materialized build machinery;
+    # the build-free generated join (default) would bypass it entirely
+    r.session.set("generated_join_enabled", False)
     q = (
         "select count(*), sum(l_extendedprice) from lineitem, orders "
         "where l_orderkey = o_orderkey"
@@ -233,6 +239,9 @@ def test_host_spill_tier_restages(base):
     # side (the inner join) must materialize
     r.session.set("spill_threshold_bytes", 1 << 12)
     r.session.set("host_spill_bytes", 1)  # everything spills to host
+    # these tests exercise the partitioned/materialized build machinery;
+    # the build-free generated join (default) would bypass it entirely
+    r.session.set("generated_join_enabled", False)
     q = (
         "select count(*), sum(l_extendedprice) from lineitem, orders, "
         "customer where l_orderkey = o_orderkey "
