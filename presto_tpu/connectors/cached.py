@@ -45,6 +45,15 @@ class CachingConnector:
             )
         return iter(self._page_cache[key])
 
+    def gen_body(self, table, n, names):
+        """No traceable generation: this connector's whole point is that
+        a scan is an HBM read of retained pages. Returning None keeps
+        the executor's whole-pipeline fusion (which would regenerate
+        inside the fused program and bypass the cache) off this path;
+        generated joins (gen_at/key_inverse) still delegate — they are
+        lookups, not scans."""
+        return None
+
     def drop_cache(self) -> None:
         self._page_cache.clear()
 

@@ -867,41 +867,51 @@ class TpcdsConnector(GeneratorConnector, Connector):
 
     # ----------------------------------------------------- store channel
 
-    def _ticket_values(self, ticket: jnp.ndarray):
-        """Per-ticket (visit-level) draws shared by every line."""
-        return dict(
-            customer=_unif(ticket, "store_sales", "customer",
-                           1, self.n_customer),
-            cdemo=_unif(ticket, "store_sales", "cdemo", 1, self.n_cdemo),
-            hdemo=_unif(ticket, "store_sales", "hdemo", 1, self.n_hdemo),
-            addr=_unif(ticket, "store_sales", "addr", 1, self.n_addr),
-            store=_unif(ticket, "store_sales", "store", 1, self.n_store),
-            day=_unif(ticket, "store_sales", "day",
-                      SALES_START, SALES_END),
-            nlines=_unif(ticket, "store_sales", "nlines", 1, MAX_LINES),
-        )
+    def _ticket_values(self, ticket: jnp.ndarray) -> _Lazy:
+        """Per-ticket (visit-level) draws shared by every line (LAZY:
+        each field traces only when pulled — see _Lazy)."""
+        v = _Lazy()
+        v.put("customer", lambda: _unif(
+            ticket, "store_sales", "customer", 1, self.n_customer))
+        v.put("cdemo", lambda: _unif(
+            ticket, "store_sales", "cdemo", 1, self.n_cdemo))
+        v.put("hdemo", lambda: _unif(
+            ticket, "store_sales", "hdemo", 1, self.n_hdemo))
+        v.put("addr", lambda: _unif(
+            ticket, "store_sales", "addr", 1, self.n_addr))
+        v.put("store", lambda: _unif(
+            ticket, "store_sales", "store", 1, self.n_store))
+        v.put("day", lambda: _unif(
+            ticket, "store_sales", "day", SALES_START, SALES_END))
+        v.put("nlines", lambda: _unif(
+            ticket, "store_sales", "nlines", 1, MAX_LINES))
+        return v
 
     @staticmethod
-    def _line_money(stream: str, key: jnp.ndarray):
+    def _line_money(stream: str, key: jnp.ndarray) -> _Lazy:
         """The per-line pricing model every sales channel shares
         (wholesale -> markup list price -> discounted sale price -> tax),
         drawn from the channel's own RNG streams. net_paid here has no
         coupon; the store channel overlays its coupon on top."""
-        qty = _unif(key, stream, "qty", 1, 100)
-        whole = _unif(key, stream, "wholesale", 100, 10_000)
-        markup = _unif(key, stream, "markup", 100, 300)
-        lst = whole * markup // jnp.int64(100)
-        disc = _unif(key, stream, "disc", 0, 100)
-        sprice = lst * (jnp.int64(100) - disc) // jnp.int64(100)
-        taxp = _unif(key, stream, "taxp", 0, 9)
-        ext_sales = qty * sprice
-        ext_tax = ext_sales * taxp // jnp.int64(100)
-        return dict(
-            qty=qty, whole=whole, lst=lst, sprice=sprice, taxp=taxp,
-            ext_sales=ext_sales, net_paid=ext_sales, ext_tax=ext_tax,
-        )
+        m = _Lazy()
+        m.put("qty", lambda: _unif(key, stream, "qty", 1, 100))
+        m.put("whole", lambda: _unif(key, stream, "wholesale",
+                                     100, 10_000))
+        m.put("lst", lambda: (
+            m["whole"] * _unif(key, stream, "markup", 100, 300)
+            // jnp.int64(100)))
+        m.put("sprice", lambda: (
+            m["lst"] * (jnp.int64(100) - _unif(key, stream, "disc",
+                                               0, 100))
+            // jnp.int64(100)))
+        m.put("taxp", lambda: _unif(key, stream, "taxp", 0, 9))
+        m.put("ext_sales", lambda: m["qty"] * m["sprice"])
+        m.put("net_paid", lambda: m["ext_sales"])
+        m.put("ext_tax", lambda: (
+            m["ext_sales"] * m["taxp"] // jnp.int64(100)))
+        return m
 
-    def _ss_values(self, slot: jnp.ndarray):
+    def _ss_values(self, slot: jnp.ndarray) -> _Lazy:
         """Per-slot store_sales values: pure functions of the global slot
         index (ticket * MAX_LINES + line-1); shared by store_returns and
         the catalog re-purchase correlation."""
@@ -910,24 +920,40 @@ class TpcdsConnector(GeneratorConnector, Connector):
         tv = self._ticket_values(ticket)
         key = slot
         m = self._line_money("store_sales", key)
-        qty, sprice, taxp = m["qty"], m["sprice"], m["taxp"]
-        has_coupon = _unif(key, "store_sales", "hascoup", 0, 9) < 2
-        cfrac = _unif(key, "store_sales", "cfrac", 0, 50)
-        ext_sales = m["ext_sales"]
-        coupon = jnp.where(has_coupon, ext_sales * cfrac // 100, 0)
-        net_paid = ext_sales - coupon
-        ext_tax = net_paid * taxp // jnp.int64(100)
-        valid = line <= tv["nlines"]
-        returned = valid & (
+        v = _Lazy()
+        v.merge(m)
+        v.merge(tv)
+        v.put("ticket", lambda: ticket)
+        v.put("line", lambda: line)
+        v.put("key", lambda: key)
+        v.put("coupon", lambda: jnp.where(
+            _unif(key, "store_sales", "hascoup", 0, 9) < 2,
+            m["ext_sales"] * _unif(key, "store_sales", "cfrac", 0, 50)
+            // 100,
+            0,
+        ))
+        # store channel overlays the coupon on the shared money model
+        v.put("net_paid", lambda: m["ext_sales"] - v["coupon"])
+        v.put("ext_tax", lambda: (
+            v["net_paid"] * m["taxp"] // jnp.int64(100)))
+        v.put("valid", lambda: line <= tv["nlines"])
+        v.put("returned", lambda: v["valid"] & (
             _unif(key, "store_returns", "flag", 0, 99) < SS_RETURN_PCT
-        )
-        return dict(
-            m, ticket=ticket, line=line, key=key, valid=valid,
-            returned=returned,
-            item=_unif(key, "store_sales", "item", 1, self.n_item),
-            promo=_unif(key, "store_sales", "promo", 1, self.n_promo),
-            coupon=coupon, net_paid=net_paid, ext_tax=ext_tax, **tv,
-        )
+        ))
+        # items within a ticket are DISTINCT (dsdgen picks store-order
+        # items from a permutation): base + line*stride mod n_item with
+        # stride < n_item/MAX_LINES guarantees the 11 lines collide
+        # never — and (ss_ticket_number, ss_item_sk) is a true key,
+        # which the windowed generated join relies on
+        v.put("item", lambda: (
+            _unif(ticket, "store_sales", "itembase", 0, self.n_item - 1)
+            + line * (1 + _unif(
+                ticket, "store_sales", "itemstride", 0,
+                max(self.n_item // (MAX_LINES + 1) - 1, 0)))
+        ) % self.n_item + 1)
+        v.put("promo", lambda: _unif(
+            key, "store_sales", "promo", 1, self.n_promo))
+        return v
 
     def _gen_store_sales_at(self, idx) -> _Lazy:
         slot = idx
@@ -970,33 +996,36 @@ class TpcdsConnector(GeneratorConnector, Connector):
         return lz
 
     @staticmethod
-    def _return_money(stream: str, key, qty, sprice, taxp, day):
+    def _return_money(stream: str, key, sv: _Lazy) -> _Lazy:
         """Shared return-line money model for both channels: quantity,
         amount/tax, and the refunded/reversed/store-credit split of the
-        amount (stream names the RNG streams so the channels differ)."""
-        rqty = _unif(key, stream, "qty", 1, 100) % qty + 1
-        ramt = rqty * sprice
-        rtax = ramt * taxp // jnp.int64(100)
-        f = _unif(key, stream, "reffrac", 0, 100)
-        refunded = ramt * f // jnp.int64(100)
-        g = _unif(key, stream, "revfrac", 0, 100)
-        reversed_c = (ramt - refunded) * g // jnp.int64(100)
-        credit = ramt - refunded - reversed_c
-        fee = _unif(key, stream, "fee", 100, 10_000)
-        ship = _unif(key, stream, "ship", 0, 5_000)
-        rday = day + _unif(key, stream, "lag", 1, 90)
-        return dict(rqty=rqty, ramt=ramt, rtax=rtax, refunded=refunded,
-                    reversed_c=reversed_c, credit=credit, fee=fee,
-                    ship=ship, rday=rday)
+        amount (stream names the RNG streams so the channels differ).
+        sv supplies the sale line's qty/sprice/taxp/day lazily."""
+        rv = _Lazy()
+        rv.put("rqty", lambda: (
+            _unif(key, stream, "qty", 1, 100) % sv["qty"] + 1))
+        rv.put("ramt", lambda: rv["rqty"] * sv["sprice"])
+        rv.put("rtax", lambda: (
+            rv["ramt"] * sv["taxp"] // jnp.int64(100)))
+        rv.put("refunded", lambda: (
+            rv["ramt"] * _unif(key, stream, "reffrac", 0, 100)
+            // jnp.int64(100)))
+        rv.put("reversed_c", lambda: (
+            (rv["ramt"] - rv["refunded"])
+            * _unif(key, stream, "revfrac", 0, 100) // jnp.int64(100)))
+        rv.put("credit", lambda: (
+            rv["ramt"] - rv["refunded"] - rv["reversed_c"]))
+        rv.put("fee", lambda: _unif(key, stream, "fee", 100, 10_000))
+        rv.put("ship", lambda: _unif(key, stream, "ship", 0, 5_000))
+        rv.put("rday", lambda: (
+            sv["day"] + _unif(key, stream, "lag", 1, 90)))
+        return rv
 
-    def _sr_values(self, slot: jnp.ndarray):
+    def _sr_values(self, slot: jnp.ndarray) -> _Lazy:
         sv = self._ss_values(slot)
-        out = self._return_money(
-            "store_returns", sv["key"], sv["qty"], sv["sprice"],
-            sv["taxp"], sv["day"],
-        )
-        out["sv"] = sv
-        return out
+        rv = self._return_money("store_returns", slot, sv)
+        rv.put("sv", lambda: sv)
+        return rv
 
     def _gen_store_returns_at(self, idx) -> _Lazy:
         slot = idx
@@ -1040,7 +1069,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
 
     # ---------------------------------------------------- catalog channel
 
-    def _cs_values(self, slot: jnp.ndarray):
+    def _cs_values(self, slot: jnp.ndarray) -> _Lazy:
         """Per-slot catalog_sales values. The re-purchase correlation: a
         line targets a pseudo-random store-sales slot; when that slot is a
         returned sale (and this line drew the 30% correlation), the line
@@ -1048,46 +1077,58 @@ class TpcdsConnector(GeneratorConnector, Connector):
         order = slot // MAX_LINES
         line = slot % MAX_LINES + 1
         key = slot
-        nlines = _unif(order, "catalog_sales", "nlines", 1, MAX_LINES)
-        valid = line <= nlines
-        # order-level draws
-        o_customer = _unif(order, "catalog_sales", "customer",
-                           1, self.n_customer)
-        o_day = _unif(order, "catalog_sales", "day",
-                      SALES_START, SALES_END)
+        m = self._line_money("catalog_sales", key)
+        v = _Lazy()
+        v.merge(m)
+        v.put("order", lambda: order)
+        v.put("line", lambda: line)
+        v.put("key", lambda: key)
+        v.put("valid", lambda: line <= _unif(
+            order, "catalog_sales", "nlines", 1, MAX_LINES))
         # correlation target: a returned store sale re-purchased by
         # catalog; pure function of the target slot index
         n_ss = self.n_ticket * MAX_LINES
-        t_slot = _unif(key, "catalog_sales", "corrslot", 0, n_ss - 1)
-        t = self._sr_values(t_slot)
-        corr = valid & t["sv"]["returned"] & (
-            _unif(key, "catalog_sales", "corr", 0, 99) < CS_REPURCHASE_PCT
-        )
-        customer = jnp.where(corr, t["sv"]["customer"], o_customer)
-        item = jnp.where(
-            corr, t["sv"]["item"],
+
+        def t_vals():
+            t_slot = _unif(key, "catalog_sales", "corrslot", 0, n_ss - 1)
+            return self._sr_values(t_slot)
+
+        v.put("_t", t_vals)
+        v.put("corr", lambda: v["valid"] & v["_t"]["sv"]["returned"] & (
+            _unif(key, "catalog_sales", "corr", 0, 99)
+            < CS_REPURCHASE_PCT
+        ))
+        v.put("customer", lambda: jnp.where(
+            v["corr"], v["_t"]["sv"]["customer"],
+            _unif(order, "catalog_sales", "customer",
+                  1, self.n_customer),
+        ))
+        v.put("item", lambda: jnp.where(
+            v["corr"], v["_t"]["sv"]["item"],
             _unif(key, "catalog_sales", "item", 1, self.n_item),
-        )
-        day = jnp.clip(
+        ))
+        v.put("day", lambda: jnp.clip(
             jnp.where(
-                corr,
-                t["rday"] + _unif(key, "catalog_sales", "lag", 1, 60),
-                o_day,
+                v["corr"],
+                v["_t"]["rday"] + _unif(key, "catalog_sales",
+                                        "lag", 1, 60),
+                _unif(order, "catalog_sales", "day",
+                      SALES_START, SALES_END),
             ),
             SALES_START, SALES_END,
-        )
-        m = self._line_money("catalog_sales", key)
-        returned = valid & (
+        ))
+        v.put("returned", lambda: v["valid"] & (
             _unif(key, "catalog_returns", "flag", 0, 99) < CS_RETURN_PCT
-        )
-        return dict(
-            m, order=order, line=line, key=key, valid=valid,
-            returned=returned, customer=customer, item=item, day=day,
-            cdemo=_unif(order, "catalog_sales", "cdemo", 1, self.n_cdemo),
-            hdemo=_unif(order, "catalog_sales", "hdemo", 1, self.n_hdemo),
-            addr=_unif(order, "catalog_sales", "addr", 1, self.n_addr),
-            promo=_unif(key, "catalog_sales", "promo", 1, self.n_promo),
-        )
+        ))
+        v.put("cdemo", lambda: _unif(
+            order, "catalog_sales", "cdemo", 1, self.n_cdemo))
+        v.put("hdemo", lambda: _unif(
+            order, "catalog_sales", "hdemo", 1, self.n_hdemo))
+        v.put("addr", lambda: _unif(
+            order, "catalog_sales", "addr", 1, self.n_addr))
+        v.put("promo", lambda: _unif(
+            key, "catalog_sales", "promo", 1, self.n_promo))
+        return v
 
     def _gen_catalog_sales_at(self, idx) -> _Lazy:
         slot = idx
@@ -1144,10 +1185,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
         @functools.lru_cache(maxsize=1)
         def rv():
             c = cv()
-            return self._return_money(
-                "catalog_returns", c["key"], c["qty"], c["sprice"],
-                c["taxp"], c["day"],
-            )
+            return self._return_money("catalog_returns", c["key"], c)
 
         lz.put("cr_returned_date_sk",
                lambda: rv()["rday"] + jnp.int64(JULIAN_BASE))
@@ -1370,38 +1408,47 @@ class TpcdsConnector(GeneratorConnector, Connector):
 
     # ------------------------------------------------------ web channel
 
-    def _ws_values(self, slot: jnp.ndarray):
+    def _ws_values(self, slot: jnp.ndarray) -> _Lazy:
         """Per-slot web_sales values; order-structured like the catalog
         channel (order = one customer session, 1..11 live lines)."""
         order = slot // MAX_LINES
         line = slot % MAX_LINES + 1
         key = slot
-        nlines = _unif(order, "web_sales", "nlines", 1, MAX_LINES)
-        valid = line <= nlines
         m = self._line_money("web_sales", key)
-        returned = valid & (
+        v = _Lazy()
+        v.merge(m)
+        v.put("order", lambda: order)
+        v.put("line", lambda: line)
+        v.put("key", lambda: key)
+        v.put("valid", lambda: line <= _unif(
+            order, "web_sales", "nlines", 1, MAX_LINES))
+        v.put("returned", lambda: v["valid"] & (
             _unif(key, "web_returns", "flag", 0, 99) < WS_RETURN_PCT
-        )
-        return dict(
-            m, order=order, line=line, key=key, valid=valid,
-            returned=returned,
-            customer=_unif(order, "web_sales", "customer",
-                           1, self.n_customer),
-            cdemo=_unif(order, "web_sales", "cdemo", 1, self.n_cdemo),
-            hdemo=_unif(order, "web_sales", "hdemo", 1, self.n_hdemo),
-            addr=_unif(order, "web_sales", "addr", 1, self.n_addr),
-            site=_unif(order, "web_sales", "site", 1, self.n_web_site),
-            page=_unif(order, "web_sales", "page", 1, self.n_web_page),
-            day=_unif(order, "web_sales", "day",
-                      SALES_START, SALES_END),
-            tod=_unif(order, "web_sales", "tod", 0, 86_399),
-            warehouse=_unif(key, "web_sales", "wh",
-                            1, self.n_warehouse),
-            ship_mode=_unif(key, "web_sales", "sm",
-                            1, self.n_ship_mode),
-            item=_unif(key, "web_sales", "item", 1, self.n_item),
-            promo=_unif(key, "web_sales", "promo", 1, self.n_promo),
-        )
+        ))
+        v.put("customer", lambda: _unif(
+            order, "web_sales", "customer", 1, self.n_customer))
+        v.put("cdemo", lambda: _unif(
+            order, "web_sales", "cdemo", 1, self.n_cdemo))
+        v.put("hdemo", lambda: _unif(
+            order, "web_sales", "hdemo", 1, self.n_hdemo))
+        v.put("addr", lambda: _unif(
+            order, "web_sales", "addr", 1, self.n_addr))
+        v.put("site", lambda: _unif(
+            order, "web_sales", "site", 1, self.n_web_site))
+        v.put("page", lambda: _unif(
+            order, "web_sales", "page", 1, self.n_web_page))
+        v.put("day", lambda: _unif(
+            order, "web_sales", "day", SALES_START, SALES_END))
+        v.put("tod", lambda: _unif(order, "web_sales", "tod", 0, 86_399))
+        v.put("warehouse", lambda: _unif(
+            key, "web_sales", "wh", 1, self.n_warehouse))
+        v.put("ship_mode", lambda: _unif(
+            key, "web_sales", "sm", 1, self.n_ship_mode))
+        v.put("item", lambda: _unif(
+            key, "web_sales", "item", 1, self.n_item))
+        v.put("promo", lambda: _unif(
+            key, "web_sales", "promo", 1, self.n_promo))
+        return v
 
     def _gen_web_sales_at(self, idx) -> _Lazy:
         slot = idx
@@ -1464,10 +1511,7 @@ class TpcdsConnector(GeneratorConnector, Connector):
         @functools.lru_cache(maxsize=1)
         def rv():
             w = wv()
-            return self._return_money(
-                "web_returns", w["key"], w["qty"], w["sprice"],
-                w["taxp"], w["day"],
-            )
+            return self._return_money("web_returns", w["key"], w)
 
         lz.put("wr_returned_date_sk",
                lambda: rv()["rday"] + jnp.int64(JULIAN_BASE))

@@ -328,7 +328,13 @@ def _unif(keys, table, column, lo: int, hi: int) -> jnp.ndarray:
 
 class _Lazy:
     """Column-pruned generation: entries are thunks evaluated only for the
-    requested column set (a traced no-op for the rest)."""
+    requested column set (a traced no-op for the rest). Keeping every
+    field lazy matters twice over: pruned scans trace only the touched
+    columns, and the generated-join / fused-pipeline kernels that embed
+    generation stay small enough to compile quickly (the TPC-DS fact
+    value models are ~25 interdependent draws; a windowed join tracing
+    them 11x per candidate must pull single fields, not the full
+    model)."""
 
     def __init__(self):
         self._thunks: Dict[str, object] = {}
@@ -341,6 +347,14 @@ class _Lazy:
         if name not in self._memo:
             self._memo[name] = self._thunks[name]()
         return self._memo[name]
+
+    __getitem__ = get
+
+    def merge(self, other: "_Lazy") -> None:
+        """Adopt another lazy's thunks (later put() calls override);
+        memoization stays shared through the adopted thunks' own
+        closures."""
+        self._thunks.update(other._thunks)
 
 
 # ------------------------------------------------------------- connector

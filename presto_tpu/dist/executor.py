@@ -498,9 +498,52 @@ class DistExecutor(Executor):
     # -------------------------------------------------------------- join
     def _dist_join(self, node: P.HashJoin) -> Iterator[Page]:
         dl, dr = self.dist(node.left), self.dist(node.right)
+        gj = self._generated_join_info(node, self.output_types(node.left))
+        if gj is not None:
+            # build-free generated join is embarrassingly SPMD: each
+            # device inverts its shard's probe keys and GENERATES the
+            # carried build columns locally — no broadcast, no
+            # repartition, no build materialization on any device
+            yield from self._dist_join_generated(node, gj, dl)
+            return
         if dl == REPLICATED and dr == REPLICATED:
             yield from super()._exec_join(node)
             return
+        yield from self._dist_join_materialized(node, dl, dr)
+
+    def _dist_join_generated(self, node: P.HashJoin, info, dl
+                             ) -> Iterator[Page]:
+        self.generated_joins_used += 1
+        kern, windowed = self.generated_join_kernel(node, info)
+        spec = PS("d") if dl == SHARDED else PS()
+        if not windowed:
+            key = ("d_genjoin", node, dl)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(jax.shard_map(
+                    kern, mesh=self.mesh, in_specs=(spec,),
+                    out_specs=spec, check_vma=False,
+                ))
+            for page in self.pages(node.left):
+                yield self._jit_cache[key](page)
+            return
+
+        def win_body(page):
+            out, multi = kern(page)
+            return out, jax.lax.psum(multi.astype(jnp.int32), "d") > 0
+
+        key = ("d_genjoin_win", node, dl)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                win_body, mesh=self.mesh, in_specs=(spec,),
+                out_specs=(spec, PS()), check_vma=False,
+            ))
+        for page in self.pages(node.left):
+            out, multi = self._jit_cache[key](page)
+            self._pending_overflow.append(multi)
+            yield out
+
+    def _dist_join_materialized(self, node: P.HashJoin, dl, dr
+                                ) -> Iterator[Page]:
         # build side: replicated (broadcast) or sharded (partitioned)
         build_pages = list(self.pages(node.right))
         right_types = self.output_types(node.right)

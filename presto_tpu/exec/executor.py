@@ -412,7 +412,126 @@ class Executor:
             self._account_page(page)
             yield page
 
+    def _scan_chain(self, node: P.PhysicalNode, *, through_joins: bool):
+        """Walk a Filter/Project/Exchange chain (and, when
+        through_joins, generated-join-eligible HashJoins) down to its
+        TableScan. THE one chain walker shared by the generated-join
+        eligibility check and the fused-pipeline builder. Returns
+        (scan, chain top-down) with HashJoins as (node, info) tuples,
+        or None when any node breaks the chain."""
+        chain: List = []
+        cur = node
+        while True:
+            if isinstance(cur, (P.Filter, P.Exchange, P.Project)):
+                chain.append(cur)
+                cur = cur.source
+            elif through_joins and isinstance(cur, P.HashJoin):
+                info = self._generated_join_info(
+                    cur, self.output_types(cur.left))
+                if info is None:
+                    return None
+                chain.append((cur, info))
+                cur = cur.left
+            elif isinstance(cur, P.TableScan):
+                return cur, chain
+            else:
+                return None
+
+    def _fused_stream(self, node: P.PhysicalNode
+                      ) -> Optional[Iterator[Page]]:
+        """Whole-pipeline fusion: when `node` is a chain of Filter /
+        Project / Exchange / build-free generated joins over a
+        TableScan of an on-device generator, compile the ENTIRE
+        per-page pipeline — generation included — into ONE XLA program
+        per split and stream its outputs.
+
+        Reference: operator/ScanFilterAndProjectOperator.java fuses
+        scan+filter+project for the same reason (avoid materializing
+        between operators); the TPU translation goes further and fuses
+        the whole driver loop for the chain, so a page pays ONE kernel
+        launch instead of one per node (launch overhead ~6ms on the
+        axon tunnel dominates small per-node kernels — ROOFLINE.md §4).
+        Returns None when the subtree has any non-fusable node."""
+        if not self.use_jit:
+            return None
+        walked = self._scan_chain(node, through_joins=True)
+        if walked is None:
+            return None
+        cur, chain = walked
+        if not chain:
+            return None  # a bare scan already runs as one program
+        conn = self.catalogs[cur.catalog]
+        # structural gate: fuse ONLY when pages() is exactly the base
+        # per-split generation loop — a connector (or wrapper: caching,
+        # DCN hash-split masking, instance-level instrumentation) that
+        # overrides pages() transforms the stream in ways inlined
+        # generation would silently bypass
+        if (getattr(type(conn), "pages", None) is not Connector.pages
+                or "pages" in vars(conn)):
+            return None
+        names = tuple(cur.columns)
+        probe = conn.gen_body(cur.table, 8, names)
+        if probe is None:
+            return None
+        schema = conn.table_schema(cur.table)
+        scan_types = tuple(schema.column_type(c) for c in names)
+        dicts = getattr(conn, "_dicts", {}).get(cur.table, {})
+        scan_dicts = tuple(dicts.get(c) for c in names)
+        splits = conn.splits(cur.table, self.page_rows)
+        if cur.constraint:
+            splits = conn.prune_splits(cur.table, splits, cur.constraint)
+
+        # bottom-up list of page transforms (top-down in `chain`)
+        steps: List = []
+        for nd in reversed(chain):
+            if isinstance(nd, tuple):
+                jnode, info = nd
+                kern, windowed = self.generated_join_kernel(jnode, info)
+                steps.append(("joinw" if windowed else "join", kern))
+                self.generated_joins_used += 1
+            else:
+                fn = _node_replay_fn(nd)
+                if fn is not None:
+                    steps.append(("map", fn))
+
+        def run_split(gen_fn, start):
+            datas, valid = gen_fn(start)
+            page = Page(blocks=tuple(
+                Block(data=d, type=t, nulls=None, dictionary=dic)
+                for d, t, dic in zip(datas, scan_types, scan_dicts)
+            ), valid=valid)
+            flags = []
+            for kind, fn in steps:
+                if kind == "joinw":
+                    page, multi = fn(page)
+                    flags.append(multi)
+                else:
+                    page = fn(page)
+            return page, tuple(flags)
+
+        def stream():
+            for split in splits:
+                if not split.row_count:
+                    continue
+                key = ("fused", node, cur.table, split.row_count)
+                if key not in self._jit_cache:
+                    gen_fn = conn.gen_body(
+                        cur.table, split.row_count, names)
+                    self._jit_cache[key] = jax.jit(
+                        functools.partial(run_split, gen_fn))
+                page, flags = self._jit_cache[key](
+                    jnp.int64(split.start_row))
+                self._pending_overflow.extend(flags)
+                yield page
+
+        return stream()
+
     def _pages_impl(self, node: P.PhysicalNode) -> Iterator[Page]:
+        if isinstance(node, (P.Filter, P.Project, P.HashJoin)):
+            fused = self._fused_stream(node)
+            if fused is not None:
+                yield from fused
+                return
         if isinstance(node, P.TableScan):
             conn = self.catalogs[node.catalog]
             yield from conn.pages(
@@ -950,6 +1069,14 @@ class Executor:
         flag overflow and ride the boosted-retry ladder (reference
         analog: every Presto operator re-compacts via PageBuilder —
         pages are always dense there)."""
+        if node.capacity <= A.MATMUL_AGG_MAX_GROUPS:
+            # few groups: the aggregation runs on the dense/MXU paths
+            # whose per-page cost is already near-free — and at scale
+            # the accumulator could not hold a high-selectivity stream
+            # anyway (e.g. Q5 SF100's ~18M qualifying rows vs a <=2M
+            # buffer); stream straight through
+            yield from self.pages(node.source)
+            return
         yield from self._compacted_stream(node.source, node)
 
     def _compacted_stream(self, src: P.PhysicalNode,
@@ -1328,6 +1455,10 @@ class Executor:
             return None
         if node.join_type not in ("inner", "left"):
             return None
+        walked = self._scan_chain(node.right, through_joins=False)
+        if walked is None:
+            return None
+        cur, chain = walked
 
         def plain_int(t) -> bool:
             return not (
@@ -1338,19 +1469,7 @@ class Executor:
 
         if not all(plain_int(left_types[c]) for c in node.left_keys):
             return None
-        # walk the build chain down to its scan (key-channel-agnostic)
-        chain: List[P.PhysicalNode] = []
-        cur = node.right
         from presto_tpu.expr.ir import InputRef
-
-        while True:
-            if isinstance(cur, (P.Filter, P.Exchange, P.Project)):
-                chain.append(cur)
-                cur = cur.source
-            elif isinstance(cur, P.TableScan):
-                break
-            else:
-                return None
 
         def resolve(ch: int) -> Optional[int]:
             # build-root channel -> scan channel through the projects
@@ -1421,44 +1540,47 @@ class Executor:
         scan_dicts = tuple(dicts.get(c) for c in cur.columns)
         # replay the chain top-down over generated pages (bottom-up in
         # plan order = reversed walk order)
-        chain_fns = []
-        for nd in reversed(chain):
-            if isinstance(nd, P.Filter):
-                chain_fns.append(functools.partial(
-                    _replay_filter, nd.predicate))
-            elif isinstance(nd, P.Project):
-                chain_fns.append(functools.partial(
-                    _project_page, nd.exprs))
-            # Exchange: no-op locally
+        chain_fns = [
+            fn for fn in (
+                _node_replay_fn(nd) for nd in reversed(chain)
+            ) if fn is not None
+        ]
         return (node.left_keys[pivot], extra_pairs, inv, window,
                 gen_keys, gen, scan_types, scan_dicts,
                 tuple(chain_fns), n_rows)
 
-    def _exec_join_generated(self, node: P.HashJoin, info
-                             ) -> Iterator[Page]:
+    @staticmethod
+    def generated_join_kernel(node: P.HashJoin, info):
+        """The ONE place the _generated_join_info tuple meets the
+        kernels: returns (page_fn, windowed). Plain mode: page -> page.
+        Windowed: page -> (page, multi_flag) — the caller must defer
+        multi_flag to the overflow ladder. Shared by the local executor,
+        the dist executor's shard_map wrapping, and the fused-pipeline
+        builder."""
         (pivot_ch, extra_pairs, inv, window, gen_keys, gen,
          scan_types, scan_dicts, chain_fns, n_rows) = info
-        self.generated_joins_used += 1
         if window == 1:
-            fn = self._jit(
-                ("genjoin", node),
-                functools.partial(
-                    _generated_join_page, pivot_ch, extra_pairs,
-                    node.join_type, inv, gen, scan_types, scan_dicts,
-                    chain_fns, n_rows,
-                ),
-            )
+            return functools.partial(
+                _generated_join_page, pivot_ch, extra_pairs,
+                node.join_type, inv, gen, scan_types, scan_dicts,
+                chain_fns, n_rows,
+            ), False
+        return functools.partial(
+            _generated_join_window_page, pivot_ch, extra_pairs,
+            node.join_type, inv, window, gen_keys, gen, scan_types,
+            scan_dicts, chain_fns, n_rows,
+        ), True
+
+    def _exec_join_generated(self, node: P.HashJoin, info
+                             ) -> Iterator[Page]:
+        self.generated_joins_used += 1
+        kern, windowed = self.generated_join_kernel(node, info)
+        if not windowed:
+            fn = self._jit(("genjoin", node), kern)
             for page in self.pages(node.left):
                 yield fn(page)
             return
-        fn = self._jit(
-            ("genjoin_win", node),
-            functools.partial(
-                _generated_join_window_page, pivot_ch, extra_pairs,
-                node.join_type, inv, window, gen_keys, gen, scan_types,
-                scan_dicts, chain_fns, n_rows,
-            ),
-        )
+        fn = self._jit(("genjoin_win", node), kern)
         for page in self.pages(node.left):
             out, multi = fn(page)
             # >1 in-window matches for some probe row: the key set is
@@ -1888,8 +2010,16 @@ def _project_page(exprs, page: Page) -> Page:
         nulls = v.nulls
         if nulls is not None and nulls.ndim == 0:
             nulls = jnp.broadcast_to(nulls, (page.capacity,))
+        dic = v.dictionary
+        if (dic is None and T.is_string(e.type) and v.is_const
+                and v.py_value is not None):
+            # a PROJECTED string constant must be first-class: consuming
+            # functions resolve constants against the column dictionary,
+            # but as an output column the code needs its own one-entry
+            # dictionary or it would decode as the bare code 0
+            dic = Dictionary([v.py_value])
         blocks.append(
-            Block(data=data, type=e.type, nulls=nulls, dictionary=v.dictionary)
+            Block(data=data, type=e.type, nulls=nulls, dictionary=dic)
         )
     return Page(blocks=tuple(blocks), valid=page.valid)
 
@@ -2543,6 +2673,17 @@ def _null_blocks(types: List[T.SqlType], cap: int) -> List[Block]:
 
 def _replay_filter(predicate, page: Page) -> Page:
     return evaluate_filter(predicate, page, jnp)
+
+
+def _node_replay_fn(nd):
+    """Per-node page->page replay transform for chain re-execution over
+    generated pages (None for pass-through nodes like local Exchange) —
+    the ONE place chain-replay semantics live."""
+    if isinstance(nd, P.Filter):
+        return functools.partial(_replay_filter, nd.predicate)
+    if isinstance(nd, P.Project):
+        return functools.partial(_project_page, nd.exprs)
+    return None
 
 
 def _subtree_has_join(node: P.PhysicalNode) -> bool:
