@@ -98,6 +98,7 @@ def eval_call(ctx: Ctx, name: str, result_type: T.SqlType, vals: List[Val]):
             union_nulls(ctx.xp, out.nulls, extra),
             out.type,
             out.dictionary,
+            py_value=out.py_value,
         )
     return out
 
@@ -429,7 +430,13 @@ register("not", lambda a: T.BOOLEAN, _impl_not)
 # ------------------------------------------------------------------- casts
 
 def _impl_cast(ctx: Ctx, rt: T.SqlType, vals: List[Val]) -> Val:
-    data, nulls = cast_data(ctx.xp, vals[0], rt, ctx.capacity)
+    v = vals[0]
+    data, nulls = cast_data(ctx.xp, v, rt, ctx.capacity)
+    if T.is_string(rt) and T.is_string(v.type):
+        # varchar(n) <-> varchar keeps the dictionary codes; dropping
+        # the dictionary (or a constant's py_value) here would decode
+        # the codes as bare integers downstream
+        return Val(data, nulls, rt, v.dictionary, py_value=v.py_value)
     return Val(data, nulls, rt)
 
 

@@ -304,14 +304,20 @@ class LocalRunner:
                     f"prepared statement not found: {stmt.name}"
                 )
             inner = parse(text)
-            if isinstance(inner, (N.Delete, N.Update)) and "?" in text:
+            if isinstance(inner, (N.Delete, N.Update)):
                 # DML predicates/assignments ride as raw SQL slices the
-                # AST rewrite cannot reach — fail clearly rather than
-                # with an unbound-parameter planning error later
-                raise ValueError(
-                    "parameters in prepared DELETE/UPDATE are not "
-                    "supported; inline the values"
-                )
+                # AST rewrite cannot reach; substitute the EXECUTE
+                # arguments' raw source text into the ? placeholders
+                # positionally (quote-aware, so '?' inside string
+                # literals is data, not a parameter)
+                inner, used = _bind_dml_parameters(inner, stmt.arg_sqls)
+                if used != len(stmt.args):
+                    raise ValueError(
+                        f"incorrect number of parameters: statement "
+                        f"expects {used}, EXECUTE supplies "
+                        f"{len(stmt.args)}"
+                    )
+                return self._execute_stmt(inner)
             want = _count_parameters(inner)
             if len(stmt.args) != want:
                 raise ValueError(
@@ -607,6 +613,82 @@ _ACTIVE_SESSION: contextvars.ContextVar = contextvars.ContextVar(
 
 def current_session():
     return _ACTIVE_SESSION.get()
+
+
+def _subst_sql_params(sql: str, args, pos: int):
+    """Replace top-level ? placeholders in a raw SQL slice with the
+    argument texts starting at args[pos]. '?' inside single-quoted
+    string literals, double-quoted identifiers, or -- and /* */
+    comments is data, matching the tokenizer's lexical rules.
+    Returns (new_sql, next_pos)."""
+
+    def quoted_span(i: int, quote: str) -> int:
+        # end index (exclusive) of a quoted span starting at i; doubled
+        # quotes escape
+        j = i + 1
+        while j < len(sql):
+            if sql[j] == quote:
+                if j + 1 < len(sql) and sql[j + 1] == quote:
+                    j += 2
+                    continue
+                return j + 1
+            j += 1
+        return j
+
+    out = []
+    i, n = 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch in ("'", '"'):
+            j = quoted_span(i, ch)
+            out.append(sql[i:j])
+            i = j
+            continue
+        if ch == "-" and sql[i:i + 2] == "--":
+            j = sql.find("\n", i)
+            j = n if j < 0 else j + 1
+            out.append(sql[i:j])
+            i = j
+            continue
+        if ch == "/" and sql[i:i + 2] == "/*":
+            j = sql.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append(sql[i:j])
+            i = j
+            continue
+        if ch == "?":
+            if pos >= len(args):
+                raise ValueError(
+                    f"query needs {pos + 1}+ parameters, EXECUTE "
+                    f"supplies {len(args)}"
+                )
+            out.append("(" + args[pos] + ")")
+            pos += 1
+            i += 1
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out), pos
+
+
+def _bind_dml_parameters(stmt, arg_sqls):
+    """Positional ? substitution across a Delete/Update statement's raw
+    SQL slices (assignments left-to-right, then WHERE — source order).
+    Returns (bound statement, parameters consumed)."""
+    pos = 0
+    if isinstance(stmt, N.Update):
+        assigns = []
+        for col, expr_sql in stmt.assignments:
+            bound, pos = _subst_sql_params(expr_sql, arg_sqls, pos)
+            assigns.append((col, bound))
+        where = stmt.where_sql
+        if where is not None:
+            where, pos = _subst_sql_params(where, arg_sqls, pos)
+        return N.Update(stmt.parts, tuple(assigns), where), pos
+    where = stmt.where_sql
+    if where is not None:
+        where, pos = _subst_sql_params(where, arg_sqls, pos)
+    return N.Delete(stmt.parts, where), pos
 
 
 def _count_parameters(node) -> int:

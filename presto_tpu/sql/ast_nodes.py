@@ -329,10 +329,14 @@ class Prepare(Node):
 
 @dataclasses.dataclass(frozen=True)
 class ExecutePrepared(Node):
-    """EXECUTE name [USING expr, ...] (reference: sql/tree/Execute)."""
+    """EXECUTE name [USING expr, ...] (reference: sql/tree/Execute).
+    arg_sqls carries each argument's raw source text so parameters can
+    substitute into DML statements whose predicates/assignments ride as
+    raw SQL slices (Delete/Update below)."""
 
     name: str
     args: Tuple[Node, ...] = ()
+    arg_sqls: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

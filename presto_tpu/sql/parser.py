@@ -219,12 +219,18 @@ class Parser:
         if self.accept_keyword("execute"):
             name = self.expect_name()
             args: List[N.Node] = []
+            arg_sqls: List[str] = []
             if self.accept_keyword("using"):
-                args.append(self.parse_expr())
-                while self.accept_op(","):
+                while True:
+                    start = self.peek().pos
                     args.append(self.parse_expr())
+                    end = self.peek().pos
+                    arg_sqls.append(
+                        self.source[start:end].strip().rstrip(";"))
+                    if not self.accept_op(","):
+                        break
             self._finish()
-            return N.ExecutePrepared(name, tuple(args))
+            return N.ExecutePrepared(name, tuple(args), tuple(arg_sqls))
         if self.accept_keyword("deallocate"):
             self.accept_keyword("prepare")
             name = self.expect_name()

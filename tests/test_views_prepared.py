@@ -142,3 +142,51 @@ def test_prepared_statements_scoped_per_user(runner):
 def test_prepare_validates_statement(runner):
     with pytest.raises(Exception):
         runner.execute("prepare bad from select from from")
+
+
+def test_prepared_dml_parameters(runner):
+    """? parameters substitute into DELETE/UPDATE raw-SQL slices
+    positionally (assignments left-to-right, then WHERE); '?' inside a
+    string literal is data (reference: sql/tree/Parameter binding over
+    Delete/Update)."""
+    runner.execute(
+        "create table memory.pt as select 1 a, 'x' b "
+        "union all select 2, 'y' union all select 3, 'z'"
+    )
+    runner.execute("prepare pd from delete from memory.pt where a = ?")
+    runner.execute("execute pd using 2")
+    assert runner.execute(
+        "select a, b from memory.pt order by 1"
+    ).rows == [(1, "x"), (3, "z")]
+    runner.execute(
+        "prepare pu from update memory.pt set b = ? where a = ?"
+    )
+    runner.execute("execute pu using 'it''s', 3")
+    assert runner.execute(
+        "select a, b from memory.pt order by 1"
+    ).rows == [(1, "x"), (3, "it's")]
+    # arity mismatch is a clear error
+    with pytest.raises(Exception):
+        runner.execute("execute pd using 1, 2")
+    # '?' inside a string literal is NOT a parameter
+    runner.execute(
+        "prepare pq from delete from memory.pt where b = '?'"
+    )
+    runner.execute("execute pq")
+    assert len(runner.execute("select a from memory.pt").rows) == 2
+
+
+def test_projected_string_constants_decode(runner):
+    """A projected string constant (and casts of it) is first-class:
+    it decodes as its value, not its dictionary code."""
+    assert runner.execute("select 'x'").rows == [("x",)]
+    assert runner.execute(
+        "select 'x' union all select 'y'"
+    ).rows in ([("x",), ("y",)], [("y",), ("x",)])
+    assert runner.execute(
+        "select cast('q' as varchar)"
+    ).rows == [("q",)]
+    runner.execute("create table memory.sc as select 1 a, 'w' b")
+    assert runner.execute(
+        "select b from memory.sc"
+    ).rows == [("w",)]
