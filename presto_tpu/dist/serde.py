@@ -8,11 +8,15 @@ and serializes ONLY at the process boundary, exactly as SURVEY §6.8
 prescribes: "the HTTP shapes survive only at the pod boundary".
 
 Format (little-endian, zlib-compressed payload):
-    header: JSON {blocks: [{dtype(s), has_nulls, dictionary?, type}],
-            capacity} + per-array raw bytes, length-prefixed.
-Types are reconstructed by name through presto_tpu.types; dictionaries
-ship as JSON value lists (content-equal on arrival — Dictionary hashes
-by content).
+    header: JSON {blocks: [{dtype(s), encs, has_nulls, dictionary?,
+            type}], capacity} + per-array raw bytes, length-prefixed.
+Per-array encodings (the BlockEncoding analog): "raw" ships the full
+array; "rle" ships ONE element for a constant run of the page's
+capacity (reference: spi/block/RunLengthEncodedBlock — constant
+columns, all-false null masks, and all-true validity masks collapse to
+one value on the wire). Types are reconstructed by name through
+presto_tpu.types; dictionaries ship as JSON value lists (content-equal
+on arrival — Dictionary hashes by content).
 """
 
 from __future__ import annotations
@@ -27,7 +31,7 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu.page import Block, Dictionary, Page
 
-_MAGIC = b"PTP1"
+_MAGIC = b"PTP2"
 
 
 def _type_to_json(t: T.SqlType):
@@ -43,34 +47,69 @@ def _arrays_of(block: Block) -> List[np.ndarray]:
     return [np.asarray(d) for d in datas]
 
 
+def _dic_value_to_json(v):
+    """Type-preserving dictionary-value encoding: dictionaries hold
+    strings, python ints/floats/bools, bytes (varbinary), None, and
+    nested tuples (array/map/row values) — str() would corrupt all but
+    the first (reference analog: BlockEncoding serde is typed)."""
+    if v is None or isinstance(v, (str, bool)):
+        return v
+    if isinstance(v, (bytes, bytearray)):
+        return {"b": bytes(v).hex()}
+    if isinstance(v, (int, float)):
+        return {"n": v}
+    if isinstance(v, (tuple, list)):
+        return {"t": [_dic_value_to_json(x) for x in v]}
+    return str(v)
+
+
+def _dic_value_from_json(v):
+    if v is None or isinstance(v, (str, bool)):
+        return v
+    if isinstance(v, dict):
+        if "b" in v:
+            return bytes.fromhex(v["b"])
+        if "n" in v:
+            return v["n"]
+        if "t" in v:
+            return tuple(_dic_value_from_json(x) for x in v["t"])
+    return v
+
+
 def serialize_page(page: Page) -> bytes:
     """One Page -> bytes (the SerializedPage analog)."""
     header = {"capacity": int(page.capacity), "blocks": []}
     payload = bytearray()
 
-    def put(arr: np.ndarray):
-        b = np.ascontiguousarray(arr).tobytes()
+    def put(arr: np.ndarray) -> str:
+        arr = np.ascontiguousarray(arr)
+        if arr.size > 1 and bool((arr == arr.flat[0]).all()):
+            b = arr[:1].tobytes()
+            payload.extend(struct.pack("<q", len(b)))
+            payload.extend(b)
+            return "rle"
+        b = arr.tobytes()
         payload.extend(struct.pack("<q", len(b)))
         payload.extend(b)
+        return "raw"
 
     for blk in page.blocks:
         arrays = _arrays_of(blk)
-        header["blocks"].append({
+        bh = {
             "type": _type_to_json(blk.type),
             "dtypes": [a.dtype.str for a in arrays],
             "nwords": len(arrays),
             "has_nulls": blk.nulls is not None,
             "dictionary": (
-                [None if v is None else str(v)
-                 for v in blk.dictionary.values]
+                [_dic_value_to_json(v) for v in blk.dictionary.values]
                 if blk.dictionary is not None else None
             ),
-        })
-        for a in arrays:
-            put(a)
+        }
+        bh["encs"] = [put(a) for a in arrays]
         if blk.nulls is not None:
-            put(np.asarray(blk.nulls))
-    put(np.asarray(page.valid))
+            bh["nulls_enc"] = put(np.asarray(blk.nulls))
+        header["blocks"].append(bh)
+    header["valid_enc"] = put(np.asarray(page.valid))
     hdr = json.dumps(header).encode()
     body = zlib.compress(bytes(payload), level=1)
     return (_MAGIC + struct.pack("<ii", len(hdr), len(body))
@@ -84,28 +123,40 @@ def deserialize_page(buf: bytes) -> Page:
     payload = zlib.decompress(buf[12 + hlen:12 + hlen + blen])
     pos = 0
 
-    def take(dtype, n):
+    def take(dtype, n, enc="raw"):
         nonlocal pos
         (ln,) = struct.unpack_from("<q", payload, pos)
         pos += 8
-        arr = np.frombuffer(payload, dtype=dtype, count=n,
+        count = 1 if enc == "rle" else n
+        arr = np.frombuffer(payload, dtype=dtype, count=count,
                             offset=pos).copy()
         pos += ln
+        if enc == "rle":
+            arr = np.full((n,), arr[0], dtype=dtype)
         return arr
 
     cap = header["capacity"]
     blocks = []
     for bh in header["blocks"]:
-        arrays = [take(np.dtype(d), cap) for d in bh["dtypes"]]
-        nulls = take(np.bool_, cap) if bh["has_nulls"] else None
-        dic = (Dictionary(bh["dictionary"])
-               if bh["dictionary"] is not None else None)
+        arrays = [
+            take(np.dtype(d), cap, e)
+            for d, e in zip(bh["dtypes"], bh["encs"])
+        ]
+        nulls = (
+            take(np.bool_, cap, bh.get("nulls_enc", "raw"))
+            if bh["has_nulls"] else None
+        )
+        dic = (
+            Dictionary([_dic_value_from_json(v)
+                        for v in bh["dictionary"]])
+            if bh["dictionary"] is not None else None
+        )
         data = tuple(arrays) if bh["nwords"] > 1 else arrays[0]
         blocks.append(Block(
             data=data, type=_type_from_json(bh["type"]), nulls=nulls,
             dictionary=dic,
         ))
-    valid = take(np.bool_, cap)
+    valid = take(np.bool_, cap, header.get("valid_enc", "raw"))
     return Page(blocks=tuple(blocks), valid=valid)
 
 

@@ -1404,3 +1404,4 @@ register("row", lambda a: T.RowType(tuple(a)), _impl_row_ctor)
 # extended builtin families (JSON, TRY/TRY_CAST, bitwise, URL, array/map
 # utilities) register themselves on import — see functions_ext.py
 from presto_tpu.expr import functions_ext  # noqa: E402,F401  isort:skip
+from presto_tpu.expr import functions_more  # noqa: E402,F401  isort:skip

@@ -1,0 +1,565 @@
+"""Function registry breadth: the remaining reference scalar families.
+
+Reference: presto-main operator/scalar/* — RegexpFunctions (the full
+regexp_* set), StringFunctions (translate/levenshtein/hamming/soundex),
+VarbinaryFunctions (to_utf8/crc32/xxhash64/sha512/hmac_*/big-endian),
+BitwiseFunctions (shifts), UrlFunctions (component extractors),
+ArrayFunctions (set algebra/zip), MapFunctions (concat/from_entries).
+
+All string/array/map work rides the dictionary pattern of
+functions.py/functions_ext.py: host-side transforms over the DISTINCT
+value table plus an on-device code remap, so per-row device work stays
+O(1) gathers regardless of string length. Binary (column, column)
+string/array ops go through a bounded pair universe: the cross product
+of both dictionaries' values is enumerated host-side when small enough
+and refused (clear error) when it would explode — the engine's honest
+version of per-row host work it cannot vectorize.
+
+Varbinary stays host-side (types.py: "VARBINARY -> host-side payloads")
+— varbinary values are python `bytes` living in dictionaries, and the
+hash/codec functions return them as first-class values.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.expr.functions import (
+    Ctx,
+    _dict_int,
+    _dict_map,
+    _dict_map_nullable,
+    register,
+)
+from presto_tpu.expr.functions_ext import (
+    _array_resolve_same,
+    _dict_of,
+    _elem_result_val,
+    _lam_of,
+    _require_const,
+    _run_lambda,
+    _str_resolve,
+    _varchar_results,
+)
+from presto_tpu.expr.values import Val, union_nulls
+from presto_tpu.page import Dictionary
+
+_PAIR_LIMIT = 1 << 16
+
+
+def _strcol(val: Val) -> Val:
+    """Constant string/varbinary inputs become one-entry-dictionary
+    columns so every dictionary-based helper (including functions.py's
+    const-rejecting ones) applies uniformly."""
+    if (val.dictionary is None and val.is_const
+            and val.py_value is not None):
+        return Val(val.data, val.nulls, val.type,
+                   Dictionary([val.py_value]), py_value=val.py_value)
+    return val
+
+
+def _pair_map(ctx: Ctx, a: Val, b: Val, fn, rt) -> Val:
+    """Binary op over two dictionary-coded columns via the bounded
+    cross-product universe: result[i] = fn(a_val[i], b_val[i]) computed
+    per distinct (a, b) PAIR, with codes pair_code = a*len(db) + b."""
+    a, b = _strcol(a), _strcol(b)
+    da, db = _dict_of(a), _dict_of(b)
+    if len(da) * max(len(db), 1) > _PAIR_LIMIT:
+        raise TypeError(
+            "dictionary pair universe too large for host evaluation "
+            f"({len(da)}x{len(db)}); reduce distinct values or make "
+            "one side a constant"
+        )
+    results = [fn(x, y) for x in da.values for y in db.values]
+    xp = ctx.xp
+    ca = xp.clip(a.data, 0, max(len(da) - 1, 0)).astype(np.int64)
+    cb = xp.clip(b.data, 0, max(len(db) - 1, 0)).astype(np.int64)
+    pair = Val(
+        ca * max(len(db), 1) + cb,
+        union_nulls(xp, a.nulls, b.nulls),
+        a.type,
+        Dictionary(list(range(len(results)))),  # placeholder universe
+    )
+    return _elem_result_val(ctx, pair, results, rt)
+
+
+# ------------------------------------------------------------------ regexp
+
+
+def _const_pat(vals: List[Val], idx: int = 1) -> str:
+    return str(_require_const(vals[idx], "regexp pattern"))
+
+
+def _impl_regexp_extract_all(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    rx = re.compile(_const_pat(vals))
+    group = int(_require_const(vals[2], "regexp group")) \
+        if len(vals) > 2 else 0
+
+    def one(v):
+        return tuple(
+            m.group(group) for m in rx.finditer(str(v))
+        )
+
+    return _elem_result_val(ctx, _strcol(vals[0]), [one(v) for v in _dict_of(_strcol(vals[0])).values],
+        T.ArrayType(T.VARCHAR),
+    )
+
+
+register("regexp_extract_all", lambda a: T.ArrayType(T.VARCHAR),
+         _impl_regexp_extract_all)
+
+
+def _impl_regexp_split(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    rx = re.compile(_const_pat(vals))
+    return _elem_result_val(ctx, _strcol(vals[0]),
+        [tuple(rx.split(str(v))) for v in _dict_of(_strcol(vals[0])).values],
+        T.ArrayType(T.VARCHAR),
+    )
+
+
+register("regexp_split", lambda a: T.ArrayType(T.VARCHAR),
+         _impl_regexp_split)
+
+register("regexp_count", lambda a: T.BIGINT,
+         lambda ctx, rt, vals: _dict_int(ctx, _strcol(vals[0]),
+             lambda v, rx=re.compile(_const_pat(vals)):
+             sum(1 for _ in rx.finditer(str(v)))))
+register("regexp_position", lambda a: T.BIGINT,
+         lambda ctx, rt, vals: _dict_int(ctx, _strcol(vals[0]),
+             lambda v, rx=re.compile(_const_pat(vals)):
+             (lambda m: m.start() + 1 if m else -1)(rx.search(str(v)))))
+
+
+# ------------------------------------------------------------------ string
+
+
+def _impl_translate(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    src = str(_require_const(vals[1], "translate from"))
+    dst = str(_require_const(vals[2], "translate to"))
+    table = {}
+    for i, ch in enumerate(src):
+        table.setdefault(ord(ch), dst[i] if i < len(dst) else None)
+    tbl = {k: v for k, v in table.items()}
+    return _dict_map(ctx, _strcol(vals[0]),
+        lambda v: "".join(
+            tbl.get(ord(c), c) for c in str(v)
+            if tbl.get(ord(c), c) is not None
+        ),
+        T.VARCHAR,
+    )
+
+
+register("translate", lambda a: T.VARCHAR, _impl_translate)
+
+
+def _soundex(s: str) -> str:
+    codes = {**dict.fromkeys("BFPV", "1"), **dict.fromkeys("CGJKQSXZ", "2"),
+             **dict.fromkeys("DT", "3"), "L": "4",
+             **dict.fromkeys("MN", "5"), "R": "6"}
+    s = "".join(c for c in str(s).upper() if c.isalpha())
+    if not s:
+        return ""
+    out, prev = s[0], codes.get(s[0], "")
+    for c in s[1:]:
+        code = codes.get(c, "")
+        if code and code != prev:
+            out += code
+        if c not in "HW":
+            prev = code
+    return (out + "000")[:4]
+
+
+register("soundex", lambda a: T.VARCHAR,
+         lambda ctx, rt, vals: _dict_map(ctx, _strcol(vals[0]), _soundex,
+                                         T.VARCHAR))
+
+
+def _levenshtein(a: str, b: str) -> int:
+    a, b = str(a), str(b)
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def _impl_levenshtein(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    if vals[1].is_const:
+        w = str(vals[1].py_value)
+        return _dict_int(ctx, _strcol(vals[0]),
+                         lambda v: _levenshtein(str(v), w))
+    return _pair_map(ctx, vals[0], vals[1],
+                     lambda x, y: _levenshtein(str(x), str(y)),
+                     T.BIGINT)
+
+
+register("levenshtein_distance", lambda a: T.BIGINT, _impl_levenshtein)
+
+
+def _hamming(a: str, b: str) -> Optional[int]:
+    a, b = str(a), str(b)
+    if len(a) != len(b):
+        return None  # reference raises; masked-eval policy -> NULL
+    return sum(1 for x, y in zip(a, b) if x != y)
+
+
+def _impl_hamming(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    if vals[1].is_const:
+        w = str(vals[1].py_value)
+        return _dict_map_nullable(ctx, _strcol(vals[0]), lambda v: _hamming(str(v), w), T.BIGINT)
+    return _pair_map(ctx, vals[0], vals[1], _hamming, T.BIGINT)
+
+
+register("hamming_distance", lambda a: T.BIGINT, _impl_hamming)
+
+
+def _luhn(s: str) -> bool:
+    digits = str(s)
+    if not digits.isdigit():
+        return False
+    total = 0
+    for i, ch in enumerate(reversed(digits)):
+        d = int(ch)
+        if i % 2 == 1:
+            d *= 2
+            if d > 9:
+                d -= 9
+        total += d
+    return total % 10 == 0
+
+
+register("luhn_check", lambda a: T.BOOLEAN,
+         lambda ctx, rt, vals: _dict_map(ctx, _strcol(vals[0]), _luhn, T.BOOLEAN))
+
+
+# --------------------------------------------------------------- varbinary
+# varbinary values are python bytes living in dictionaries (types.py)
+
+
+def _as_bytes(v) -> bytes:
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    return str(v).encode("utf-8")
+
+
+register("to_utf8", lambda a: T.VARBINARY,
+         lambda ctx, rt, vals: _elem_result_val(ctx, _strcol(vals[0]),
+             [_as_bytes(v) for v in _dict_of(_strcol(vals[0])).values],
+             T.VARBINARY))
+register("from_utf8", lambda a: T.VARCHAR,
+         lambda ctx, rt, vals: _varchar_results(ctx, _strcol(vals[0]),
+             [_as_bytes(v).decode("utf-8", "replace")
+              for v in _dict_of(_strcol(vals[0])).values]))
+register("crc32", lambda a: T.BIGINT,
+         lambda ctx, rt, vals: _dict_int(ctx, _strcol(vals[0]),
+             lambda v: zlib.crc32(_as_bytes(v)) & 0xFFFFFFFF))
+
+
+def _xxhash64_bytes(b: bytes) -> int:
+    from presto_tpu.ops.hashing import xxhash64_host
+
+    return xxhash64_host(b)
+
+
+register("xxhash64", lambda a: T.VARBINARY,
+         lambda ctx, rt, vals: _elem_result_val(ctx, _strcol(vals[0]),
+             [(_xxhash64_bytes(_as_bytes(v)) & (2**64 - 1)
+               ).to_bytes(8, "big")
+              for v in _dict_of(_strcol(vals[0])).values],
+             T.VARBINARY))
+
+
+def _impl_hashfn_bytes(algo):
+    import hashlib
+
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        return _elem_result_val(ctx, _strcol(vals[0]),
+            [hashlib.new(algo, _as_bytes(v)).digest()
+             for v in _dict_of(_strcol(vals[0])).values],
+            T.VARBINARY,
+        )
+
+    return impl
+
+
+register("sha512", lambda a: T.VARBINARY, _impl_hashfn_bytes("sha512"))
+
+
+def _const_value(val: Val, what: str):
+    """A constant py_value OR the single entry of a one-entry
+    dictionary (a constant that went through a function, e.g.
+    to_utf8('key'))."""
+    if val.is_const:
+        return val.py_value
+    if val.dictionary is not None and len(val.dictionary) == 1:
+        return val.dictionary.values[0]
+    raise TypeError(f"{what} must be a constant")
+
+
+def _impl_hmac(algo):
+    import hashlib
+    import hmac as hmac_mod
+
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        key = _as_bytes(_const_value(vals[1], "hmac key"))
+        return _elem_result_val(ctx, _strcol(vals[0]),
+            [hmac_mod.new(key, _as_bytes(v), algo).digest()
+             for v in _dict_of(_strcol(vals[0])).values],
+            T.VARBINARY,
+        )
+
+    return impl
+
+
+register("hmac_md5", lambda a: T.VARBINARY, _impl_hmac("md5"))
+register("hmac_sha1", lambda a: T.VARBINARY, _impl_hmac("sha1"))
+register("hmac_sha256", lambda a: T.VARBINARY, _impl_hmac("sha256"))
+register("hmac_sha512", lambda a: T.VARBINARY, _impl_hmac("sha512"))
+
+register("from_big_endian_64", lambda a: T.BIGINT,
+         lambda ctx, rt, vals: _dict_int(ctx, _strcol(vals[0]),
+             lambda v: int.from_bytes(
+                 _as_bytes(v)[:8], "big", signed=True)))
+register("from_big_endian_32", lambda a: T.INTEGER,
+         lambda ctx, rt, vals: _dict_int(ctx, _strcol(vals[0]),
+             lambda v: int.from_bytes(
+                 _as_bytes(v)[:4], "big", signed=True)))
+
+
+# ---------------------------------------------------------------- bitwise
+
+
+def _impl_shift(kind):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        xp = ctx.xp
+        x = vals[0].data.astype(np.int64)
+        n = vals[1].data.astype(np.int64)
+        nc = xp.clip(n, 0, 63)
+        if kind == "left":
+            out = xp.where(n >= 64, np.int64(0), x << nc)
+        elif kind == "arith":
+            # >=64 saturates to the sign fill, which clip-to-63 gives
+            out = x >> nc
+        else:  # logical right: >=64 shifts everything out
+            out = xp.where(
+                n >= 64, np.int64(0),
+                (x.astype(np.uint64) >> nc.astype(np.uint64))
+                .astype(np.int64),
+            )
+        return Val(out, union_nulls(xp, vals[0].nulls, vals[1].nulls),
+                   T.BIGINT)
+
+    return impl
+
+
+register("bitwise_left_shift", lambda a: T.BIGINT, _impl_shift("left"),
+         propagate_nulls=False)
+register("bitwise_right_shift", lambda a: T.BIGINT,
+         _impl_shift("logical"), propagate_nulls=False)
+register("bitwise_right_shift_arithmetic", lambda a: T.BIGINT,
+         _impl_shift("arith"), propagate_nulls=False)
+register("bit_length", lambda a: T.BIGINT,
+         lambda ctx, rt, vals: _dict_int(ctx, _strcol(vals[0]), lambda v: len(_as_bytes(v)) * 8))
+
+
+# -------------------------------------------------------------------- url
+
+
+def _impl_url_part(part):
+    from urllib.parse import urlparse
+
+    def one(v):
+        try:
+            u = urlparse(str(v))
+        except Exception:
+            return None
+        got = {
+            "host": u.hostname, "path": u.path or "",
+            "protocol": u.scheme, "query": u.query,
+            "fragment": u.fragment,
+        }[part]
+        return None if got is None else str(got)
+
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        return _dict_map_nullable(ctx, _strcol(vals[0]), one, T.VARCHAR)
+
+    return impl
+
+
+for _p in ("host", "path", "protocol", "query", "fragment"):
+    register(f"url_extract_{_p}", _str_resolve, _impl_url_part(_p))
+
+
+def _impl_url_port(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    from urllib.parse import urlparse
+
+    def one(v):
+        try:
+            p = urlparse(str(v)).port
+        except Exception:
+            return None
+        return p
+
+    d = _dict_of(_strcol(vals[0]))
+    return _elem_result_val(ctx, _strcol(vals[0]), [one(v) for v in d.values], T.BIGINT
+    )
+
+
+register("url_extract_port", lambda a: T.BIGINT, _impl_url_port)
+
+# url_encode / url_decode already live in functions_ext.py
+
+
+# ------------------------------------------------------------- array sets
+
+
+def _impl_array_setop(op):
+    def impl(ctx: Ctx, rt, vals: List[Val]) -> Val:
+        def fn(x, y):
+            x, y = tuple(x), tuple(y)
+            if op == "union":
+                return tuple(dict.fromkeys(x + y))
+            if op == "intersect":
+                ys = set(y)
+                return tuple(dict.fromkeys(v for v in x if v in ys))
+            ys = set(y)  # except
+            return tuple(dict.fromkeys(v for v in x if v not in ys))
+
+        return _pair_map(ctx, vals[0], vals[1], fn, rt)
+
+    return impl
+
+
+register("array_union", _array_resolve_same, _impl_array_setop("union"))
+register("array_intersect", _array_resolve_same,
+         _impl_array_setop("intersect"))
+register("array_except", _array_resolve_same, _impl_array_setop("except"))
+register("arrays_overlap", lambda a: T.BOOLEAN,
+         lambda ctx, rt, vals: _pair_map(
+             ctx, vals[0], vals[1],
+             lambda x, y: bool(set(x) & set(y)), T.BOOLEAN))
+
+
+def _impl_zip(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    t0, t1 = vals[0].type, vals[1].type
+    rt2 = T.ArrayType(T.RowType((
+        t0.element if isinstance(t0, T.ArrayType) else T.UNKNOWN,
+        t1.element if isinstance(t1, T.ArrayType) else T.UNKNOWN,
+    )))
+    return _pair_map(
+        ctx, vals[0], vals[1],
+        lambda x, y: tuple(
+            (x[i] if i < len(x) else None,
+             y[i] if i < len(y) else None)
+            for i in range(max(len(x), len(y)))
+        ),
+        rt2,
+    )
+
+
+register(
+    "zip",
+    lambda a: T.ArrayType(T.RowType((
+        a[0].element if isinstance(a[0], T.ArrayType) else T.UNKNOWN,
+        a[1].element if isinstance(a[1], T.ArrayType) else T.UNKNOWN,
+    ))),
+    _impl_zip,
+)
+
+
+def _impl_zip_with(ctx: Ctx, rt, vals: List) -> Val:
+    a, b, lam = vals[0], vals[1], _lam_of(vals, 2)
+    ta = a.type.element if isinstance(a.type, T.ArrayType) else T.UNKNOWN
+    tb = b.type.element if isinstance(b.type, T.ArrayType) else T.UNKNOWN
+
+    def fn(x, y):
+        x, y = tuple(x), tuple(y)
+        n = max(len(x), len(y))
+        xs = [x[i] if i < len(x) else None for i in range(n)]
+        ys = [y[i] if i < len(y) else None for i in range(n)]
+        return tuple(_run_lambda(lam, [xs, ys], [ta, tb]))
+
+    return _pair_map(ctx, a, b, fn, rt)
+
+
+register(
+    "zip_with",
+    # args = (array, array, lambda-body type) — result element type is
+    # the lambda's
+    lambda a: T.ArrayType(a[2] if len(a) > 2 else T.UNKNOWN),
+    _impl_zip_with,
+)
+
+
+# -------------------------------------------------------------------- maps
+
+
+def _impl_map_concat(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    def fn(x, y):
+        out = dict(tuple(kv) for kv in x)
+        out.update(dict(tuple(kv) for kv in y))
+        return tuple(out.items())
+
+    return _pair_map(ctx, vals[0], vals[1], fn, vals[0].type)
+
+
+register(
+    "map_concat",
+    lambda a: a[0] if isinstance(a[0], T.MapType) else T.UNKNOWN,
+    _impl_map_concat,
+)
+
+
+def _impl_map_from_entries(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    t = vals[0].type
+    elem = t.element if isinstance(t, T.ArrayType) else None
+    kt = elem.fields[0] if isinstance(elem, T.RowType) else T.UNKNOWN
+    vt = elem.fields[1] if isinstance(elem, T.RowType) else T.UNKNOWN
+    return _elem_result_val(ctx, _strcol(vals[0]),
+        [tuple(dict(tuple(kv) for kv in v).items())
+         for v in _dict_of(_strcol(vals[0])).values],
+        T.MapType(kt, vt),
+    )
+
+
+register(
+    "map_from_entries",
+    lambda a: T.MapType(
+        a[0].element.fields[0], a[0].element.fields[1]
+    ) if (isinstance(a[0], T.ArrayType)
+          and isinstance(a[0].element, T.RowType)) else T.UNKNOWN,
+    _impl_map_from_entries,
+)
+
+
+def _impl_split_to_map(ctx: Ctx, rt, vals: List[Val]) -> Val:
+    entry_d = str(_require_const(vals[1], "entry delimiter"))
+    kv_d = str(_require_const(vals[2], "key/value delimiter"))
+
+    def one(v):
+        out = {}
+        s = str(v)
+        if not s:
+            return ()
+        for part in s.split(entry_d):
+            k, _, val = part.partition(kv_d)
+            out[k] = val
+        return tuple(out.items())
+
+    return _elem_result_val(ctx, _strcol(vals[0]), [one(v) for v in _dict_of(_strcol(vals[0])).values],
+        T.MapType(T.VARCHAR, T.VARCHAR),
+    )
+
+
+register("split_to_map", lambda a: T.MapType(T.VARCHAR, T.VARCHAR),
+         _impl_split_to_map)

@@ -38,6 +38,70 @@ def _rotl(x: jnp.ndarray, r: int) -> jnp.ndarray:
     return (x << jnp.uint64(r)) | (x >> jnp.uint64(64 - r))
 
 
+_M64 = (1 << 64) - 1
+
+
+def xxhash64_host(data: bytes, seed: int = 0) -> int:
+    """Full xxhash64 over a byte string (host-side scalar; the scalar
+    xxhash64() function's implementation — reference:
+    io.airlift.slice.XxHash64.hash(Slice))."""
+    p1, p2, p3, p4, p5 = (int(_P1), int(_P2), int(_P3), int(_P4),
+                          int(_P5))
+
+    def rot(x, r):
+        return ((x << r) | (x >> (64 - r))) & _M64
+
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + p1 + p2) & _M64
+        v2 = (seed + p2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - p1) & _M64
+
+        def rnd(acc, lane):
+            acc = (acc + lane * p2) & _M64
+            return (rot(acc, 31) * p1) & _M64
+
+        while i + 32 <= n:
+            v1 = rnd(v1, int.from_bytes(data[i:i + 8], "little"))
+            v2 = rnd(v2, int.from_bytes(data[i + 8:i + 16], "little"))
+            v3 = rnd(v3, int.from_bytes(data[i + 16:i + 24], "little"))
+            v4 = rnd(v4, int.from_bytes(data[i + 24:i + 32], "little"))
+            i += 32
+        h = (rot(v1, 1) + rot(v2, 7) + rot(v3, 12) + rot(v4, 18)) & _M64
+
+        def merge(h, v):
+            h ^= rnd(0, v)
+            return (h * p1 + p4) & _M64
+
+        h = merge(h, v1)
+        h = merge(h, v2)
+        h = merge(h, v3)
+        h = merge(h, v4)
+    else:
+        h = (seed + p5) & _M64
+    h = (h + n) & _M64
+    while i + 8 <= n:
+        k = (int.from_bytes(data[i:i + 8], "little") * p2) & _M64
+        k = (rot(k, 31) * p1) & _M64
+        h = ((rot(h ^ k, 27) * p1) + p4) & _M64
+        i += 8
+    if i + 4 <= n:
+        k = (int.from_bytes(data[i:i + 4], "little") * p1) & _M64
+        h = ((rot(h ^ k, 23) * p2) + p3) & _M64
+        i += 4
+    while i < n:
+        h = (rot(h ^ ((data[i] * p5) & _M64), 11) * p1) & _M64
+        i += 1
+    h ^= h >> 33
+    h = (h * p2) & _M64
+    h ^= h >> 29
+    h = (h * p3) & _M64
+    h ^= h >> 32
+    return h
+
+
 def xxhash64_u64(value: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
     """xxhash64 of a single 8-byte little-endian value (vectorized).
 

@@ -2014,6 +2014,14 @@ class ExprTranslator:
             elif (e.name == "transform_values"
                     and len(a.params) == 1):
                 want = [param_types[1]]  # v -> ... binds the value
+            elif e.name == "zip_with":
+                # (x, y) -> ... binds both arrays' element types
+                t1 = out_args[1].type if len(out_args) > 1 else T.UNKNOWN
+                want = [
+                    param_types[0],
+                    t1.element if isinstance(t1, T.ArrayType)
+                    else T.UNKNOWN,
+                ]
             else:
                 want = (param_types if len(a.params) == len(param_types)
                         else param_types[: len(a.params)])
