@@ -10,16 +10,21 @@ failureDetector/HeartbeatFailureDetector (peer liveness).
 TPU-native shape (SURVEY §6.8): ICI-scale parallelism stays INSIDE a
 worker process as compiled collectives; this layer is the DCN half —
 processes exchange serialized pages over HTTP exactly where the
-reference does, but only at the PARTIAL/FINAL aggregation boundary:
+reference does, at one of two fragment boundaries:
 
-    worker w: scan(splits w::K of fact table) -> ... -> partial agg
-              -> serialized state pages
-    coordinator: RemoteSource(all workers) -> final agg -> rest of plan
+    PARTIAL/FINAL aggregation cut (tiny state pages; preferred):
+      worker w: scan(splits w::K of fact table) -> ... -> partial agg
+      coordinator: RemoteSource(all workers) -> final agg -> rest
+    UNION cut (general row-local subtree; multi-join pipelines with
+    no decomposable aggregation):
+      worker w: row-local subtree over split share -> result pages
+      coordinator: RemoteSource union -> sort/topN/window/agg -> rest
 
-Plan distribution is by REPLAY, not shipping: the worker re-plans the
-same SQL with the same deterministic planner and takes the same cut
-(fragment identity = (sql, role); divergence from the reference's
-serialized PlanFragment, documented in server/worker.py).
+Either way the task body carries the coordinator's SERIALIZED physical
+fragment (dist/plan_serde.py — the reference's TaskUpdateRequest
+PlanFragment); workers execute exactly that tree, never re-planning.
+Scans split round-robin or hash-co-partitioned on join keys (both big
+join sides 1/N per worker; hash_fanout_source).
 
 Failure model matches the reference: a worker death or exhausted fetch
 retries fails the QUERY cleanly (no task-level recovery; SURVEY §6.3),
@@ -36,13 +41,15 @@ import urllib.request
 import uuid
 from typing import Dict, List, Optional
 
-from presto_tpu.dist import serde
+from presto_tpu.dist import plan_serde, serde
 from presto_tpu.exec import plan as P
 from presto_tpu.server.heartbeat import HeartbeatFailureDetector
 from presto_tpu.server.worker import (
     fanout_safe,
     find_partial_cut,
+    find_union_cut,
     hash_fanout_plan,
+    hash_fanout_source,
     largest_table,
 )
 
@@ -152,43 +159,68 @@ class DcnRunner:
     # ---------------------------------------------------------- execute
     def execute(self, sql: str):
         plan = self.runner.plan(sql)
-        cut = find_partial_cut(plan)
-        if cut is None:
-            # no aggregation boundary: run locally (out of DCN scope)
-            self.last_distribution = "local"
-            return self.runner.execute(sql).rows
         ex = self.runner.executor
-        # PARTITIONED JOIN first (the hash-repartition exchange: both
-        # big join sides co-partitioned by key hash, build state 1/N
-        # per worker); round-robin split-table fan-out (replicated
-        # builds) is the fallback shape
-        partition_cols = hash_fanout_plan(
-            cut, self.runner.catalogs,
-            partition_threshold=self.partition_threshold,
-        )
-        split_table = largest_table(cut.source, self.runner.catalogs)
-        if partition_cols is None and (
-            split_table is None or not fanout_safe(cut, split_table)
-        ):
-            # non-decomposable shape (DISTINCT masks, outer/semi joins,
-            # self-joins of the fact table, nested aggs): run locally
-            # rather than wrong
-            self.last_distribution = "local"
-            return self.runner.execute(sql).rows
-        self.last_distribution = (
-            "hash" if partition_cols is not None else "roundrobin"
-        )
+        cut = find_partial_cut(plan)
+        partial = coord_plan = partition_cols = split_table = None
+        if cut is not None:
+            # best shape: PARTIAL/FINAL aggregation split — workers
+            # ship tiny accumulator-state pages. PARTITIONED JOIN
+            # first (the hash-repartition exchange: both big join
+            # sides co-partitioned by key hash, build state 1/N per
+            # worker); round-robin split-table fan-out (replicated
+            # builds) is the fallback shape
+            partition_cols = hash_fanout_plan(
+                cut, self.runner.catalogs,
+                partition_threshold=self.partition_threshold,
+            )
+            split_table = largest_table(cut.source,
+                                        self.runner.catalogs)
+            if partition_cols is not None or (
+                split_table is not None
+                and fanout_safe(cut, split_table)
+            ):
+                self.last_distribution = (
+                    "hash" if partition_cols is not None
+                    else "roundrobin"
+                )
+                partial = dataclasses.replace(cut, step="partial")
+        if partial is None:
+            # general shape: UNION CUT — workers execute the topmost
+            # row-local subtree (multi-join pipelines, no aggregation
+            # required) over their split share; the coordinator unions
+            # the pages and runs everything above (sort/topN/window/
+            # non-decomposable aggregation). Reference: a leaf-stage
+            # fragment under a GATHER exchange.
+            split_table = largest_table(plan, self.runner.catalogs)
+            ucut = (find_union_cut(plan, split_table)
+                    if split_table is not None else None)
+            if ucut is None:
+                # nothing distributable: run locally rather than wrong
+                self.last_distribution = "local"
+                return self.runner.execute(sql).rows
+            partition_cols = hash_fanout_source(
+                ucut, self.runner.catalogs,
+                partition_threshold=self.partition_threshold,
+            )
+            self.last_distribution = (
+                "union-hash" if partition_cols is not None
+                else "union-roundrobin"
+            )
+            cut, partial = ucut, ucut
         # coordinator-side final stage honors the same session the
         # workers were sent
         self.runner.apply_session()
 
-        # launch one task per worker
+        # launch one task per worker; the task body carries the
+        # SERIALIZED fragment (plan shipping — reference:
+        # TaskUpdateRequest.fragment), not SQL to replay
+        fragment = plan_serde.dumps(partial)
         qid = uuid.uuid4().hex[:12]
         tasks = []
         for w, uri in enumerate(self.worker_uris):
             payload = {
                 "taskId": f"{qid}.{w}",
-                "sql": sql,
+                "fragment": fragment,
                 "splitTable": split_table,
                 "splitIndex": w,
                 "splitCount": len(self.worker_uris),
@@ -205,14 +237,17 @@ class DcnRunner:
                 ) from e
             tasks.append((uri, f"{qid}.{w}"))
 
-        # coordinator-side plan: PARTIAL subtree -> RemoteSource
-        partial = dataclasses.replace(cut, step="partial")
+        # coordinator-side plan: shipped subtree -> RemoteSource
         state_types = tuple(ex.output_types(partial))
         key = f"dcn-{qid}"
         remote = P.RemoteSource(types=state_types, key=key,
                                 origin=partial)
-        final = dataclasses.replace(cut, step="final", source=remote)
-        coord_plan = _replace_node(plan, cut, final)
+        if partial is cut:  # union cut: consume the union as-is
+            coord_plan = _replace_node(plan, cut, remote)
+        else:  # aggregation cut: FINAL step over the state pages
+            final = dataclasses.replace(cut, step="final",
+                                        source=remote)
+            coord_plan = _replace_node(plan, cut, final)
 
         def supplier():
             for uri, task_id in tasks:

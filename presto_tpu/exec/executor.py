@@ -251,9 +251,21 @@ class Executor:
         # RAM (the HBM->host spill); below it they stay device-resident
         # as a page list. None = host tier disabled.
         self.host_spill_bytes: Optional[int] = None
+        # Third tier: intermediates estimated above disk_spill_bytes
+        # write to .npz spill files (FileSingleStreamSpiller proper);
+        # None = disk tier disabled. spill_path = target directory.
+        self.disk_spill_bytes: Optional[int] = None
+        self.spill_path: Optional[str] = None
         self._stream_cache: Dict = {}
         self.host_spill_pages = 0  # observability / tests
         self.host_spill_bytes_used = 0
+        self.disk_spill_pages = 0
+        # Per-partition skew rebalancing (SURVEY §6.7): on boosted
+        # retries, inner grace-join partitions chunk their build rows
+        # by position instead of growing buffers (join_skew_rebalance
+        # session property); skew_chunks_used is observability.
+        self.join_skew_rebalance = True
+        self.skew_chunks_used = 0
         # Hard per-pass row cap for join builds (session property
         # max_join_build_rows): partitions a join whenever the build-side
         # row estimate exceeds it, independent of the byte threshold.
@@ -758,6 +770,8 @@ class Executor:
         self.spill_partitions_used = 0
         self.host_spill_pages = 0
         self.host_spill_bytes_used = 0
+        self.disk_spill_pages = 0
+        self.skew_chunks_used = 0
         try:
             for _attempt in range(6):
                 self._pending_overflow = []
@@ -1084,14 +1098,19 @@ class Executor:
         if not self.agg_compact or not _subtree_has_join(src):
             yield from self.pages(src)
             return
+        # the planner's group-count estimate is a LOWER bound on the
+        # stream's valid rows — an accumulator smaller than it is
+        # guaranteed to overflow (observed: Q3 SF10's ~3M qualifying
+        # rows vs the 262k optimistic default), so size C to cover the
+        # estimate and skip compaction entirely when that can't fit
+        # under the axon >=4M-row fault line (the partitioned/plain
+        # paths handle dense streams without a rolling buffer)
+        est = _next_pow2(max(getattr(key_node, "capacity", 8), 8))
         C = _next_pow2(
-            max(self.agg_optimistic_rows or (1 << 18), 8192)
+            max(self.agg_optimistic_rows or (1 << 18), est, 8192)
             * self._capacity_boost
         )
         if C > (1 << 21):
-            # the accumulator itself would approach the axon >=4M-row
-            # fault line — a stream this dense gains nothing from
-            # compaction; fall back to the plain per-page flow
             yield from self.pages(src)
             return
         first = self._jit(
@@ -1420,18 +1439,22 @@ class Executor:
             est = self.estimate_rows(node) * _row_bytes(
                 self.output_types(node)
             )
-            tier = (
-                "host"
-                if self.host_spill_bytes is not None
-                and est > self.host_spill_bytes
-                else "device"
-            )
-            store = PageStore(tier)
+            if (self.disk_spill_bytes is not None
+                    and est > self.disk_spill_bytes):
+                tier = "disk"
+            elif (self.host_spill_bytes is not None
+                    and est > self.host_spill_bytes):
+                tier = "host"
+            else:
+                tier = "device"
+            store = PageStore(tier, spill_dir=self.spill_path)
             for page in self.pages(node):
                 store.put(page)
             if tier == "host":
                 self.host_spill_pages += store.page_count
                 self.host_spill_bytes_used += store.bytes
+            elif tier == "disk":
+                self.disk_spill_pages += store.page_count
             self._stream_cache[key] = store
         return self._stream_cache[key].stream
 
@@ -1761,7 +1784,16 @@ class Executor:
         sides filtered to hash(key) % P == p, so the build materialization
         is ~1/P of the single-pass size. Skewed partitions raise the
         deferred overflow flag and the query retries on the boosted
-        capacity ladder (same escape as every capacity decision here)."""
+        capacity ladder — where INNER joins take the per-partition
+        REBALANCING path instead of growing buffers (SURVEY §6.7):
+        a genuinely hot join key cannot be split by key hash, so the hot
+        partition's build rows are chunked by POSITION into passes whose
+        buffers stay at the unboosted (fault-line-safe) size, each chunk
+        probed by the full partition probe stream; inner-join output is
+        the disjoint union over chunks (every build row lives in exactly
+        one chunk). Reading exact partition sizes is a host sync, which
+        is admissible here because the retry boundary already paid the
+        one D2H read that triggers axon's post-read degradation."""
         self.spill_partitions_used = max(self.spill_partitions_used, parts)
         semi = node.join_type in ("semi", "anti")
         bfilter = self._partition_filter(node.right_keys, parts,
@@ -1769,8 +1801,19 @@ class Executor:
         pfilter = self._partition_filter(node.left_keys, parts)
         right_stream = self._source_stream(node.right)
         left_stream = self._source_stream(node.left)
+        rebalance = (
+            self.join_skew_rebalance
+            and self._capacity_boost > 1
+            and node.join_type == "inner"
+        )
         for p in range(parts):
             pj = jnp.uint64(p)
+            if rebalance:
+                yield from self._join_partition_rebalanced(
+                    node, p, parts, bfilter, pfilter, right_stream,
+                    left_stream, left_types, unique_build,
+                )
+                continue
             build_pages = []
             for pg in right_stream():
                 f = bfilter(pg, pj)
@@ -1801,6 +1844,64 @@ class Executor:
                                        left_types,
                                        unique_build=unique_build,
                                        density=parts)
+
+    def _join_partition_rebalanced(
+        self, node: P.HashJoin, p: int, parts: int, bfilter, pfilter,
+        right_stream, left_stream, left_types, unique_build: bool,
+    ) -> Iterator[Page]:
+        """One skew-rebalanced partition pass (see _exec_join_partitioned):
+        exact per-page build counts (host reads — recovery mode), pieces
+        packed greedily into chunks of at most the UNBOOSTED partition
+        cap, oversized pieces split by slice_page, one probe pass per
+        chunk."""
+        from presto_tpu.ops.compact import slice_page
+
+        pj = jnp.uint64(p)
+        chunk_cap = 1024
+        pieces: List[Page] = []
+        for pg in right_stream():
+            chunk_cap = max(
+                chunk_cap,
+                min(_next_pow2(max(pg.capacity // parts * 2, 1024)),
+                    _next_pow2(pg.capacity)),
+            )
+            f = bfilter(pg, pj)
+            n = int(f.num_rows())  # host sync: admissible on retry
+            if n:
+                pieces.append(compact_page(f, _next_pow2(max(n, 256))))
+        # greedy pack: pieces accumulate into a chunk until it would
+        # exceed chunk_cap; a single piece larger than chunk_cap splits
+        # by position
+        chunks: List[List[Page]] = [[]]
+        room = chunk_cap
+        for piece in pieces:
+            rows = piece.capacity  # compacted: capacity ~ rows
+            if rows > chunk_cap:
+                for off in range(0, rows, chunk_cap):
+                    chunks.append(
+                        [slice_page(piece, off, chunk_cap)]
+                    )
+                continue
+            if rows > room:
+                chunks.append([])
+                room = chunk_cap
+            chunks[-1].append(piece)
+            room -= rows
+        chunks = [c for c in chunks if c]
+        if not chunks:
+            return  # empty inner partition: no output
+        self.skew_chunks_used = max(self.skew_chunks_used, len(chunks))
+        for chunk in chunks:
+            build_all = concat_all(chunk)
+            build = compact_page(
+                build_all, _next_pow2(build_all.capacity)
+            )
+            self._account_page(build)
+            probe_pages = (pfilter(pg, pj) for pg in left_stream())
+            yield from self._join_pass(
+                node, build, probe_pages, left_types,
+                unique_build=unique_build, density=parts,
+            )
 
     def _join_pass(
         self, node: P.HashJoin, build: Page, probe_pages, left_types,

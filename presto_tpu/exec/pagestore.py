@@ -17,6 +17,13 @@ The TPU translation has two tiers:
   the HBM->host-RAM spill of SURVEY §6.4. This is what lets a
   partitioned operator consume an intermediate larger than device
   memory without recomputing the subplan that produced it.
+- tier="disk": each page's array leaves write to one .npz file in a
+  per-store temp directory (the treedef and static aux — types,
+  dictionaries — are tiny and stay in RAM); stream() re-reads and
+  re-stages. The FileSingleStreamSpiller analog proper: at SF100 a
+  partitioned join's materialized side can exceed host RAM (SURVEY
+  §6.4 sizes SF100 lineitem at ~80 GB raw). Files are deleted on
+  close()/GC.
 
 Stores are owned by the Executor per query attempt (capacity-boost
 retries invalidate them — cached pages may embed overflowed results).
@@ -24,9 +31,13 @@ retries invalidate them — cached pages may embed overflowed results).
 
 from __future__ import annotations
 
-from typing import Iterator, List
+import os
+import shutil
+import tempfile
+from typing import Iterator, List, Optional
 
 import jax
+import numpy as np
 
 from presto_tpu.page import Page
 
@@ -34,12 +45,18 @@ from presto_tpu.page import Page
 class PageStore:
     """Append-once, stream-many page materialization."""
 
-    def __init__(self, tier: str = "device"):
-        assert tier in ("device", "host"), tier
+    def __init__(self, tier: str = "device",
+                 spill_dir: Optional[str] = None):
+        assert tier in ("device", "host", "disk"), tier
         self.tier = tier
         self._pages: List = []
         self.bytes = 0
         self.page_count = 0
+        self._dir: Optional[str] = None
+        if tier == "disk":
+            self._dir = tempfile.mkdtemp(
+                prefix="presto_tpu_spill_", dir=spill_dir or None
+            )
 
     def put(self, page: Page) -> None:
         from presto_tpu.exec.executor import page_bytes
@@ -51,6 +68,13 @@ class PageStore:
             # degrades post-D2H kernel launches, so callers only pick
             # the host tier when the intermediate cannot stay resident
             self._pages.append(jax.device_get(page))
+        elif self.tier == "disk":
+            host = jax.device_get(page)
+            leaves, treedef = jax.tree_util.tree_flatten(host)
+            path = os.path.join(self._dir, f"p{self.page_count}.npz")
+            np.savez(path, **{f"a{i}": leaf
+                              for i, leaf in enumerate(leaves)})
+            self._pages.append((path, treedef, len(leaves)))
         else:
             self._pages.append(page)
 
@@ -58,5 +82,24 @@ class PageStore:
         if self.tier == "host":
             for p in self._pages:
                 yield jax.device_put(p)
+        elif self.tier == "disk":
+            for path, treedef, n in self._pages:
+                with np.load(path) as z:
+                    leaves = [z[f"a{i}"] for i in range(n)]
+                yield jax.device_put(
+                    jax.tree_util.tree_unflatten(treedef, leaves)
+                )
         else:
             yield from self._pages
+
+    def close(self) -> None:
+        if self._dir is not None:
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+        self._pages = []
+
+    def __del__(self):  # best-effort file cleanup
+        try:
+            self.close()
+        except Exception:
+            pass

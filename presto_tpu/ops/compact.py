@@ -166,3 +166,26 @@ def concat_all(pages) -> Page:
 def concat_pages(a: Page, b: Page) -> Page:
     """Two-page concat (see concat_all)."""
     return concat_all([a, b])
+
+
+def slice_page(page: Page, start: int, size: int) -> Page:
+    """Static row-window slice [start, start+size) of a page — every
+    block's data (and nulls) sliced with compile-time bounds, validity
+    preserved. Used by the per-partition skew rebalancer to chunk a hot
+    join partition's build rows by POSITION (a genuinely hot key cannot
+    be split by key hash; reference analog: PartitionedLookupSource
+    dividing one partition's addresses across probe passes)."""
+    stop = min(start + size, page.capacity)
+
+    def cut(x):
+        return x[start:stop]
+
+    blocks = []
+    for blk in page.blocks:
+        data = (
+            tuple(cut(d) for d in blk.data)
+            if isinstance(blk.data, tuple) else cut(blk.data)
+        )
+        nulls = cut(blk.nulls) if blk.nulls is not None else None
+        blocks.append(blk.with_data(data, nulls=nulls))
+    return Page(blocks=tuple(blocks), valid=cut(page.valid))

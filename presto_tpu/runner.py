@@ -181,6 +181,13 @@ class LocalRunner:
         ex.host_spill_bytes = (
             int(self.session.get("host_spill_bytes")) or None
         )
+        ex.disk_spill_bytes = (
+            int(self.session.get("disk_spill_bytes")) or None
+        )
+        ex.spill_path = self.session.get("spill_path") or None
+        ex.join_skew_rebalance = bool(
+            self.session.get("join_skew_rebalance")
+        )
         ex.max_build_rows = (
             int(self.session.get("max_join_build_rows")) or None
         )

@@ -6,13 +6,13 @@ execution/buffer/OutputBuffer (token-indexed page buffer consumed by
 HttpPageBufferClient with at-least-once + token-dedupe semantics).
 
 The TPU-native shape: one worker process = one host driving its local
-devices. A task carries (sql, fragment role, split assignment); the
-worker re-plans the SQL with the same deterministic planner the
-coordinator ran (the fragment identity is (sql, role) — plan shipping
-is replaced by plan replay, documented divergence from the reference's
-serialized PlanFragment), restricts the designated fact table to its
-round-robin split share, executes the PARTIAL subtree, and buffers
-serialized pages (dist/serde.py) for token-indexed fetch.
+devices. A task carries a SERIALIZED physical-plan fragment
+(dist/plan_serde.py — the reference's TaskUpdateRequest PlanFragment)
+plus a split assignment; the worker deserializes and executes exactly
+the subtree the coordinator planned, restricted to its split share
+(round-robin or hash-co-partitioned scans), and buffers serialized
+pages (dist/serde.py) for token-indexed fetch. Legacy peers may still
+send (sql, role) for worker-side replay.
 
 Fault-injection hooks (SURVEY §6.3: inject at the host page proxy —
 ICI collectives cannot be faulted): FAULT_DELAY_MS delays every
@@ -59,64 +59,99 @@ def find_partial_cut(plan: P.PhysicalNode) -> Optional[P.Aggregation]:
     return None
 
 
+def row_local_scan_count(node: P.PhysicalNode,
+                         split_table: str) -> Optional[int]:
+    """How many times ``split_table`` is scanned under ``node``, or
+    None when the subtree is not ROW-LOCAL — i.e. when the multiset of
+    its output rows is NOT the disjoint union of the outputs over a
+    row-partition of split_table (all other tables replicated).
+
+    Row-local shapes: Filter / Project / Exchange / TableScan / INNER
+    hash joins. Inner joins distribute over a partition of any single
+    table (each result row maps to exactly one row of it); outer/semi/
+    anti/cross joins, aggregations, sorts, limits, windows, and
+    MarkDistinct do not (a MarkDistinct would mark first-occurrence
+    per worker and double-count values spanning workers)."""
+    if isinstance(node, P.TableScan):
+        return 1 if node.table == split_table else 0
+    if isinstance(node, (P.Filter, P.Project, P.Exchange)):
+        return row_local_scan_count(node.source, split_table)
+    if isinstance(node, P.HashJoin):
+        if node.join_type != "inner":
+            return None
+        left = row_local_scan_count(node.left, split_table)
+        right = row_local_scan_count(node.right, split_table)
+        if left is None or right is None:
+            return None
+        return left + right
+    return None
+
+
 def fanout_safe(cut: P.Aggregation, split_table: str) -> bool:
     """Whether the PARTIAL subtree distributes over a round-robin
-    partition of split_table's rows. Safe shape: decomposable
-    aggregates with no DISTINCT masks (a MarkDistinct below the cut
-    would mark first-occurrence per worker and double-count values
-    spanning workers — the in-mesh fragmenter gathers MarkDistinct for
-    the same reason), and below the cut only Filter / Project /
-    Exchange / TableScan / INNER hash joins with exactly ONE scan of
-    the split table. Inner joins distribute over a partition of any
-    single table (each result row maps to exactly one row of it);
-    outer/semi/anti/cross joins, nested aggregations, sorts, limits,
-    windows, and self-joins of the split table do not — those queries
-    fall back to local execution."""
+    partition of split_table's rows: decomposable aggregates with no
+    DISTINCT masks, and a row-local source with exactly ONE scan of
+    the split table (see row_local_scan_count). Queries outside this
+    shape use the union-cut fallback (find_union_cut) or run local."""
     if any(s.mask is not None for s in cut.aggregates):
         return False
-    state = {"scans": 0, "ok": True}
+    return row_local_scan_count(cut.source, split_table) == 1
 
-    def walk(n):
-        if not state["ok"]:
-            return
-        if isinstance(n, P.TableScan):
-            if n.table == split_table:
-                state["scans"] += 1
-            return
-        if isinstance(n, (P.Filter, P.Project, P.Exchange)):
-            walk(n.source)
-            return
-        if isinstance(n, P.HashJoin):
-            if n.join_type != "inner":
-                state["ok"] = False
-                return
-            walk(n.left)
-            walk(n.right)
-            return
-        state["ok"] = False
 
-    walk(cut.source)
-    return state["ok"] and state["scans"] == 1
+def find_union_cut(plan: P.PhysicalNode,
+                   split_table: str) -> Optional[P.PhysicalNode]:
+    """The TOPMOST row-local subtree scanning split_table exactly once
+    — the general distribution shape for plans with no decomposable
+    aggregation cut (reference: a SOURCE_DISTRIBUTION leaf fragment
+    under a GATHER exchange; SqlQueryScheduler runs the leaf stage on
+    every worker and the coordinator consumes the union). Workers
+    execute the subtree over their split share; the coordinator
+    replaces it with a RemoteSource and runs everything above (sort /
+    topN / window / non-decomposable aggregation) over the unioned
+    pages. Returns None when no useful cut exists (a bare scan or a
+    pure projection of one is not worth shipping: generation is
+    cheaper than the wire — the cut must contain a join or filter)."""
+
+    def has_work(n) -> bool:
+        if isinstance(n, (P.HashJoin, P.Filter)):
+            return True
+        return any(has_work(c) for c in n.children())
+
+    n = row_local_scan_count(plan, split_table)
+    if n == 1 and has_work(plan):
+        return plan
+    for c in plan.children():
+        hit = find_union_cut(c, split_table)
+        if hit is not None:
+            return hit
+    return None
 
 
 def hash_fanout_plan(cut: P.Aggregation, catalogs,
                      partition_threshold: int = 1 << 17):
+    """Co-partitioning spec for a PARTITIONED JOIN fan-out below an
+    aggregation cut; decomposability of the aggregates follows
+    fanout_safe's rules (no DISTINCT masks). See hash_fanout_source."""
+    if any(s.mask is not None for s in cut.aggregates):
+        return None
+    return hash_fanout_source(cut.source, catalogs,
+                              partition_threshold)
+
+
+def hash_fanout_source(root: P.PhysicalNode, catalogs,
+                       partition_threshold: int = 1 << 17):
     """Co-partitioning spec for a PARTITIONED JOIN fan-out (the DCN
     hash-repartition exchange; reference: AddExchanges choosing
     REPARTITION and inserting hash exchanges on both join sides).
 
     Returns {table: partition_column} covering every BIG scanned table
     (row_count >= partition_threshold), or None when the shape does
-    not co-partition. Valid shape below the cut: Filter / Project /
+    not co-partition. Valid shape under ``root``: Filter / Project /
     Exchange / TableScan / INNER hash joins; every join with big
     tables on BOTH sides must equi-join on single keys that are
     provably those tables' columns (exec/plan.scan_column_of), and
     each big table must receive exactly ONE partition column; small
-    tables replicate (broadcast side). Decomposability of the
-    aggregates themselves follows fanout_safe's rules (no DISTINCT
-    masks)."""
-    if any(s.mask is not None for s in cut.aggregates):
-        return None
+    tables replicate (broadcast side)."""
     parts: dict = {}
     state = {"ok": True}
 
@@ -190,7 +225,7 @@ def hash_fanout_plan(cut: P.Aggregation, catalogs,
             return
         state["ok"] = False
 
-    walk(cut.source)
+    walk(root)
     if not state["ok"] or len(parts) < 2:
         return None
     return parts
@@ -428,11 +463,21 @@ class WorkerServer:
                 catalogs, page_rows=self.page_rows,
                 default_catalog=session.catalog, session=session,
             )
-            plan = runner.plan(req["sql"])
-            cut = find_partial_cut(plan)
-            if cut is None:
-                raise ValueError("no aggregation cut in fragment")
-            partial = dataclasses.replace(cut, step="partial")
+            if req.get("fragment") is not None:
+                # plan SHIPPING (reference: TaskUpdateRequest carrying a
+                # serialized PlanFragment): execute exactly the subtree
+                # the coordinator planned — no worker-side re-planning
+                from presto_tpu.dist import plan_serde
+
+                partial = plan_serde.loads(req["fragment"])
+            else:
+                # legacy SQL replay (pre-round-5 protocol, kept for
+                # mixed-version peers): re-plan and take the same cut
+                plan = runner.plan(req["sql"])
+                cut = find_partial_cut(plan)
+                if cut is None:
+                    raise ValueError("no aggregation cut in fragment")
+                partial = dataclasses.replace(cut, step="partial")
             ex = runner.executor
             runner.apply_session()
             for page in ex.pages(partial):

@@ -166,6 +166,30 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "keeps huge intermediates from pinning device memory",
             int, 1 << 32,
         ),
+        PropertyMetadata(
+            "disk_spill_bytes",
+            "materialized intermediates estimated above this many "
+            "bytes stage to DISK files instead of host RAM (0 = "
+            "disabled; the third spill tier — SF100 partitioned state "
+            "can exceed host RAM per SURVEY §6.4's sizing). Default "
+            "64GB engages only when host RAM would be at risk",
+            int, 1 << 36,
+        ),
+        PropertyMetadata(
+            "spill_path",
+            "directory for disk-spill files (empty = the system temp "
+            "dir; reference: spiller-spill-path config)",
+            str, "",
+        ),
+        PropertyMetadata(
+            "join_skew_rebalance",
+            "on boosted retries, rebalance hot grace-join partitions "
+            "by chunking build rows by position (buffers stay at the "
+            "unboosted size; one probe pass per chunk) instead of "
+            "growing every buffer — a genuinely hot key cannot be "
+            "split by hash (SURVEY §6.7 per-partition rebalancing)",
+            bool, True,
+        ),
     ]
 }
 

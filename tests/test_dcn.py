@@ -140,9 +140,105 @@ def test_two_real_processes_and_kill(single):
                 p.wait(timeout=10)
 
 
-def test_non_aggregate_query_falls_back_local(coord, single):
+def test_bare_scan_query_falls_back_local(coord, single):
+    # a bare scan has no useful union cut (generation is cheaper than
+    # the wire) — runs locally
     q = "select r_regionkey, r_name from region order by r_regionkey"
     assert coord.execute(q) == single.execute(q).rows
+    assert coord.last_distribution == "local"
+
+
+def test_union_cut_multijoin_distributes(coord, single):
+    """VERDICT r4 #7 done-criterion: a multi-join query with NO
+    aggregation distributes across 2 workers (union cut: workers run
+    the row-local join subtree over their split share, shipped as a
+    serialized fragment; the coordinator unions the pages)."""
+    q = ("select c_name, o_orderkey, l_quantity from customer "
+         "join orders on c_custkey = o_custkey "
+         "join lineitem on l_orderkey = o_orderkey "
+         "where l_quantity > 45")
+    want = single.execute(q).rows
+    got = coord.execute(q)
+    assert coord.last_distribution.startswith("union")
+    assert rows_equal(got, want)
+
+
+def test_union_cut_under_topn(coord, single):
+    # coordinator-side TopN over the unioned worker pages
+    q = ("select o_orderkey, l_extendedprice from orders "
+         "join lineitem on l_orderkey = o_orderkey "
+         "order by l_extendedprice desc, o_orderkey limit 7")
+    want = single.execute(q).rows
+    got = coord.execute(q)
+    assert coord.last_distribution.startswith("union")
+    assert got == want
+
+
+def test_union_cut_hash_partitioned(workers, single):
+    # both big sides of the join hash-co-partition (union-hash):
+    # worker build state is 1/N even with no aggregation in the plan
+    coord = DcnRunner({"tpch": TpchConnector(SF)}, workers,
+                      default_catalog="tpch", page_rows=PAGE_ROWS,
+                      partition_threshold=10_000)
+    q = ("select o_orderpriority, l_shipmode from orders "
+         "join lineitem on l_orderkey = o_orderkey "
+         "where l_quantity > 49")
+    want = single.execute(q).rows
+    got = coord.execute(q)
+    assert coord.last_distribution == "union-hash"
+    assert rows_equal(got, want)
+
+
+def test_shipped_fragment_is_executed_verbatim(workers, single):
+    """Plan SHIPPING (not replay): POST a hand-edited fragment that no
+    SQL replay could produce and check the worker executes exactly it."""
+    import urllib.request
+
+    from presto_tpu.dist import plan_serde, serde
+    from presto_tpu.exec import plan as P
+    from presto_tpu.expr import ir as E
+
+    plan = single.plan("select o_orderkey from orders")
+    # wrap the scan subtree in an extra filter the SQL never had
+    scan = plan
+    while not isinstance(scan, P.TableScan):
+        scan = scan.children()[0]
+    fragment = P.Filter(
+        source=P.Project(source=scan, exprs=(
+            E.input_ref(0, single.executor.output_types(scan)[0]),)),
+        predicate=E.call("lt", E.input_ref(
+            0, single.executor.output_types(scan)[0]),
+            E.const(100, single.executor.output_types(scan)[0])),
+    )
+    payload = {
+        "taskId": "ship-test.0",
+        "fragment": plan_serde.dumps(fragment),
+        "splitTable": "orders",
+        "splitIndex": 0,
+        "splitCount": 1,
+        "session": {},
+    }
+    req = urllib.request.Request(
+        f"{workers[0]}/v1/task", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=30).close()
+    rows = []
+    token = 0
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        r = urllib.request.urlopen(
+            f"{workers[0]}/v1/task/ship-test.0/results/{token}",
+            timeout=30)
+        if r.status == 204:
+            if r.headers.get("X-Done") == "1":
+                break
+            continue
+        body = r.read()
+        token = int(r.headers["X-Next-Token"])
+        rows.extend(serde.deserialize_page(body).to_pylist())
+    want = [r for r in single.execute(
+        "select o_orderkey from orders").rows if r[0] < 100]
+    assert rows_equal(rows, want)
 
 
 @pytest.mark.parametrize("q", [

@@ -1,0 +1,72 @@
+"""Run one rung and report WHICH executor site raised each overflow flag.
+
+Usage: python tools/debug_overflow.py {tpch|tpcds} QID SF [k=v ...]
+
+bench.py only records "capacity overflow at initial capacities" — this
+tool wraps Executor._pending_overflow so every appended device flag
+carries the Python call site that produced it, then decodes the flags
+and prints the sites whose flag is True. Use it to find the node whose
+planner capacity estimate is short (the fix belongs in
+sql/planner.py's estimates or the executor's clamps, not in boosting).
+"""
+
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from tools._common import configure_jax, make_runner, queries  # noqa: E402
+
+
+class TracedList(list):
+    def __init__(self):
+        super().__init__()
+        self.sites = []
+
+    def append(self, flag):
+        frame = None
+        for fr in reversed(traceback.extract_stack(limit=8)):
+            if "presto_tpu" in fr.filename:
+                frame = fr
+                break
+        self.sites.append(
+            f"{os.path.basename(frame.filename)}:{frame.lineno} "
+            f"{frame.name}" if frame else "?")
+        super().append(flag)
+
+    def extend(self, flags):
+        for f in flags:
+            self.append(f)
+
+
+def main() -> int:
+    import numpy as np
+
+    suite, qid, sf = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+    configure_jax()
+    runner = make_runner(suite, sf, props=sys.argv[4:])
+    plan = runner.plan(queries(suite)[qid])
+    ex = runner.executor
+    ex._pending_overflow = TracedList()
+    t0 = time.time()
+    pages = list(ex.pages(plan))
+    rows = 0
+    for p in pages:
+        rows += len(p.to_pylist())
+    print(f"wall {time.time() - t0:.1f}s rows={rows}", flush=True)
+    tl = ex._pending_overflow
+    n_true = 0
+    for site, flag in zip(tl.sites, tl):
+        v = bool(np.asarray(flag).any())
+        if v:
+            n_true += 1
+            print(f"OVERFLOW at {site}", flush=True)
+    print(f"{n_true}/{len(tl)} flags true", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
