@@ -177,14 +177,24 @@ def _run_child(args, timeout, env=None):
 # --------------------------------------------------------- orchestrator
 
 
+# Rungs that run SOLO (their own group child) even when they share a
+# (suite, sf, props) runner with faster rungs: a slow/hanging join rung
+# must only be able to time out ITSELF. BENCH_r05 lost the entire
+# headline group — every rung valid:false — because q5_sf1 burned the
+# shared group cap before q1/q6/q3 could decode+validate.
+SOLO_RUNGS = {"q5_sf1"}
+
+
 def _groups():
     """RUNGS grouped by (suite, sf, props) preserving ladder order —
     each group is one subprocess so rungs sharing a runner pay the
-    tunnel program-load bill once."""
+    tunnel program-load bill once. SOLO_RUNGS get a group of their own
+    (isolation beats sharing the program-load bill for rungs that have
+    blown group deadlines before)."""
     out, index = [], {}
     for rung in RUNGS:
         name, suite, qid, sf, props = rung
-        key = (suite, sf, props)
+        key = ("solo", name) if name in SOLO_RUNGS else (suite, sf, props)
         if key not in index:
             index[key] = len(out)
             out.append([])

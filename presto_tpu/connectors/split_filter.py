@@ -21,6 +21,14 @@ class SplitFilterConnector:
     """Wraps a connector; worker ``index`` of ``count`` sees only its
     round-robin share of ``table``'s splits."""
 
+    # pages() below IS the base per-split generation loop over this
+    # wrapper's own splits() — safe for the executor's whole-pipeline
+    # fusion (which drives splits()/gen_body directly), so a worker's
+    # shipped scan→filter→project→partial-agg fragment compiles to one
+    # program per split exactly like the local path. HashSplitConnector
+    # must NOT set this: its pages() masks rows after generation.
+    fused_scan_ok = True
+
     def __init__(self, inner, table: str, index: int, count: int):
         self._inner = inner
         self._table = table

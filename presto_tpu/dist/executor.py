@@ -187,6 +187,19 @@ class DistExecutor(Executor):
         )
 
     # ----------------------------------------------------------- helpers
+    def _lazy_probe_ok(self, node: P.PhysicalNode) -> bool:
+        """Late materialization only along fully-replicated probe
+        spines: sharded subtrees route through shard_map paths that
+        speak materialized Pages (their exchanges/collectives cannot
+        carry a host-side indirection descriptor)."""
+        return (
+            super()._lazy_probe_ok(node)
+            and self.dist(node) == REPLICATED
+            and all(
+                self.dist(c) == REPLICATED for c in node.children()
+            )
+        )
+
     def _shard_page_kernel(self, key, fn):
         """shard_map-wrap a pure page->page kernel (shard-local map)."""
         if key not in self._jit_cache:
@@ -600,7 +613,7 @@ class DistExecutor(Executor):
                 )
                 out, matched, ovf = _probe_join_page(
                     node.left_keys, node.right_keys, node.join_type,
-                    pg, build, index, oc,
+                    False, pg, build, index, oc,
                 )
                 ovf = jax.lax.psum(ovf.astype(jnp.int32), "d") > 0
                 if dr == REPLICATED:

@@ -28,7 +28,9 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu.connectors.base import Connector
 from presto_tpu.exec import agg_states as S
+from presto_tpu.exec import latemat as LM
 from presto_tpu.exec import plan as P
+from presto_tpu.exec import prune as PR
 from presto_tpu.expr.eval import evaluate, evaluate_filter
 from presto_tpu.ops import agg as A
 from presto_tpu.ops import hashing as H
@@ -281,6 +283,34 @@ class Executor:
         # property); generated_joins_used is observability for tests
         self.generated_join = True
         self.generated_joins_used = 0
+        # Late materialization for join chains (session property
+        # late_materialization_enabled; exec/latemat.py): joins emit a
+        # row-id indirection per build side instead of gathering every
+        # carried column; values gather ONCE at the first consumer that
+        # needs them. "auto" engages only on TPU — the win is HBM
+        # gather bandwidth (ROOFLINE §4), while the extra per-join
+        # programs cost real CPU compile time (same policy as
+        # pallas_join_enabled). Direct Executor construction defaults
+        # to ON (library users, unit tests); the session layer maps
+        # auto per backend. Counters: gathers_deferred = per-page
+        # column gathers skipped at join-output time;
+        # gathers_materialized = per-page column value gathers actually
+        # performed (lift + chain-boundary finish). On a lazy chain,
+        # materialized per carried build column per page is exactly 1.
+        self.late_mat = True
+        self.gathers_deferred = 0
+        self.gathers_materialized = 0
+        # Whole-pipeline fusion THROUGH partial aggregation (session
+        # property fused_partial_agg_enabled): a scan→filter→project→
+        # partial-agg chain compiles to ONE XLA program per split
+        # (ROOFLINE §4's computed bound for Q1/Q6). "auto" fuses only
+        # on TPU — the win is per-launch tunnel overhead, which CPU
+        # doesn't pay, while the bigger fused programs cost real CPU
+        # compile time (same policy as pallas_join_enabled).
+        # fused_partial_aggs counts fused streams built (mirrors
+        # generated_joins_used).
+        self.agg_fusion = "auto"
+        self.fused_partial_aggs = 0
         # blocking-aggregation sizing heuristics (session properties
         # agg_optimistic_rows / agg_compact_enabled): start group
         # capacities tight and densify join-sparse inputs, both guarded
@@ -449,8 +479,8 @@ class Executor:
             else:
                 return None
 
-    def _fused_stream(self, node: P.PhysicalNode
-                      ) -> Optional[Iterator[Page]]:
+    def _fused_stream(self, node: P.PhysicalNode, agg_tail=None,
+                      key_extra=None) -> Optional[Iterator[Page]]:
         """Whole-pipeline fusion: when `node` is a chain of Filter /
         Project / Exchange / build-free generated joins over a
         TableScan of an on-device generator, compile the ENTIRE
@@ -463,23 +493,38 @@ class Executor:
         the whole driver loop for the chain, so a page pays ONE kernel
         launch instead of one per node (launch overhead ~6ms on the
         axon tunnel dominates small per-node kernels — ROOFLINE.md §4).
-        Returns None when the subtree has any non-fusable node."""
+        Returns None when the subtree has any non-fusable node.
+
+        ``agg_tail`` extends the fusion THROUGH partial aggregation
+        (see _fused_partial_tail): a ("map", fn) tail appends a plain
+        page transform (global partial states), an ("aggflag", fn) tail
+        appends a grouped partial step whose overflow flag joins the
+        deferred ladder — scan→filter→project→partial-agg in ONE
+        program per split (ROOFLINE §4: ~6 launches total for Q1 SF1
+        instead of ~8 per page). ``key_extra`` salts the jit key with
+        the caller's boost-dependent parameters."""
         if not self.use_jit:
             return None
         walked = self._scan_chain(node, through_joins=True)
         if walked is None:
             return None
         cur, chain = walked
-        if not chain:
+        if not chain and agg_tail is None:
             return None  # a bare scan already runs as one program
         conn = self.catalogs[cur.catalog]
         # structural gate: fuse ONLY when pages() is exactly the base
         # per-split generation loop — a connector (or wrapper: caching,
         # DCN hash-split masking, instance-level instrumentation) that
         # overrides pages() transforms the stream in ways inlined
-        # generation would silently bypass
-        if (getattr(type(conn), "pages", None) is not Connector.pages
-                or "pages" in vars(conn)):
+        # generation would silently bypass. Wrappers whose pages() IS
+        # the base loop over their own splits() (the worker's
+        # round-robin SplitFilterConnector) declare fused_scan_ok —
+        # the fused stream respects their splits()/prune_splits().
+        base_pages = (
+            getattr(type(conn), "pages", None) is Connector.pages
+            or getattr(type(conn), "fused_scan_ok", False)
+        )
+        if not base_pages or "pages" in vars(conn):
             return None
         names = tuple(cur.columns)
         probe = conn.gen_body(cur.table, 8, names)
@@ -505,6 +550,9 @@ class Executor:
                 fn = _node_replay_fn(nd)
                 if fn is not None:
                     steps.append(("map", fn))
+        if agg_tail is not None:
+            steps.append(agg_tail)
+            self.fused_partial_aggs += 1
 
         def run_split(gen_fn, start):
             datas, valid = gen_fn(start)
@@ -514,9 +562,9 @@ class Executor:
             ), valid=valid)
             flags = []
             for kind, fn in steps:
-                if kind == "joinw":
-                    page, multi = fn(page)
-                    flags.append(multi)
+                if kind in ("joinw", "aggflag"):
+                    page, flag = fn(page)
+                    flags.append(flag)
                 else:
                     page = fn(page)
             return page, tuple(flags)
@@ -525,7 +573,8 @@ class Executor:
             for split in splits:
                 if not split.row_count:
                     continue
-                key = ("fused", node, cur.table, split.row_count)
+                key = ("fused", node, key_extra, cur.table,
+                       split.row_count)
                 if key not in self._jit_cache:
                     gen_fn = conn.gen_body(
                         cur.table, split.row_count, names)
@@ -537,6 +586,37 @@ class Executor:
                 yield page
 
         return stream()
+
+    def _fused_partial_tail(self, node: P.Aggregation, layouts,
+                            cap: Optional[int], max_iters: Optional[int]):
+        """The partial-aggregation tail step for _fused_stream, or None
+        when the shape should not fuse. Global aggregations always
+        qualify. Grouped ones qualify unless fusing would bypass the
+        join-output compaction stream (_agg_source_pages): big group
+        capacity AND a join in the chain — there the blocking agg's
+        per-sparse-page cost dwarfs the saved launches. Everywhere else
+        the fused tail does EXACTLY the per-page work of the unfused
+        driver loop, minus the launches."""
+        mode = self.agg_fusion
+        if mode in (False, None, "false", "off") or not self.use_jit:
+            return None
+        if mode == "auto" and jax.default_backend() != "tpu":
+            return None
+        layouts_t = tuple(tuple(l) for l in layouts)
+        if not node.group_channels:
+            return ("map", functools.partial(
+                _partial_global_agg, node.aggregates, layouts_t))
+        if cap is None:
+            return None
+        if (node.capacity > A.MATMUL_AGG_MAX_GROUPS
+                and _subtree_has_join(node.source)):
+            return None
+        raw = functools.partial(
+            _partial_agg_page, node.group_channels, node.aggregates,
+            layouts_t, collect_k=self._collect_k_eff,
+        )
+        return ("aggflag",
+                functools.partial(_fused_agg_step, raw, cap, max_iters))
 
     def _pages_impl(self, node: P.PhysicalNode) -> Iterator[Page]:
         if isinstance(node, (P.Filter, P.Project, P.HashJoin)):
@@ -772,23 +852,22 @@ class Executor:
         self.host_spill_bytes_used = 0
         self.disk_spill_pages = 0
         self.skew_chunks_used = 0
+        # generated/pallas counters accumulate for the executor's
+        # lifetime (tests assert before/after deltas); snapshot them so
+        # EXPLAIN ANALYZE can report THIS query's engagement
+        self._joins_counter_base = (
+            self.generated_joins_used, self.pallas_joins_used
+        )
         try:
             for _attempt in range(6):
-                self._pending_overflow = []
-                # boosted retries invalidate materialized intermediates:
-                # cached pages may embed overflow-truncated results
-                self._stream_cache = {}
+                self._begin_attempt()
                 if self._collect_stats is not None:
                     # drop failed-attempt stats
                     self._collect_stats.clear()
                 out_pages = list(self.pages(node))
-                if self._pending_overflow:
-                    flag = self._pending_overflow[0]
-                    for f in self._pending_overflow[1:]:
-                        flag = flag | f
-                    if bool(flag):
-                        self._capacity_boost *= 4
-                        continue
+                if self._overflow_flagged():
+                    self._capacity_boost *= 4
+                    continue
                 rows: List[tuple] = []
                 for page in out_pages:
                     rows.extend(_decode_result_page(page))
@@ -799,7 +878,73 @@ class Executor:
         finally:
             # release materialized intermediates (HBM/host pages) the
             # moment the query is done
-            self._stream_cache = {}
+            self._release_stream_cache()
+
+    def _begin_attempt(self) -> None:
+        """Per-attempt reset shared by every overflow-ladder driver
+        (execute(), stream_fragment()): deferred flags, materialized
+        intermediates (cached pages may embed overflow-truncated
+        results), and the per-attempt gather/fusion counters — a
+        retried attempt re-defers and re-materializes from scratch, so
+        cumulative counts would break the exactly-one-gather-per-
+        carried-column accounting."""
+        self._pending_overflow = []
+        self._release_stream_cache()
+        self.gathers_deferred = 0
+        self.gathers_materialized = 0
+        self.fused_partial_aggs = 0
+
+    def _overflow_flagged(self) -> bool:
+        """OR-reduce the attempt's deferred overflow flags — the ONE
+        host sync of the deferred-sync discipline (see __init__)."""
+        if not self._pending_overflow:
+            return False
+        flag = self._pending_overflow[0]
+        for f in self._pending_overflow[1:]:
+            flag = flag | f
+        return bool(flag)
+
+    def stream_fragment(self, node: P.PhysicalNode, emit,
+                        cancelled=lambda: False) -> List:
+        """Stream a plan fragment's pages through ``emit`` under the
+        SAME query-scope overflow ladder as execute() — for drivers
+        that ship results incrementally (server/worker.py's task
+        runtime) instead of materializing rows. Returns the emit()
+        results of the last (overflow-free) attempt; a truncated page
+        set can never escape because results publish only per
+        completed attempt. Raises after 6 boosted retries."""
+        self._capacity_boost = 1
+        try:
+            for _attempt in range(6):
+                self._begin_attempt()
+                out: List = []
+                for page in self.pages(node):
+                    if cancelled():
+                        return out
+                    out.append(emit(page))
+                if not self._overflow_flagged():
+                    return out
+                self._capacity_boost *= 4
+            raise RuntimeError(
+                "fragment capacity overflow persisted after 6 boosted "
+                "retries"
+            )
+        finally:
+            # close materialized intermediates (incl. disk-tier spill
+            # dirs) the moment the fragment is done — never rely on
+            # __del__ timing (same discipline as execute())
+            self._release_stream_cache()
+
+    def _release_stream_cache(self) -> None:
+        """Invalidate materialized intermediates, CLOSING each PageStore
+        explicitly (disk-tier stores hold presto_tpu_spill_* temp dirs
+        whose cleanup must not rely on __del__ timing)."""
+        for store in self._stream_cache.values():
+            try:
+                store.close()
+            except Exception:  # pragma: no cover - best-effort cleanup
+                pass
+        self._stream_cache = {}
 
     def _account_page(self, page: Page) -> None:
         size = page_bytes(page)
@@ -828,6 +973,21 @@ class Executor:
             stats = dict(self._collect_stats)
         finally:
             self._collect_stats = None
+        # query-level execution counters ride under a string key (node
+        # entries key by id(node), an int — no collision); PlanPrinter
+        # renders them as a trailing Counters line. The gather/fusion
+        # counters are per-attempt (reset in _begin_attempt, so they
+        # describe the successful attempt); the lifetime-cumulative
+        # join counters report as THIS query's delta over the snapshot
+        # execute() took.
+        base_gen, base_pal = getattr(self, "_joins_counter_base", (0, 0))
+        stats["counters"] = {
+            "gathers_deferred": self.gathers_deferred,
+            "gathers_materialized": self.gathers_materialized,
+            "fused_partial_aggs": self.fused_partial_aggs,
+            "generated_joins_used": self.generated_joins_used - base_gen,
+            "pallas_joins_used": self.pallas_joins_used - base_pal,
+        }
         return names, rows, stats
 
     # -------------------------------------------------------- aggregation
@@ -872,12 +1032,29 @@ class Executor:
 
     def _exec_agg_partial(self, node: P.Aggregation) -> Iterator[Page]:
         """Partial step only: one state page per input page (reference:
-        AggregationNode.Step.PARTIAL before the exchange)."""
+        AggregationNode.Step.PARTIAL before the exchange). When the
+        source is a fusable scan chain, the WHOLE pipeline — generation
+        through partial aggregation — compiles to one program per split
+        (this is the path shipped-plan worker fragments execute)."""
         in_types = self._agg_in_types(node)
         layouts = [
             S.state_layout(s.function, t)
             for s, t in zip(node.aggregates, in_types)
         ]
+        tail = self._fused_partial_tail(
+            node, layouts,
+            _next_pow2(node.capacity * self._capacity_boost),
+            64 * self._capacity_boost,
+        )
+        if tail is not None:
+            fused = self._fused_stream(
+                node.source, agg_tail=tail,
+                key_extra=(node, self._capacity_boost,
+                           self._collect_k_eff),
+            )
+            if fused is not None:
+                yield from fused
+                return
         if not node.group_channels:
             fn = self._jit(
                 ("gagg_partial", node),
@@ -1042,13 +1219,29 @@ class Executor:
         )
         fold = _FoldBuffer(self, merge_fn, fold_cap, max_iters,
                            2 * fold_cap)
-        for page in self._agg_source_pages(node):
-            # distinct groups <= rows, so clip the capacity to the page
-            out, overflow = partial_fn(
-                page, min(cap, _next_pow2(page.capacity)), max_iters
+        # scan→filter→project→partial-agg as ONE program per split when
+        # the source chain fuses (the fused stream's state pages feed
+        # the same fold/final machinery)
+        tail = self._fused_partial_tail(node, layouts, cap, max_iters)
+        fused = (
+            self._fused_stream(
+                node.source, agg_tail=tail,
+                key_extra=(node, "single", self._capacity_boost,
+                           self._collect_k_eff),
             )
-            self._pending_overflow.append(overflow)
-            fold.add(out)
+            if tail is not None and node.group_channels else None
+        )
+        if fused is not None:
+            for out in fused:
+                fold.add(out)
+        else:
+            for page in self._agg_source_pages(node):
+                # distinct groups <= rows: clip the capacity to the page
+                out, overflow = partial_fn(
+                    page, min(cap, _next_pow2(page.capacity)), max_iters
+                )
+                self._pending_overflow.append(overflow)
+                fold.add(out)
         merged = fold.final_merged()
         if merged is None:
             return
@@ -1305,7 +1498,16 @@ class Executor:
                 tuple(tuple(l) for l in layouts)
             ),
         )
-        partials = [partial_fn(p) for p in self.pages(node.source)]
+        tail = self._fused_partial_tail(node, layouts, None, None)
+        fused = (
+            self._fused_stream(node.source, agg_tail=tail,
+                               key_extra=(node, "global"))
+            if tail is not None else None
+        )
+        if fused is not None:
+            partials = list(fused)
+        else:
+            partials = [partial_fn(p) for p in self.pages(node.source)]
         if not partials:
             partials = [
                 _empty_state_page(node.aggregates, layouts,
@@ -1612,6 +1814,26 @@ class Executor:
             yield out
 
     def _exec_join(self, node: P.HashJoin) -> Iterator[Page]:
+        """Page-level join execution: lazy (late-materialization) items
+        produced along the probe spine materialize HERE, at the chain
+        boundary — every deferred build column pays its one gather."""
+        for item in self._exec_join_items(node):
+            yield self._materialize_lazy(item)
+
+    def _exec_join_items(self, node: P.HashJoin, want_lazy: bool = False):
+        """Yields Page or latemat.LazyPage items for a join node. The
+        single-pass general/unique sort paths defer build sides
+        (inner/left joins) and consume the probe side through
+        _lazy_pages so chained joins compose row-id indirections; every
+        other path (generated, Pallas-unique, partitioned, semi/anti,
+        right/full) yields materialized Pages as before.
+
+        want_lazy: the consumer is a lazy-aware parent join — defer
+        unconditionally. Otherwise (the chain boundary, where the
+        caller materializes immediately) defer only when the probe
+        items are themselves lazy: deferring the boundary join's own
+        side is then free (the finish program runs anyway), while for
+        a single un-chained join it would only add a launch."""
         left_types = self.output_types(node.left)
         right_types = self.output_types(node.right)
         gj = self._generated_join_info(node, left_types)
@@ -1660,10 +1882,142 @@ class Executor:
                                       right_types):
             yield from self._pallas_join_pass(node, build, left_types)
             return
-        yield from self._join_pass(
-            node, build, self.pages(node.left), left_types,
-            unique_build=unique_build,
+        allow = (self._late_mat_on()
+                 and node.join_type in ("inner", "left"))
+        probe_src = (
+            self._lazy_pages(node.left) if allow
+            else self.pages(node.left)
         )
+        defer = "never"
+        if allow:
+            defer = "always" if want_lazy else "chain"
+        yield from self._join_pass(
+            node, build, probe_src, left_types,
+            unique_build=unique_build, defer=defer,
+        )
+
+    # --------------------------------------- late materialization driver
+    def _late_mat_on(self) -> bool:
+        """late_materialization_enabled resolution: "auto" engages on
+        TPU only (gather bandwidth is the win; CPU pays compile cost
+        for nothing), True/False are explicit overrides."""
+        mode = self.late_mat
+        if mode in (False, None, "false", "off"):
+            return False
+        if mode == "auto":
+            return jax.default_backend() == "tpu"
+        return True
+
+    def _lazy_probe_ok(self, node: P.PhysicalNode) -> bool:
+        """Whether a probe-side subtree may stream lazy items instead of
+        Pages. The DistExecutor narrows this to fully-replicated
+        subtrees (sharded nodes route through shard_map paths that
+        speak Pages)."""
+        return self._late_mat_on()
+
+    def _lazy_pages(self, node: P.PhysicalNode):
+        """A join's probe-side stream: latemat.LazyPage items when the
+        subtree is an eligible join-chain segment, plain Pages
+        otherwise. Whole-chain fusion (generated joins) wins over
+        laziness — a fused chain has no gathers to defer.
+
+        Items bypass pages(), so interior chain nodes get no per-node
+        EXPLAIN ANALYZE stats (the chain's wall lands on the top join);
+        memory accounting is preserved by accounting every interior
+        item here."""
+        if isinstance(node, P.HashJoin) and self._lazy_probe_ok(node):
+            fused = self._fused_stream(node)
+            if fused is not None:
+                for page in fused:
+                    self._account_page(page)
+                    yield page
+                return
+            for item in self._exec_join_items(node, want_lazy=True):
+                self._account_page(
+                    item.reduced if isinstance(item, LM.LazyPage)
+                    else item
+                )
+                yield item
+            return
+        if (isinstance(node, P.Filter) and self._lazy_probe_ok(node)
+                and _filter_chain_has_join(node)):
+            fused = self._fused_stream(node)
+            if fused is not None:
+                for page in fused:
+                    self._account_page(page)
+                    yield page
+                return
+            yield from self._lazy_filter(node)
+            return
+        yield from self.pages(node)
+
+    def _lazy_filter(self, node: P.Filter):
+        """Filter over a lazy join chain: lift exactly the deferred
+        channels the predicate reads (prune.expr_channels — the
+        liveness set), remap the predicate onto the reduced layout, and
+        flip validity bits without materializing anything else."""
+        refs = tuple(sorted(PR.expr_channels(node.predicate)))
+        for item in self._lazy_pages(node.source):
+            if isinstance(item, Page):
+                fn = self._jit(
+                    ("filter", node.predicate),
+                    functools.partial(_replay_filter, node.predicate),
+                )
+                yield fn(item)
+                continue
+            lz = self._lazy_lift(item, refs)
+            pred = PR.remap_expr(
+                node.predicate, {c: lz.phys(c) for c in refs}
+            )
+            fn = self._jit(
+                ("filter_lazy", pred, lz.mat),
+                functools.partial(_replay_filter, pred),
+            )
+            yield dataclasses.replace(lz, reduced=fn(lz.reduced))
+
+    def _lazy_lift(self, lz: LM.LazyPage, channels) -> LM.LazyPage:
+        """Materialize the named logical channels of a lazy page (one
+        gather each) — downstream join keys and filter references, the
+        'needed as values NOW' set. No-op when already materialized."""
+        need = tuple(sorted(set(channels) - set(lz.mat)))
+        if not need:
+            return lz
+        self.gathers_materialized += len(need)
+        maps = tuple(s.channel_map for s in lz.sides)
+        fn = self._jit(
+            ("latemat_lift", lz.signature(), need,
+             tuple(s.build.capacity for s in lz.sides)),
+            functools.partial(LM.lift_page, lz.mat, maps, need),
+        )
+        reduced = fn(lz.reduced, *[s.build for s in lz.sides])
+        _, new_mat, new_maps, keep = LM.lift_layout(lz.mat, maps, need)
+        return LM.LazyPage(
+            reduced=reduced, width=lz.width, mat=new_mat,
+            sides=tuple(
+                LM.LazySide(lz.sides[i].build, new_maps[i])
+                for i in keep
+            ),
+        )
+
+    def _materialize_lazy(self, item):
+        """Chain-boundary materialization: every still-deferred column
+        gathers exactly once through its side's composed row ids."""
+        if isinstance(item, Page):
+            return item
+        if not item.sides:
+            return item.reduced  # mat covers all channels, in order
+        self.gathers_materialized += sum(
+            len(s.channel_map) for s in item.sides
+        )
+        maps = tuple(s.channel_map for s in item.sides)
+        fn = self._jit(
+            ("latemat_fin", item.signature(),
+             tuple(s.build.capacity for s in item.sides)),
+            functools.partial(
+                LM.finish_page, item.mat, maps, item.width
+            ),
+        )
+        return fn(item.reduced, *[s.build for s in item.sides])
 
     # ---------------------------------------------------- Pallas paths
     def _pallas_mode_allows(self, layout) -> bool:
@@ -1881,6 +2235,12 @@ class Executor:
                     chunks.append(
                         [slice_page(piece, off, chunk_cap)]
                     )
+                # the split chunks are full (and `room` still described
+                # the chunk BEFORE them): start a fresh chunk so later
+                # pieces cannot pile onto a full slice and grow a chunk
+                # to ~2x chunk_cap
+                chunks.append([])
+                room = chunk_cap
                 continue
             if rows > room:
                 chunks.append([])
@@ -1906,13 +2266,21 @@ class Executor:
     def _join_pass(
         self, node: P.HashJoin, build: Page, probe_pages, left_types,
         *, unique_build: bool = False, density: int = 1,
-    ) -> Iterator[Page]:
+        defer: str = "never",
+    ):
         """One build+probe pass (the whole join unless partitioned).
 
         unique_build: <=1 match per probe row — output sized to the probe
         page exactly. density: probe pages carry ~1/density real rows
         (partition-filtered passes); output capacity shrinks to match,
-        with the deferred overflow flag + boosted retry guarding skew."""
+        with the deferred overflow flag + boosted retry guarding skew.
+        defer: "always" emits this join's build side as a row-id
+        indirection (latemat.LazyPage) instead of gathering its columns;
+        "chain" defers only when the probe item is itself lazy (the
+        finish program runs anyway, so deferring is free — while for a
+        lone boundary join it would just add a launch); "never" is the
+        eager path. Lazy probe items' deferred keys lift here — exactly
+        the 'needed as a downstream join key' liveness contract."""
         if node.join_type in ("semi", "anti"):
             fn = self._jit(
                 ("semi", node, build.capacity),
@@ -1927,59 +2295,83 @@ class Executor:
         # candidate ranges come from the bucketed open-addressing kernel
         # instead of searchsorted (north-star's radix-partitioned join)
         use_radix = self._radix_join_eligible(node, build)
+        layout = interpret = None
         if use_radix:
             from presto_tpu.ops import pallas_join as PJ
 
             self.pallas_joins_used += 1
             layout = PJ.plan_layout(build.capacity)
             interpret = self._pallas_interpret(layout)
-            probe_fn = self._jit(
-                ("radix_probe", node, build.capacity, interpret),
+        use_unique = (
+            not use_radix and unique_build
+            and node.join_type in ("inner", "left")
+            and self._capacity_boost == 1
+        )
+        defer_allowed = (
+            defer != "never" and node.join_type in ("inner", "left")
+        )
+
+        def probe_fn_for(pkeys, defer_item):
+            if use_radix:
+                return self._jit(
+                    ("radix_probe", node, build.capacity, interpret,
+                     pkeys, defer_item),
+                    functools.partial(
+                        _probe_radix_join_page, pkeys,
+                        node.right_keys, node.join_type, layout,
+                        interpret, defer_item,
+                    ),
+                    static_argnums=(3,),
+                )
+            if use_unique:
+                # FK fast path: no expansion; a u64 hash collision
+                # between distinct unique keys flags overflow and the
+                # boosted retry takes the general expansion below
+                return self._jit(
+                    ("join_probe_unique", node, build.capacity, pkeys,
+                     defer_item),
+                    functools.partial(
+                        _probe_join_page_unique, pkeys,
+                        node.right_keys, node.join_type, defer_item,
+                    ),
+                    static_argnums=(3,),
+                )
+            return self._jit(
+                ("join_probe", node, build.capacity, pkeys, defer_item),
                 functools.partial(
-                    _probe_radix_join_page, node.left_keys,
-                    node.right_keys, node.join_type, layout, interpret,
+                    _probe_join_page, pkeys, node.right_keys,
+                    node.join_type, defer_item,
                 ),
                 static_argnums=(3,),
             )
-        elif (unique_build and node.join_type in ("inner", "left")
-                and self._capacity_boost == 1):
-            # FK fast path: no expansion; a u64 hash collision between
-            # distinct unique keys flags overflow and the boosted retry
-            # takes the general expansion below
-            probe_fn = self._jit(
-                ("join_probe_unique", node, build.capacity),
-                functools.partial(
-                    _probe_join_page_unique, node.left_keys,
-                    node.right_keys, node.join_type
-                ),
-                static_argnums=(3,),
-            )
-        else:
-            probe_fn = self._jit(
-                ("join_probe", node, build.capacity),
-                functools.partial(
-                    _probe_join_page, node.left_keys, node.right_keys,
-                    node.join_type
-                ),
-                static_argnums=(3,),
-            )
+
         build_matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
+        n_right = len(build.blocks)
         # canonical key encodings depend on the probe page's dictionaries
         # (merged-universe remap), which can differ across pages when the
         # probe side unions differently-coded streams — index per
         # dictionary signature, built once each (HashBuilderOperator
         # analog; one signature in the common case)
         indexes: Dict = {}
-        for page in probe_pages:
-            sig = tuple(
-                page.block(c).dictionary for c in node.left_keys
-            )
+        for item in probe_pages:
+            if isinstance(item, LM.LazyPage):
+                # downstream-join-key liveness: lift exactly the key
+                # channels this probe needs as values
+                lz = self._lazy_lift(item, node.left_keys)
+                page = lz.reduced
+                pkeys = tuple(lz.phys(c) for c in node.left_keys)
+            else:
+                lz = None
+                page = item
+                pkeys = tuple(node.left_keys)
+            sig = (pkeys,
+                   tuple(page.block(c).dictionary for c in pkeys))
             if sig not in indexes:
                 if use_radix:
                     index, b_ovf = self._jit(
                         ("radix_build", node, build.capacity, sig),
                         functools.partial(
-                            _build_radix_join_index, node.left_keys,
+                            _build_radix_join_index, pkeys,
                             node.right_keys, layout,
                         ),
                     )(page, build)
@@ -1990,7 +2382,7 @@ class Executor:
                     index = self._jit(
                         ("join_build", node, build.capacity, sig),
                         functools.partial(
-                            _build_join_index, node.left_keys,
+                            _build_join_index, pkeys,
                             node.right_keys,
                         ),
                     )(page, build)
@@ -2016,10 +2408,36 @@ class Executor:
                 # partition-hash fluctuation without a boosted retry
                 oc = max(oc * 2 // density, 8192)
             oc = _next_pow2(max(oc, 8192) * self._capacity_boost)
-            out, matched, overflow = probe_fn(page, build, index, oc)
+            defer_item = defer_allowed and (
+                defer == "always" or lz is not None
+            )
+            out, matched, overflow = probe_fn_for(pkeys, defer_item)(
+                page, build, index, oc
+            )
             self._pending_overflow.append(overflow)
             build_matched = build_matched | matched
-            yield out
+            if defer_item:
+                width_l = lz.width if lz is not None else (
+                    page.channel_count
+                )
+                mat = lz.mat if lz is not None else tuple(
+                    range(page.channel_count)
+                )
+                sides = (lz.sides if lz is not None else ()) + (
+                    LM.LazySide(
+                        build,
+                        tuple((width_l + j, j) for j in range(n_right)),
+                    ),
+                )
+                self.gathers_deferred += sum(
+                    len(s.channel_map) for s in sides
+                )
+                yield LM.LazyPage(
+                    reduced=out, width=width_l + n_right, mat=mat,
+                    sides=sides,
+                )
+            else:
+                yield out
         if node.join_type in ("right", "full"):
             # emit unmatched build rows with null left side (reference:
             # LookupOuterOperator draining unvisited positions)
@@ -2793,6 +3211,23 @@ def _subtree_has_join(node: P.PhysicalNode) -> bool:
     return any(_subtree_has_join(c) for c in node.children())
 
 
+def _filter_chain_has_join(node: P.PhysicalNode) -> bool:
+    """Whether a Filter(-over-Filter...) chain sits directly on a
+    HashJoin — the shape the lazy-filter driver can stream without
+    materializing (projects and blocking ops break the chain)."""
+    cur = node
+    while isinstance(cur, P.Filter):
+        cur = cur.source
+    return isinstance(cur, P.HashJoin)
+
+
+def _fused_agg_step(raw, cap, max_iters, page: Page):
+    """Partial-agg tail of a fused pipeline (kernel): distinct groups
+    <= rows, so the group capacity clips to the page like the unfused
+    driver loop does."""
+    return raw(page, min(cap, _next_pow2(page.capacity)), max_iters)
+
+
 def _compact_with_flag(page: Page, cap: int):
     """compact_page plus the dropped-rows overflow flag (kernel)."""
     return (
@@ -2936,24 +3371,30 @@ def _build_join_index(left_keys, right_keys, page: Page, build: Page):
     return J.build_join_index(rcols, rnulls, build.valid)
 
 
-def _probe_join_page(left_keys, right_keys, join_type, page: Page,
-                     build: Page, index, out_cap: int):
+def _probe_join_page(left_keys, right_keys, join_type, defer,
+                     page: Page, build: Page, index, out_cap: int):
     lblocks = [page.block(c) for c in left_keys]
     rblocks = [build.block(c) for c in right_keys]
     lcols, lnulls, _rcols, _rnulls = _canonical_join_cols(lblocks, rblocks)
     m = J.hash_join_match(
         None, None, None, lcols, lnulls, page.valid, out_cap, index=index
     )
-    return _assemble_join_output(join_type, page, build, m)
+    return _assemble_join_output(join_type, page, build, m, defer=defer)
 
 
-def _probe_join_page_unique(left_keys, right_keys, join_type, page: Page,
-                            build: Page, index, out_cap: int):
+def _probe_join_page_unique(left_keys, right_keys, join_type, defer,
+                            page: Page, build: Page, index,
+                            out_cap: int):
     """FK-join (unique build keys) probe: no match expansion — the
     output page IS the probe page plus gathered build columns; for
     LEFT joins unmatched probe rows simply carry a null build side in
     the SAME page (no appended pad page). out_cap is ignored (output
-    capacity == probe capacity by construction)."""
+    capacity == probe capacity by construction).
+
+    defer=True (late materialization): the build side rides as ONE
+    int64 row-id column instead of gathered values — and because the
+    output rows ARE the probe rows, any indirections the probe page
+    already carries pass through with zero gathers."""
     lblocks = [page.block(c) for c in left_keys]
     rblocks = [build.block(c) for c in right_keys]
     lcols, lnulls, _rcols, _rnulls = _canonical_join_cols(lblocks, rblocks)
@@ -2967,6 +3408,19 @@ def _probe_join_page_unique(left_keys, right_keys, join_type, page: Page,
     bid, found, collision = J.unique_join_lookup(
         bcols, bvalid, perm, pcols, pvalid, lo, hi
     )
+    # build_matched feeds only RIGHT/FULL outer emission, which this
+    # kernel never serves (inner/left only) — a zeros stub keeps the
+    # jit output signature without paying the scatter
+    matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
+    if defer:
+        if join_type == "left":
+            id_block = Block(data=bid, type=T.BIGINT, nulls=~found)
+            out_valid = page.valid
+        else:  # inner
+            id_block = Block(data=bid, type=T.BIGINT, nulls=None)
+            out_valid = page.valid & found
+        out = Page(blocks=page.blocks + (id_block,), valid=out_valid)
+        return out, matched, collision
     right_out = gather_rows(build, bid, found)
     if join_type == "left":
         # matched rows carry build values; unmatched carry NULL build
@@ -2983,10 +3437,6 @@ def _probe_join_page_unique(left_keys, right_keys, join_type, page: Page,
         right_blocks = right_out.blocks
         out_valid = page.valid & found
     out = Page(blocks=page.blocks + right_blocks, valid=out_valid)
-    # build_matched feeds only RIGHT/FULL outer emission, which this
-    # kernel never serves (inner/left only) — a zeros stub keeps the
-    # jit output signature without paying the scatter
-    matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
     return out, matched, collision
 
 
@@ -3008,7 +3458,7 @@ def _build_radix_join_index(left_keys, right_keys, layout, page: Page,
 
 
 def _probe_radix_join_page(left_keys, right_keys, join_type, layout,
-                           interpret, page: Page, build: Page,
+                           interpret, defer, page: Page, build: Page,
                            index, out_cap: int):
     """Probe one page through the Pallas range kernel, then the shared
     verified expansion (J.expand_matches) — identical output contract to
@@ -3029,34 +3479,59 @@ def _probe_radix_join_page(left_keys, right_keys, join_type, layout,
         bcols, bvalid, perm, pcols, pvalid,
         jnp.clip(start, 0, None), cnt, out_cap,
     )
-    return _assemble_join_output(join_type, page, build, m)
+    return _assemble_join_output(join_type, page, build, m, defer=defer)
 
 
 def _assemble_join_output(join_type, page: Page, build: Page,
-                          m: J.JoinMatches):
+                          m: J.JoinMatches, defer: bool = False):
+    """Expand matches into the output page. defer=True (inner/left
+    only) emits ONE int64 build row-id column instead of gathering the
+    build blocks — probe columns (including any row-id indirections the
+    probe page already carries) gather through probe_idx, which is
+    exactly the indirection COMPOSITION of latemat.py."""
     out_valid = m.match
     left_out = gather_rows(page, m.probe_idx, out_valid)
-    right_out = gather_rows(build, m.build_idx, out_valid)
-    out = Page(blocks=left_out.blocks + right_out.blocks, valid=out_valid)
+    if defer:
+        id_block = Block(
+            data=m.build_idx.astype(jnp.int64), type=T.BIGINT,
+            nulls=None,
+        )
+        out = Page(blocks=left_out.blocks + (id_block,),
+                   valid=out_valid)
+    else:
+        right_out = gather_rows(build, m.build_idx, out_valid)
+        out = Page(blocks=left_out.blocks + right_out.blocks,
+                   valid=out_valid)
     if join_type in ("left", "full"):
         # unmatched probe rows with null build side, appended
         unmatched_valid = page.valid & (m.probe_match_count == 0)
-        null_right = [
-            Block(
-                data=b.data,
-                type=b.type,
+        if defer:
+            pad_id = Block(
+                data=jnp.zeros((page.capacity,), dtype=jnp.int64),
+                type=T.BIGINT,
                 nulls=jnp.ones((page.capacity,), dtype=jnp.bool_),
-                dictionary=b.dictionary,
             )
-            for b in gather_rows(
-                build,
-                jnp.zeros((page.capacity,), dtype=jnp.int64),
-                unmatched_valid,
-            ).blocks
-        ]
-        pad = Page(
-            blocks=page.blocks + tuple(null_right), valid=unmatched_valid
-        )
+            pad = Page(
+                blocks=page.blocks + (pad_id,), valid=unmatched_valid
+            )
+        else:
+            null_right = [
+                Block(
+                    data=b.data,
+                    type=b.type,
+                    nulls=jnp.ones((page.capacity,), dtype=jnp.bool_),
+                    dictionary=b.dictionary,
+                )
+                for b in gather_rows(
+                    build,
+                    jnp.zeros((page.capacity,), dtype=jnp.int64),
+                    unmatched_valid,
+                ).blocks
+            ]
+            pad = Page(
+                blocks=page.blocks + tuple(null_right),
+                valid=unmatched_valid,
+            )
         out = concat_all([out, pad])
     return out, m.build_matched, m.overflow
 

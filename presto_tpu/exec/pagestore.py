@@ -31,15 +31,65 @@ retries invalidate them — cached pages may embed overflowed results).
 
 from __future__ import annotations
 
+import atexit
 import os
 import shutil
 import tempfile
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Set
 
 import jax
 import numpy as np
 
 from presto_tpu.page import Page
+
+# Spill directories created by THIS process, removed on close() and —
+# as a backstop for paths that bypass close() (a killed query thread, a
+# store leaked past interpreter teardown ordering) — swept at process
+# exit. Dir names embed the owning pid (presto_tpu_spill_<pid>_...) so
+# sweep_stale_spill_dirs can reclaim leftovers of DEAD processes
+# without ever touching a live sibling's spill.
+_LIVE_DIRS: Set[str] = set()
+_SWEPT_ROOTS: Set[str] = set()
+
+
+@atexit.register
+def _exit_sweep() -> None:  # pragma: no cover - interpreter teardown
+    for d in list(_LIVE_DIRS):
+        shutil.rmtree(d, ignore_errors=True)
+    _LIVE_DIRS.clear()
+
+
+def sweep_stale_spill_dirs(root: Optional[str] = None) -> int:
+    """Remove presto_tpu_spill_* dirs under ``root`` (default: the
+    system temp dir) whose embedded owner pid is no longer alive —
+    leftovers of crashed/killed engine processes. Returns the number of
+    directories removed. Live processes' dirs (including ours) are
+    never touched."""
+    root = root or tempfile.gettempdir()
+    removed = 0
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return 0
+    for name in entries:
+        if not name.startswith("presto_tpu_spill_"):
+            continue
+        pid_part = name[len("presto_tpu_spill_"):].split("_", 1)[0]
+        if not pid_part.isdigit():
+            continue  # pre-pid-tagged layout: ownership unknowable
+        pid = int(pid_part)
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+            continue  # owner alive
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue  # owned by another user / undeterminable
+        shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+        removed += 1
+    return removed
 
 
 class PageStore:
@@ -54,9 +104,16 @@ class PageStore:
         self.page_count = 0
         self._dir: Optional[str] = None
         if tier == "disk":
+            root = spill_dir or None
+            # opportunistic stale-dir sweep, once per root per process
+            key = root or tempfile.gettempdir()
+            if key not in _SWEPT_ROOTS:
+                _SWEPT_ROOTS.add(key)
+                sweep_stale_spill_dirs(key)
             self._dir = tempfile.mkdtemp(
-                prefix="presto_tpu_spill_", dir=spill_dir or None
+                prefix=f"presto_tpu_spill_{os.getpid()}_", dir=root
             )
+            _LIVE_DIRS.add(self._dir)
 
     def put(self, page: Page) -> None:
         from presto_tpu.exec.executor import page_bytes
@@ -95,6 +152,7 @@ class PageStore:
     def close(self) -> None:
         if self._dir is not None:
             shutil.rmtree(self._dir, ignore_errors=True)
+            _LIVE_DIRS.discard(self._dir)
             self._dir = None
         self._pages = []
 

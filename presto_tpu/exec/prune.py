@@ -36,6 +36,25 @@ def _remap(e: ir.RowExpression, m: Dict[int, int]) -> ir.RowExpression:
     return e
 
 
+def expr_channels(e: ir.RowExpression) -> Set[int]:
+    """Input channels an expression reads — the per-consumer column
+    LIVENESS set. The late-materialization driver (exec/executor.py
+    ``_lazy_filter`` / ``_join_pass``) uses it to lift exactly the
+    deferred channels a filter predicate or downstream join key
+    actually needs as VALUES, leaving everything else as a row-id
+    indirection until the chain boundary (exec/latemat.py)."""
+    out: Set[int] = set()
+    _expr_refs(e, out)
+    return out
+
+
+def remap_expr(e: ir.RowExpression, m: Dict[int, int]) -> ir.RowExpression:
+    """Rewrite an expression's InputRefs through a logical->physical
+    channel mapping (the lazy reduced-page layout, or any pruned
+    layout). Shared by _prune above and the lazy-filter driver."""
+    return _remap(e, m)
+
+
 def _channel_count(node: P.PhysicalNode, counts: Dict) -> int:
     """Output channel count without connector metadata."""
     if node in counts:

@@ -88,21 +88,9 @@ def gather_rows(
     side).
     """
     idx = jnp.clip(indices, 0, page.capacity - 1)
-    new_blocks = []
-    for blk in page.blocks:
-        if isinstance(blk.data, tuple):
-            data = tuple(d[idx] for d in blk.data)
-        else:
-            data = blk.data[idx]
-        nulls = blk.nulls[idx] if blk.nulls is not None else None
-        if force_null is not None:
-            base = (
-                nulls
-                if nulls is not None
-                else jnp.zeros(idx.shape, dtype=jnp.bool_)
-            )
-            nulls = base | force_null
-        new_blocks.append(blk.with_data(data, nulls=nulls))
+    new_blocks = [
+        blk.take(idx, extra_nulls=force_null) for blk in page.blocks
+    ]
     return Page(blocks=tuple(new_blocks), valid=valid)
 
 

@@ -139,6 +139,30 @@ class Block:
             dictionary=self.dictionary,
         )
 
+    def take(self, idx, extra_nulls=None) -> "Block":
+        """Row-indirection gather: output row j = self row idx[j], with
+        ``extra_nulls`` ORed over the gathered null mask.
+
+        The Block-level primitive behind DictionaryBlock-style late
+        materialization (exec/latemat.py defers carried join columns as
+        row-id indirections and takes the values once, at the first
+        value consumer) and ordinary row gathers (ops/compact.
+        gather_rows). Callers clamp idx into range; masked-off rows may
+        gather garbage that validity/null masks hide."""
+        if isinstance(self.data, tuple):
+            data = tuple(d[idx] for d in self.data)
+        else:
+            data = self.data[idx]
+        nulls = self.nulls[idx] if self.nulls is not None else None
+        if extra_nulls is not None:
+            nulls = (
+                extra_nulls if nulls is None else (nulls | extra_nulls)
+            )
+        return Block(
+            data=data, type=self.type, nulls=nulls,
+            dictionary=self.dictionary,
+        )
+
     def tree_flatten(self):
         children = (self.data, self.nulls)
         aux = (self.type, self.dictionary, self.nulls is None)

@@ -210,6 +210,12 @@ class LocalRunner:
             self.session.get("agg_compact_enabled"))
         ex.generated_join = bool(
             self.session.get("generated_join_enabled"))
+        ex.late_mat = {
+            "auto": "auto", "true": True, "false": False,
+        }[self.session.get("late_materialization_enabled")]
+        ex.agg_fusion = {
+            "auto": "auto", "true": True, "false": False,
+        }[self.session.get("fused_partial_agg_enabled")]
 
     def estimate_memory(self, sql: str) -> int:
         """Crude peak-HBM estimate for admission control (reference:
@@ -605,6 +611,14 @@ def explain_text(node: P.PhysicalNode, indent: int = 0, stats=None) -> str:
     parts = [line]
     for child in node.children():
         parts.append(explain_text(child, indent + 1, stats=stats))
+    if indent == 0 and stats is not None and stats.get("counters"):
+        # query-level execution counters (late-materialization gather
+        # accounting, pipeline-fusion engagement) — reference analog:
+        # QueryStats' operator summaries in EXPLAIN ANALYZE output
+        ctr = stats["counters"]
+        parts.append("Counters: " + ", ".join(
+            f"{k}={ctr[k]}" for k in sorted(ctr)
+        ))
     return "\n".join(parts)
 
 

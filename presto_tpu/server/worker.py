@@ -480,16 +480,25 @@ class WorkerServer:
                 partial = dataclasses.replace(cut, step="partial")
             ex = runner.executor
             runner.apply_session()
-            for page in ex.pages(partial):
-                if task.cancelled:
-                    break
-                import jax
+            import jax
 
-                host = jax.device_get(page)
-                blob = serde.serialize_page(host)
-                with task.lock:
-                    task.pages.append(blob)
+            # Worker-side overflow discipline: the executor's shared
+            # query-scope retry ladder (Executor.stream_fragment) —
+            # pages buffer locally and publish only after the
+            # fragment's OR-reduced overflow flags clear, so a
+            # truncated page set can NEVER reach the coordinator as a
+            # silent result. On overflow the fragment re-runs with 4x
+            # capacities (the coordinator's long-poll tolerates the
+            # delay); persistent overflow fails the task loudly via
+            # task.error.
+            def emit(page) -> bytes:
+                return serde.serialize_page(jax.device_get(page))
+
+            blobs: List[bytes] = ex.stream_fragment(
+                partial, emit, cancelled=lambda: task.cancelled
+            )
             with task.lock:
+                task.pages.extend(blobs)
                 task.done = True
         except Exception as e:  # pragma: no cover - error path
             with task.lock:

@@ -182,6 +182,32 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             str, "",
         ),
         PropertyMetadata(
+            "late_materialization_enabled",
+            "join chains defer carried build columns as row-id "
+            "indirections and gather values ONCE at the first consumer "
+            "that needs them (reference: DictionaryBlock outputs of "
+            "LookupJoinOperator); off gathers every carried column at "
+            "every join. auto = on when running on TPU (the win is "
+            "HBM gather bandwidth, ~25M rows/s per carried column), "
+            "off elsewhere (extra per-join programs cost CPU compile "
+            "time). Observability: gathers_deferred / "
+            "gathers_materialized counters in EXPLAIN ANALYZE",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
+        ),
+        PropertyMetadata(
+            "fused_partial_agg_enabled",
+            "compile scan->filter->project->partial-aggregation chains "
+            "to ONE XLA program per split (extends whole-pipeline "
+            "fusion through the partial agg step; fused_partial_aggs "
+            "counter in EXPLAIN ANALYZE). Grouped aggregations fuse in "
+            "the dense/MXU regime only. auto = on when running on TPU "
+            "(the win is per-launch tunnel overhead), off elsewhere "
+            "(bigger fused programs cost real CPU compile time)",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

@@ -304,18 +304,21 @@ def test_skew_rebalance_chunks_hot_partition(base):
     on the boosted retry the hot partition's build rows chunk by
     POSITION into unboosted-size passes (skew_chunks_used advances)
     and the inner join still matches the unspilled engine."""
+    from presto_tpu import types as T
     from presto_tpu.connectors.memory import MemoryConnector
 
     mem = MemoryConnector()
     n = 4000
-    # probe: keys 0..n-1; build: 85% of rows share key 7 (hot), the
-    # rest spread thinly — partition holding key 7 dwarfs the others
+    # build: 85% of rows share key 7 (hot), the rest spread thinly —
+    # the partition holding key 7 dwarfs the others. The probe table
+    # must be the BIGGER side so the planner keeps the hot table as
+    # the join BUILD (the side the rebalancer chunks).
     mem.create_table(
-        "probe", ["pk", "pv"], ["bigint", "bigint"],
-        rows=[(i % 50, i) for i in range(400)],
+        "probe", ["pk", "pv"], [T.BIGINT, T.BIGINT],
+        rows=[(i % 50, i) for i in range(8000)],
     )
     mem.create_table(
-        "build", ["bk", "bv"], ["bigint", "bigint"],
+        "build", ["bk", "bv"], [T.BIGINT, T.BIGINT],
         rows=[(7 if i % 100 < 85 else i % 50, i) for i in range(n)],
     )
     single = LocalRunner({"mem": mem}, page_rows=1 << 10,
@@ -338,15 +341,16 @@ def test_skew_rebalance_chunks_hot_partition(base):
 
 
 def test_skew_rebalance_off_still_correct(base):
+    from presto_tpu import types as T
     from presto_tpu.connectors.memory import MemoryConnector
 
     mem = MemoryConnector()
     mem.create_table(
-        "probe", ["pk", "pv"], ["bigint", "bigint"],
-        rows=[(i % 50, i) for i in range(400)],
+        "probe", ["pk", "pv"], [T.BIGINT, T.BIGINT],
+        rows=[(i % 50, i) for i in range(8000)],
     )
     mem.create_table(
-        "build", ["bk", "bv"], ["bigint", "bigint"],
+        "build", ["bk", "bv"], [T.BIGINT, T.BIGINT],
         rows=[(7 if i % 100 < 85 else i % 50, i)
               for i in range(4000)],
     )

@@ -35,6 +35,13 @@ def main() -> int:
     from presto_tpu.runner import explain_text
 
     print(explain_text(plan, stats=stats))
+    # gather accounting + fusion engagement for the analyzed run (the
+    # late-materialization / fused-partial-agg observability contract)
+    ctr = stats.get("counters", {})
+    if ctr:
+        print("# counters: " + ", ".join(
+            f"{k}={ctr[k]}" for k in sorted(ctr)
+        ), file=sys.stderr)
     print(f"# analyzed wall (incl. per-page drain overhead): {total:.2f}s")
     return 0
 
