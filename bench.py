@@ -435,6 +435,7 @@ def group_child(only_names) -> int:
 
     import zlib
 
+    from presto_tpu import compilecache as cc
     from presto_tpu.devsync import drain
 
     # in-child deadline (set by the orchestrator): when timing a rung
@@ -472,9 +473,46 @@ def group_child(only_names) -> int:
             ex._stream_cache = {}  # free materialized intermediates
             return pages, flags
 
+        # ---- first (warm-up) run: compile wall and steady wall are
+        # REPORTED SEPARATELY (compilecache.py counters), and the
+        # first-run record persists BEFORE the timed reps — a
+        # compile-bound rung that later hits the group deadline keeps
+        # an honest first_run_s/compile_wall_s instead of vanishing
+        # into a group timeout (BENCH_r05's q1/q6/q3/q5 group)
+        cc_base = cc.snapshot()
         t0 = time.time()
         pages, flags = run_device()
-        compile_s = time.time() - t0
+        first_run = time.time() - t0
+        ccd = cc.delta(cc_base)
+        table = "lineitem" if suite == "tpch" else "store_sales"
+        slots_in = runner.catalogs[suite].row_count(table)
+        r = details["rungs"].setdefault(name, {})
+        r.update({
+            "suite": suite,
+            "query": qid,
+            "sf": sf,
+            "props": list(props),
+            "first_run_s": round(first_run, 3),
+            "compile_s": round(first_run, 3),  # legacy alias
+            "compile_wall_s": ccd["compile_wall_s"],
+            "programs_compiled": ccd["programs_compiled"],
+            "program_cache_hits": ccd["program_cache_hits"],
+            "fact_slots": slots_in,
+        })
+        _write_details(details)
+        print(f"# {name}: first run {first_run:.1f}s "
+              f"(compile wall {ccd['compile_wall_s']}s over "
+              f"{ccd['programs_compiled']} programs, "
+              f"{ccd['program_cache_hits']} cache hits)",
+              file=sys.stderr)
+        if (child_deadline is not None
+                and time.time() > child_deadline):
+            r["time_error"] = (
+                "timed reps skipped: group deadline (first run + "
+                "compile wall recorded above)"
+            )
+            _write_details(details)
+            continue
         times = []
         # adaptive reps: a rung whose first timed run is already slow
         # gets one rep — median-of-3 precision is not worth 2 extra
@@ -494,23 +532,15 @@ def group_child(only_names) -> int:
         if profile_dir and name == HEADLINE:
             with jax.profiler.trace(profile_dir):
                 run_device()
-        table = "lineitem" if suite == "tpch" else "store_sales"
-        slots_in = runner.catalogs[suite].row_count(table)
-        r = {
-            "suite": suite,
-            "query": qid,
-            "sf": sf,
-            "props": list(props),
-            "compile_s": round(compile_s, 3),
+        r.update({
             "steady_s": round(steady, 5),
             "times_s": [round(t, 5) for t in times],
-            "fact_slots": slots_in,
             "slots_per_s": round(slots_in / steady),
-        }
-        details["rungs"][name] = r
+        })
+        r.pop("time_error", None)  # a retried group child succeeded
         print(f"# {name}: steady {steady*1e3:.1f} ms "
               f"({slots_in/steady/1e6:.0f}M slots/s), "
-              f"compile {compile_s:.0f}s", file=sys.stderr)
+              f"first run {first_run:.0f}s", file=sys.stderr)
         _write_details(details)
 
         # ---- generation-only attribution
@@ -622,6 +652,7 @@ def group_child(only_names) -> int:
             )
         else:
             r["capacity_boost"] = 1
+            r.pop("validate_error", None)
         _write_details(details)
         with open(os.path.join(REPO, f"val_{name}.json"), "w") as f:
             json.dump({
@@ -636,6 +667,52 @@ def group_child(only_names) -> int:
               f"decode {decode_s:.2f}s overflow={overflow}",
               file=sys.stderr)
     print(json.dumps({"ok": True}))
+    return 0
+
+
+def prewarm_child(only_names) -> int:
+    """Compile the named rungs' program sets into the persistent cache
+    WITHOUT timing them (run once, results discarded): later group
+    children — and later processes on this machine — load executables
+    from disk instead of re-invoking the compiler. This is the SF100
+    on-ramp: pay the 40+ minute partitioned-join compile once, off the
+    timed path. Prints one JSON line of per-rung compile stats."""
+    import time
+
+    from tools._common import configure_jax, make_runner, queries
+
+    configure_jax()
+    from presto_tpu import compilecache as cc
+    from presto_tpu.devsync import drain
+
+    out = {"cache_dir": None, "rungs": {}}
+    # RUNGS may already include the SF10 join rungs (env opt-in):
+    # dedup by name so no multi-minute rung prewarms twice
+    pool, seen = [], set()
+    for r in RUNGS + SF10_JOIN_RUNGS:
+        if r[0] not in seen:
+            seen.add(r[0])
+            pool.append(r)
+    selected = [r for r in pool
+                if only_names is None or r[0] in only_names]
+    for name, suite, qid, sf, props in selected:
+        runner = make_runner(suite, sf, props)
+        ex = runner.executor
+        plan = runner.plan(queries(suite)[qid])
+        base = cc.snapshot()
+        t0 = time.time()
+        ex._pending_overflow = []
+        pages = list(ex.pages(plan))
+        drain(pages)
+        ex._release_stream_cache()  # closes disk-tier spill dirs too
+        d = cc.delta(base)
+        d["wall_s"] = round(time.time() - t0, 3)
+        out["rungs"][name] = d
+        print(f"# prewarm {name}: {d['programs_compiled']} programs, "
+              f"compile wall {d['compile_wall_s']}s, "
+              f"{d['program_cache_hits']} cache hits", file=sys.stderr)
+    out["cache_dir"] = cc.cache_dir()
+    print(json.dumps(out))
     return 0
 
 
@@ -826,6 +903,14 @@ if __name__ == "__main__":
             and not sys.argv[i + 1].startswith("-") else None
         )
         sys.exit(group_child(only))
+    if "--prewarm" in sys.argv:
+        i = sys.argv.index("--prewarm")
+        only = (
+            sys.argv[i + 1].split(",")
+            if len(sys.argv) > i + 1
+            and not sys.argv[i + 1].startswith("-") else None
+        )
+        sys.exit(prewarm_child(only))
     if "--oracle-child" in sys.argv:
         sys.exit(oracle_child())
     if "--sqlite-child" in sys.argv:

@@ -132,6 +132,14 @@ def server_from_etc(etc_dir: str, port: Optional[int] = None, **kw):
     if port is None:
         port = int(conf.get("http-server.http.port", "8080"))
     mem = int(conf.get("query.max-memory-bytes", "0")) or None
+    # persistent compile cache (reference analog: compiled-artifact
+    # reuse across queries): one dir per machine outlives every server
+    # process pointed at it
+    cache_dir = conf.get("compile-cache.dir", "")
+    if cache_dir:
+        from presto_tpu import compilecache
+
+        compilecache.enable_persistent_cache(cache_dir)
     default_catalog = conf.get(
         "default-catalog", sorted(catalogs)[0]
     )

@@ -67,7 +67,9 @@ class GeneratorConnector:
         fn = self._compiled_gen(split.table, split.row_count, names)
         import jax.numpy as jnp
 
-        datas, valid = fn(jnp.int64(split.start_row))
+        datas, valid = fn(
+            jnp.int64(split.start_row), jnp.int64(split.row_count)
+        )
         dicts = self._dicts.get(split.table, {})
         blocks = []
         from presto_tpu.page import Block
@@ -84,13 +86,30 @@ class GeneratorConnector:
         return Page(blocks=tuple(blocks), valid=valid)
 
     def _compiled_gen(self, table: str, n: int, names: tuple):
-        """jit-compiled, column-pruned chunk generator; start_row is traced
-        so one compilation serves every chunk of the table."""
+        """jit-compiled, column-pruned chunk generator over the CANONICAL
+        (ladder-bucketed, exec/shapes.py) chunk shape; start_row and the
+        real row count are traced, so one compilation serves every chunk
+        whose size lands in the same bucket — tail splits no longer mint
+        a program shape per (scale factor, page_rows) combination.
+        Generated rows past the real count mask out of `valid` (the
+        generators are unbounded past the table end; the dist scan
+        relies on the same property)."""
         import jax
+        import jax.numpy as jnp
 
-        key = (table, n, names)
+        from presto_tpu.exec import shapes as SH
+
+        n_pad = SH.bucket(n)
+        key = (table, n_pad, names)
         if key not in self._gen_cache:
-            self._gen_cache[key] = jax.jit(self.gen_body(table, n, names))
+            body = self.gen_body(table, n_pad, names)
+
+            def padded(start, count, _body=body, _n=n_pad):
+                datas, valid = _body(start)
+                in_range = jnp.arange(_n, dtype=jnp.int64) < count
+                return datas, valid & in_range
+
+            self._gen_cache[key] = jax.jit(padded)
         return self._gen_cache[key]
 
     def _lazy_rows(self, table: str, start, n: int):

@@ -151,7 +151,8 @@ class DistExecutor(Executor):
             for page in self.pages(node.source):
                 dic = page.block(node.array_channel).dictionary
                 fn = self._shard_page_kernel(
-                    ("d_unnest", node, dic),
+                    ("d_unnest", node.array_channel, node.element_type,
+                     node.with_ordinality, dic),
                     functools.partial(
                         _unnest_page, node.array_channel,
                         node.element_type, node.with_ordinality,
@@ -164,7 +165,7 @@ class DistExecutor(Executor):
 
             fns = [
                 self._shard_page_kernel(
-                    ("d_groupid", node, si),
+                    ("d_groupid", node.key_channels, mask, si),
                     functools.partial(_group_id_page,
                                       node.key_channels, mask, si),
                 )
@@ -431,7 +432,7 @@ class DistExecutor(Executor):
             )
             if not node.group_channels:
                 fn = self._shard_page_kernel(
-                    ("d_gagg_partial", node),
+                    ("d_gagg_partial", node.aggregates, layouts),
                     functools.partial(
                         _partial_global_agg, node.aggregates, layouts
                     ),
@@ -460,7 +461,10 @@ class DistExecutor(Executor):
                 local_cap = min(
                     cap, _next_pow2(page.capacity // self.D)
                 )
-                key = ("d_agg_partial", node, local_cap, max_iters)
+                # canonical: the estimate-bearing node stays OUT of the
+                # key (exec/shapes.py discipline — content only)
+                key = ("d_agg_partial", node.group_channels,
+                       node.aggregates, layouts, local_cap, max_iters)
                 if key not in self._jit_cache:
                     self._jit_cache[key] = make(local_cap)
                 out, overflow = self._jit_cache[key](page)
@@ -494,7 +498,9 @@ class DistExecutor(Executor):
                 )
                 return out, jax.lax.psum(ovf.astype(jnp.int32), "d") > 0
 
-            key = ("d_agg_final", node, local_caps, fcap, max_iters)
+            key = ("d_agg_final", node.group_channels, node.aggregates,
+                   layouts, tuple(in_types), local_caps, fcap,
+                   max_iters)
             if key not in self._jit_cache:
                 self._jit_cache[key] = jax.jit(jax.shard_map(
                     body, mesh=self.mesh,

@@ -25,12 +25,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from presto_tpu import compilecache as CC
 from presto_tpu import types as T
 from presto_tpu.connectors.base import Connector
 from presto_tpu.exec import agg_states as S
 from presto_tpu.exec import latemat as LM
 from presto_tpu.exec import plan as P
 from presto_tpu.exec import prune as PR
+from presto_tpu.exec import shapes as SH
 from presto_tpu.expr.eval import evaluate, evaluate_filter
 from presto_tpu.ops import agg as A
 from presto_tpu.ops import hashing as H
@@ -42,9 +44,9 @@ from presto_tpu.ops.sort import sort_page
 from presto_tpu.page import Block, Dictionary, Page
 
 
-def _next_pow2(n: int) -> int:
-    n = max(int(n), 8)
-    return 1 << (n - 1).bit_length()
+# every program-shape size quantizes through the SHARED bucket ladder
+# (exec/shapes.py) — the name survives for the dist executor and tests
+_next_pow2 = SH.bucket
 
 
 def _row_bytes(types) -> int:
@@ -320,9 +322,24 @@ class Executor:
         # DCN ingest registry: RemoteSource.key -> callable yielding
         # host pages (reference: ExchangeClient wiring per task)
         self.remote_sources: Dict[str, object] = {}
+        # Compile-cost observability (compilecache.py): per-query deltas
+        # of the process-wide counters, set by execute() /
+        # stream_fragment() and reported through EXPLAIN ANALYZE.
+        # programs_compiled counts real XLA backend compiles (a
+        # persistent-cache hit is a program_cache_hits instead);
+        # compile_wall_s is their summed wall.
+        self.programs_compiled = 0
+        self.program_cache_hits = 0
+        self.compile_wall_s = 0.0
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
+        """One jit wrapper per CANONICAL program key. Keys name exactly
+        the inputs that shape the traced program (the kernel's bound
+        args, static sizes, dictionary signatures) and deliberately
+        exclude plan-node identity/estimates — two plans that differ
+        only in a capacity estimate share one wrapper, and the bucketed
+        static sizes (exec/shapes.py) make their programs identical."""
         if not self.use_jit:
             return fn
         if key not in self._jit_cache:
@@ -554,8 +571,16 @@ class Executor:
             steps.append(agg_tail)
             self.fused_partial_aggs += 1
 
-        def run_split(gen_fn, start):
+        def run_split(gen_fn, n_pad, start, count):
             datas, valid = gen_fn(start)
+            # canonical split shape: generation is padded to the ladder
+            # bucket; rows past the split's real count mask out here
+            # (generators have no bound — the dist scan relies on the
+            # same property), so every tail split of every scale factor
+            # reuses one program per bucket instead of minting a shape
+            valid = valid & (
+                jnp.arange(n_pad, dtype=jnp.int64) < count
+            )
             page = Page(blocks=tuple(
                 Block(data=d, type=t, nulls=None, dictionary=dic)
                 for d, t, dic in zip(datas, scan_types, scan_dicts)
@@ -573,15 +598,16 @@ class Executor:
             for split in splits:
                 if not split.row_count:
                     continue
-                key = ("fused", node, key_extra, cur.table,
-                       split.row_count)
+                n_pad = SH.bucket(split.row_count)
+                key = ("fused", node, key_extra, cur.table, n_pad)
                 if key not in self._jit_cache:
-                    gen_fn = conn.gen_body(
-                        cur.table, split.row_count, names)
+                    gen_fn = conn.gen_body(cur.table, n_pad, names)
                     self._jit_cache[key] = jax.jit(
-                        functools.partial(run_split, gen_fn))
+                        functools.partial(run_split, gen_fn, n_pad))
                 page, flags = self._jit_cache[key](
-                    jnp.int64(split.start_row))
+                    jnp.int64(split.start_row),
+                    jnp.int64(split.row_count),
+                )
                 self._pending_overflow.extend(flags)
                 yield page
 
@@ -683,7 +709,7 @@ class Executor:
             self._pending_overflow.append(build_all.num_rows() > bcap)
             build = compact_page(build_all, bcap)
             fn = self._jit(
-                ("cross", node, build.capacity),
+                ("cross", build.capacity),
                 _cross_join_page,
             )
             for page in self.pages(node.left):
@@ -703,7 +729,8 @@ class Executor:
             for page in self.pages(node.source):
                 dic = page.block(node.array_channel).dictionary
                 fn = self._jit(
-                    ("unnest", node, dic, page.capacity),
+                    ("unnest", node.array_channel, node.element_type,
+                     node.with_ordinality, dic, page.capacity),
                     functools.partial(
                         _unnest_page, node.array_channel,
                         node.element_type, node.with_ordinality,
@@ -716,7 +743,7 @@ class Executor:
             # appended (reference: GroupIdOperator's page replication)
             fns = [
                 self._jit(
-                    ("groupid", node, si),
+                    ("groupid", node.key_channels, mask, si),
                     functools.partial(_group_id_page, node.key_channels,
                                       mask, si),
                 )
@@ -737,7 +764,7 @@ class Executor:
             merged = concat_all(pages) if len(pages) > 1 else pages[0]
             self._account_page(merged)
             fn = self._jit(
-                ("markdistinct", node),
+                ("markdistinct", node.mark_channel_sets),
                 functools.partial(
                     _mark_distinct_page, node.mark_channel_sets
                 ),
@@ -763,7 +790,8 @@ class Executor:
             src_types = self.output_types(node.source)
             out_types = tuple(self.output_types(node)[len(src_types):])
             fn = self._jit(
-                ("window", node, merged.capacity),
+                ("window", node.partition_channels, node.order_keys,
+                 node.functions, out_types, merged.capacity),
                 functools.partial(
                     W.window_page, node.partition_channels,
                     node.order_keys, node.functions, out_types,
@@ -858,6 +886,7 @@ class Executor:
         self._joins_counter_base = (
             self.generated_joins_used, self.pallas_joins_used
         )
+        cc_base = CC.snapshot()
         try:
             for _attempt in range(6):
                 self._begin_attempt()
@@ -866,7 +895,12 @@ class Executor:
                     self._collect_stats.clear()
                 out_pages = list(self.pages(node))
                 if self._overflow_flagged():
-                    self._capacity_boost *= 4
+                    # re-enter at the next rung of the SHARED ladder
+                    # (shapes.py): boosted sizes coincide with a larger
+                    # query's first-attempt shapes, so the retry reuses
+                    # cached programs instead of minting fresh ones
+                    self._capacity_boost = SH.next_boost(
+                        self._capacity_boost)
                     continue
                 rows: List[tuple] = []
                 for page in out_pages:
@@ -879,6 +913,7 @@ class Executor:
             # release materialized intermediates (HBM/host pages) the
             # moment the query is done
             self._release_stream_cache()
+            self._snap_compile_counters(cc_base)
 
     def _begin_attempt(self) -> None:
         """Per-attempt reset shared by every overflow-ladder driver
@@ -914,6 +949,7 @@ class Executor:
         set can never escape because results publish only per
         completed attempt. Raises after 6 boosted retries."""
         self._capacity_boost = 1
+        cc_base = CC.snapshot()
         try:
             for _attempt in range(6):
                 self._begin_attempt()
@@ -924,7 +960,9 @@ class Executor:
                     out.append(emit(page))
                 if not self._overflow_flagged():
                     return out
-                self._capacity_boost *= 4
+                # same shared-ladder re-entry as execute(): fragment
+                # retries land on rungs the cache already paid for
+                self._capacity_boost = SH.next_boost(self._capacity_boost)
             raise RuntimeError(
                 "fragment capacity overflow persisted after 6 boosted "
                 "retries"
@@ -934,6 +972,15 @@ class Executor:
             # dirs) the moment the fragment is done — never rely on
             # __del__ timing (same discipline as execute())
             self._release_stream_cache()
+            self._snap_compile_counters(cc_base)
+
+    def _snap_compile_counters(self, base) -> None:
+        """Record this query's compile-cost delta (see compilecache.py;
+        process-wide counters, so concurrent queries share attribution)."""
+        d = CC.delta(base)
+        self.programs_compiled = d["programs_compiled"]
+        self.program_cache_hits = d["program_cache_hits"]
+        self.compile_wall_s = d["compile_wall_s"]
 
     def _release_stream_cache(self) -> None:
         """Invalidate materialized intermediates, CLOSING each PageStore
@@ -987,6 +1034,11 @@ class Executor:
             "fused_partial_aggs": self.fused_partial_aggs,
             "generated_joins_used": self.generated_joins_used - base_gen,
             "pallas_joins_used": self.pallas_joins_used - base_pal,
+            # compile-cost deltas for THIS query (compilecache.py):
+            # warmed runs report programs_compiled=0
+            "programs_compiled": self.programs_compiled,
+            "program_cache_hits": self.program_cache_hits,
+            "compile_wall_s": self.compile_wall_s,
         }
         return names, rows, stats
 
@@ -1041,15 +1093,16 @@ class Executor:
             S.state_layout(s.function, t)
             for s, t in zip(node.aggregates, in_types)
         ]
+        pcap = _next_pow2(node.capacity * self._capacity_boost)
         tail = self._fused_partial_tail(
-            node, layouts,
-            _next_pow2(node.capacity * self._capacity_boost),
-            64 * self._capacity_boost,
+            node, layouts, pcap, 64 * self._capacity_boost,
         )
         if tail is not None:
             fused = self._fused_stream(
                 node.source, agg_tail=tail,
-                key_extra=(node, self._capacity_boost,
+                key_extra=("partial", node.group_channels,
+                           node.aggregates, pcap,
+                           64 * self._capacity_boost,
                            self._collect_k_eff),
             )
             if fused is not None:
@@ -1057,7 +1110,8 @@ class Executor:
                 return
         if not node.group_channels:
             fn = self._jit(
-                ("gagg_partial", node),
+                ("gagg_partial", node.aggregates,
+                 tuple(tuple(l) for l in layouts)),
                 functools.partial(
                     _partial_global_agg, node.aggregates,
                     tuple(tuple(l) for l in layouts)
@@ -1069,7 +1123,8 @@ class Executor:
         cap = _next_pow2(node.capacity * self._capacity_boost)
         max_iters = 64 * self._capacity_boost
         fn = self._jit(
-            ("agg_partial", node, self._collect_k_eff),
+            ("agg_partial", node.group_channels, node.aggregates,
+             tuple(tuple(l) for l in layouts), self._collect_k_eff),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts),
@@ -1100,7 +1155,8 @@ class Executor:
                                       collect_k=self._collect_k_eff)
             )
             fn = self._jit(
-                ("gagg_final", node),
+                ("gagg_final", node.aggregates,
+                 tuple(tuple(l) for l in layouts), tuple(in_types)),
                 functools.partial(
                     _final_global_agg, node.aggregates,
                     tuple(tuple(l) for l in layouts), tuple(in_types)
@@ -1112,7 +1168,9 @@ class Executor:
             return
         merged = concat_all(pages) if len(pages) > 1 else pages[0]
         fn = self._jit(
-            ("agg_final", node, self._collect_k_eff),
+            ("agg_final", node.group_channels, node.aggregates,
+             tuple(tuple(l) for l in layouts), tuple(in_types),
+             self._agg_extra_types(origin), self._collect_k_eff),
             functools.partial(
                 _final_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts), tuple(in_types),
@@ -1185,7 +1243,8 @@ class Executor:
             cap = min(cap, _next_pow2(
                 self.agg_optimistic_rows * self._capacity_boost))
         partial_fn = self._jit(
-            ("agg_partial", node, self._collect_k_eff),
+            ("agg_partial", node.group_channels, node.aggregates,
+             tuple(tuple(l) for l in layouts), self._collect_k_eff),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts),
@@ -1208,7 +1267,9 @@ class Executor:
         # spill is on, onto partitioned passes).
         fold_cap = min(cap, _next_pow2((1 << 20) * self._capacity_boost))
         merge_fn = self._jit(
-            ("agg_merge", node, self._collect_k_eff),
+            ("agg_merge", node.aggregates,
+             tuple(tuple(l) for l in layouts),
+             len(node.group_channels), self._collect_k_eff),
             functools.partial(
                 _merge_partials_page, node.aggregates,
                 tuple(tuple(l) for l in layouts),
@@ -1226,7 +1287,8 @@ class Executor:
         fused = (
             self._fused_stream(
                 node.source, agg_tail=tail,
-                key_extra=(node, "single", self._capacity_boost,
+                key_extra=("single", node.group_channels,
+                           node.aggregates, cap, max_iters,
                            self._collect_k_eff),
             )
             if tail is not None and node.group_channels else None
@@ -1246,7 +1308,9 @@ class Executor:
         if merged is None:
             return
         final_fn = self._jit(
-            ("agg_final", node, self._collect_k_eff),
+            ("agg_final", node.group_channels, node.aggregates,
+             tuple(tuple(l) for l in layouts), tuple(in_types),
+             self._agg_extra_types(node), self._collect_k_eff),
             functools.partial(
                 _final_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts), tuple(in_types),
@@ -1306,12 +1370,13 @@ class Executor:
         if C > (1 << 21):
             yield from self.pages(src)
             return
+        # bare kernels: ONE canonical entry each serves every stream
         first = self._jit(
-            ("stream_compact1", key_node), _compact_with_flag,
+            ("stream_compact1",), _compact_with_flag,
             static_argnums=(1,),
         )
         merge = self._jit(
-            ("stream_compact2", key_node), _merge_compact_flag,
+            ("stream_compact2",), _merge_compact_flag,
             static_argnums=(2,),
         )
         acc = None
@@ -1361,10 +1426,11 @@ class Executor:
         self.spill_partitions_used = max(self.spill_partitions_used, parts)
         pfilter = self._partition_filter(node.group_channels, parts)
         cap = _next_pow2(node.capacity * self._capacity_boost)
-        pcap = _next_pow2(max(cap // parts * 2, 1024))
+        pcap = SH.chunk_bucket(cap, parts)
         max_iters = 64 * self._capacity_boost
         partial_fn = self._jit(
-            ("agg_partial", node, self._collect_k_eff),
+            ("agg_partial", node.group_channels, node.aggregates,
+             tuple(tuple(l) for l in layouts), self._collect_k_eff),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts),
@@ -1373,7 +1439,9 @@ class Executor:
             static_argnums=(1, 2),
         )
         final_fn = self._jit(
-            ("agg_final", node, self._collect_k_eff),
+            ("agg_final", node.group_channels, node.aggregates,
+             tuple(tuple(l) for l in layouts), tuple(in_types),
+             self._agg_extra_types(node), self._collect_k_eff),
             functools.partial(
                 _final_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts), tuple(in_types),
@@ -1384,7 +1452,9 @@ class Executor:
         )
         nkeys = len(node.group_channels)
         merge_fn = self._jit(
-            ("agg_merge", node, self._collect_k_eff),
+            ("agg_merge", node.aggregates,
+             tuple(tuple(l) for l in layouts),
+             len(node.group_channels), self._collect_k_eff),
             functools.partial(
                 _merge_partials_page, node.aggregates,
                 tuple(tuple(l) for l in layouts), nkeys,
@@ -1430,10 +1500,11 @@ class Executor:
         # partial output pages carry the keys at channels 0..nkeys-1
         pfilter = self._partition_filter(tuple(range(nkeys)), parts)
         cap = _next_pow2(node.capacity * self._capacity_boost)
-        pcap = _next_pow2(max(cap // parts * 2, 1024))
+        pcap = SH.chunk_bucket(cap, parts)
         max_iters = 64 * self._capacity_boost
         partial_fn = self._jit(
-            ("agg_partial", node, self._collect_k_eff),
+            ("agg_partial", node.group_channels, node.aggregates,
+             tuple(tuple(l) for l in layouts), self._collect_k_eff),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts),
@@ -1442,7 +1513,9 @@ class Executor:
             static_argnums=(1, 2),
         )
         merge_fn = self._jit(
-            ("agg_merge", node, self._collect_k_eff),
+            ("agg_merge", node.aggregates,
+             tuple(tuple(l) for l in layouts),
+             len(node.group_channels), self._collect_k_eff),
             functools.partial(
                 _merge_partials_page, node.aggregates,
                 tuple(tuple(l) for l in layouts), nkeys,
@@ -1451,7 +1524,9 @@ class Executor:
             static_argnums=(1, 2),
         )
         final_fn = self._jit(
-            ("agg_final", node, self._collect_k_eff),
+            ("agg_final", node.group_channels, node.aggregates,
+             tuple(tuple(l) for l in layouts), tuple(in_types),
+             self._agg_extra_types(node), self._collect_k_eff),
             functools.partial(
                 _final_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts), tuple(in_types),
@@ -1492,7 +1567,8 @@ class Executor:
 
     def _exec_global_agg(self, node, in_types, layouts) -> Page:
         partial_fn = self._jit(
-            ("gagg_partial", node),
+            ("gagg_partial", node.aggregates,
+             tuple(tuple(l) for l in layouts)),
             functools.partial(
                 _partial_global_agg, node.aggregates,
                 tuple(tuple(l) for l in layouts)
@@ -1500,8 +1576,10 @@ class Executor:
         )
         tail = self._fused_partial_tail(node, layouts, None, None)
         fused = (
-            self._fused_stream(node.source, agg_tail=tail,
-                               key_extra=(node, "global"))
+            self._fused_stream(
+                node.source, agg_tail=tail,
+                key_extra=("global", node.aggregates,
+                           tuple(tuple(l) for l in layouts)))
             if tail is not None else None
         )
         if fused is not None:
@@ -1515,7 +1593,8 @@ class Executor:
             ]
         merged = concat_all(partials) if len(partials) > 1 else partials[0]
         final_fn = self._jit(
-            ("gagg_final", node),
+            ("gagg_final", node.aggregates,
+             tuple(tuple(l) for l in layouts), tuple(in_types)),
             functools.partial(
                 _final_global_agg, node.aggregates,
                 tuple(tuple(l) for l in layouts), tuple(in_types)
@@ -2114,14 +2193,15 @@ class Executor:
         layout = PJ.plan_layout(build.capacity)
         interpret = self._pallas_interpret(layout)
         index, build_ovf = self._jit(
-            ("pallas_ubuild", node, build.capacity),
+            ("pallas_ubuild", node.right_keys[0], build.capacity),
             functools.partial(
                 _pallas_unique_build, node.right_keys[0], layout
             ),
         )(build)
         self._pending_overflow.append(build_ovf)
         fn = self._jit(
-            ("pallas_probe", node, build.capacity, interpret),
+            ("pallas_probe", node.left_keys[0], node.join_type,
+             build.capacity, interpret),
             functools.partial(
                 _pallas_probe_page, node.left_keys[0], node.join_type,
                 layout, interpret,
@@ -2216,7 +2296,7 @@ class Executor:
         for pg in right_stream():
             chunk_cap = max(
                 chunk_cap,
-                min(_next_pow2(max(pg.capacity // parts * 2, 1024)),
+                min(SH.chunk_bucket(pg.capacity, parts),
                     _next_pow2(pg.capacity)),
             )
             f = bfilter(pg, pj)
@@ -2283,7 +2363,8 @@ class Executor:
         the 'needed as a downstream join key' liveness contract."""
         if node.join_type in ("semi", "anti"):
             fn = self._jit(
-                ("semi", node, build.capacity),
+                ("semi", node.left_keys, node.right_keys,
+                 build.capacity),
                 functools.partial(_semi_join_page, node.left_keys,
                                   node.right_keys),
             )
@@ -2314,8 +2395,8 @@ class Executor:
         def probe_fn_for(pkeys, defer_item):
             if use_radix:
                 return self._jit(
-                    ("radix_probe", node, build.capacity, interpret,
-                     pkeys, defer_item),
+                    ("radix_probe", node.right_keys, node.join_type,
+                     build.capacity, interpret, pkeys, defer_item),
                     functools.partial(
                         _probe_radix_join_page, pkeys,
                         node.right_keys, node.join_type, layout,
@@ -2328,7 +2409,8 @@ class Executor:
                 # between distinct unique keys flags overflow and the
                 # boosted retry takes the general expansion below
                 return self._jit(
-                    ("join_probe_unique", node, build.capacity, pkeys,
+                    ("join_probe_unique", node.right_keys,
+                     node.join_type, build.capacity, pkeys,
                      defer_item),
                     functools.partial(
                         _probe_join_page_unique, pkeys,
@@ -2337,7 +2419,8 @@ class Executor:
                     static_argnums=(3,),
                 )
             return self._jit(
-                ("join_probe", node, build.capacity, pkeys, defer_item),
+                ("join_probe", node.right_keys, node.join_type,
+                 build.capacity, pkeys, defer_item),
                 functools.partial(
                     _probe_join_page, pkeys, node.right_keys,
                     node.join_type, defer_item,
@@ -2369,7 +2452,8 @@ class Executor:
             if sig not in indexes:
                 if use_radix:
                     index, b_ovf = self._jit(
-                        ("radix_build", node, build.capacity, sig),
+                        ("radix_build", node.right_keys,
+                         build.capacity, sig),
                         functools.partial(
                             _build_radix_join_index, pkeys,
                             node.right_keys, layout,
@@ -2380,7 +2464,8 @@ class Executor:
                     self._pending_overflow.append(b_ovf)
                 else:
                     index = self._jit(
-                        ("join_build", node, build.capacity, sig),
+                        ("join_build", node.right_keys,
+                         build.capacity, sig),
                         functools.partial(
                             _build_join_index, pkeys,
                             node.right_keys,

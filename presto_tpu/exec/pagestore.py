@@ -27,6 +27,13 @@ The TPU translation has two tiers:
 
 Stores are owned by the Executor per query attempt (capacity-boost
 retries invalidate them — cached pages may embed overflowed results).
+
+Shape contract (exec/shapes.py): stores preserve page shapes exactly
+across tiers — a restreamed page re-enters the very programs its
+first pass compiled. Callers size everything that feeds a store
+(grace-partition chunks, compacted build pieces, fold accumulators)
+through the shared bucket ladder, so spilled intermediates never
+reintroduce off-ladder shapes on the restream path.
 """
 
 from __future__ import annotations

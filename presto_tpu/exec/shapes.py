@@ -1,0 +1,67 @@
+"""The shared program-shape bucket ladder.
+
+Presto amortizes per-query codegen by aggressively reusing compiled
+artifacts across queries (reference: sql/gen/ExpressionCompiler's
+compiled-expression LRU, keyed on canonical expression shape). The
+JAX-native analog has two halves: a persistent compilation cache
+(presto_tpu/compilecache.py) and — the half that makes the cache
+actually HIT — canonicalizing every dynamic capacity the executor
+feeds into program shapes onto ONE power-of-two ladder.
+
+Every join build/output capacity, aggregation group capacity,
+grace-partition chunk size, fragment buffer size, and boosted-retry
+size quantizes through `bucket` below. Two consequences:
+
+  - a retry or a slightly different planner estimate lands on a rung
+    an earlier compilation already paid for (same HLO -> engine jit
+    cache hit, or at worst a persistent-cache hit instead of a fresh
+    XLA compile);
+  - distinct program shapes per operator family are bounded by the
+    ladder's log2 depth instead of by the number of distinct
+    estimates the planner can produce.
+
+The overflow-retry ladder is part of the same contract: a boost
+multiplies by BOOST_STEP (a power of two), so a boosted capacity
+re-enters the ladder exactly BOOST_STEP.bit_length()-1 rungs up —
+never an off-ladder ad-hoc size that would mint a fresh shape.
+"""
+
+from __future__ import annotations
+
+# The ladder floor: no operator buffer is ever sized below this many
+# slots (tiny shapes cost a full compile each just like big ones).
+LADDER_MIN = 8
+
+# Overflow-retry multiplier: each boosted attempt climbs exactly two
+# rungs. Shared by Executor.execute() and the worker-fragment
+# stream_fragment() path so a retried fragment's shapes coincide with
+# a bigger query's first-attempt shapes.
+BOOST_STEP = 4
+
+
+def bucket(n: int, floor: int = LADDER_MIN) -> int:
+    """Quantize a capacity/size onto the ladder: the smallest power of
+    two >= max(n, floor). THE canonical quantizer — every program-shape
+    size in the engine routes through here."""
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+def next_bucket(n: int) -> int:
+    """The rung strictly above n: where a size that overflowed its
+    bucket re-enters the ladder (never an ad-hoc `n * 2`-ish size)."""
+    b = bucket(n)
+    return b * 2 if b <= n else b
+
+
+def next_boost(boost: int) -> int:
+    """The next rung of the retry ladder (see BOOST_STEP)."""
+    return boost * BOOST_STEP
+
+
+def chunk_bucket(total: int, parts: int, floor: int = 1024) -> int:
+    """Per-partition chunk capacity for grace-style partitioned passes
+    (aggregation state, join builds, skew-rebalance chunks): ~2x the
+    expected total/parts occupancy — absorbing partition-hash
+    fluctuation without a boosted retry — quantized to the ladder."""
+    return bucket(max(total // max(parts, 1) * 2, floor))

@@ -216,6 +216,33 @@ class LocalRunner:
         ex.agg_fusion = {
             "auto": "auto", "true": True, "false": False,
         }[self.session.get("fused_partial_agg_enabled")]
+        # persistent compile cache (process-global jax config, so the
+        # wiring is idempotent; compilecache.py): programs compile once
+        # per canonical shape per machine, not per process
+        cache_dir = self.session.get("compile_cache_dir")
+        if cache_dir:
+            from presto_tpu import compilecache
+
+            compilecache.enable_persistent_cache(cache_dir)
+
+    def prewarm(self, sql: str) -> Dict:
+        """Compile a query's program set ahead of timing: plan + execute
+        once (results discarded) and report the compile-cost delta, so
+        subsequent timed runs measure steady state, not compile. With
+        compile_cache_dir set, one prewarm per machine serves every
+        later process (the SF100 story: pay the 40-minute partitioned-
+        join compile once, off the timed path)."""
+        import time as _time
+
+        from presto_tpu import compilecache
+
+        t0 = _time.perf_counter()
+        base = compilecache.snapshot()
+        self.execute(sql)
+        out = compilecache.delta(base)
+        out["wall_s"] = round(_time.perf_counter() - t0, 3)
+        out["cache_dir"] = compilecache.cache_dir()
+        return out
 
     def estimate_memory(self, sql: str) -> int:
         """Crude peak-HBM estimate for admission control (reference:

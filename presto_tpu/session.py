@@ -208,6 +208,16 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             validate=lambda v: v in ("auto", "true", "false"),
         ),
         PropertyMetadata(
+            "compile_cache_dir",
+            "directory for jax's persistent compilation cache: programs "
+            "compile once per canonical shape per MACHINE, not per "
+            "process (empty = in-process caching only; see "
+            "presto_tpu/compilecache.py). Observability: "
+            "programs_compiled / program_cache_hits / compile_wall_s "
+            "counters in EXPLAIN ANALYZE",
+            str, "",
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

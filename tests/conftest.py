@@ -24,6 +24,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache for the whole suite: programs compile once
+# per canonical shape per MACHINE, not per pytest process — repeated
+# tier-1 runs pay the multi-minute compile wall (the dist suite's
+# shard_map programs especially) only on the first cold run. The dir
+# lives under /tmp so it survives across runs; point
+# PRESTO_TPU_COMPILE_CACHE_DIR elsewhere (or at "") to move/disable.
+from presto_tpu import compilecache as _cc  # noqa: E402
+
+_cache_dir = os.environ.get(
+    "PRESTO_TPU_COMPILE_CACHE_DIR", "/tmp/presto_tpu_compile_cache"
+)
+if _cache_dir:
+    _cc.enable_persistent_cache(_cache_dir)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -60,9 +60,19 @@ def dist_repart(conn, mesh):
     )
 
 
+def _canon_row(row):
+    # floats compare to 9 significant digits: single-stream and mesh
+    # execution sum in different orders (and canonical page-shape
+    # padding changes the reduction tree), so float aggregates agree
+    # to ulps, not bit-exactly; everything else stays exact
+    return tuple(
+        f"{v:.9e}" if isinstance(v, float) else repr(v) for v in row
+    )
+
+
 def rows_equal(a, b):
-    return collections.Counter(map(repr, a)) == collections.Counter(
-        map(repr, b)
+    return collections.Counter(map(_canon_row, a)) == collections.Counter(
+        map(_canon_row, b)
     )
 
 

@@ -14,10 +14,17 @@ if REPO not in sys.path:
 def configure_jax():
     import jax
 
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+    from presto_tpu import compilecache
+
+    # min_compile_secs=0: cache EVERY program — retry-ladder rungs and
+    # small per-page kernels matter as much as the big fused programs
+    # when the alternative is the remote axon compiler (compilecache.py)
+    compilecache.enable_persistent_cache(
+        os.environ.get(
+            "PRESTO_TPU_COMPILE_CACHE_DIR",
+            os.path.join(REPO, ".jax_cache"),
+        )
     )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax
 
 

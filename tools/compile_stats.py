@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Compile-cost breakdown for one bench rung: cold-vs-warm compile
+walls, persistent-cache hit/miss counts, and the distribution of
+per-program backend-compile times (presto_tpu/compilecache.py).
+
+Runs the rung twice in one process. The FIRST run shows what a fresh
+process pays (persistent-cache hits replace compiles when the cache
+dir is warm); the SECOND run certifies the canonicalization contract:
+programs_compiled MUST be 0 — same query, same shapes, nothing new to
+compile (exec/shapes.py bucket ladder + canonical jit keys).
+
+Usage: compile_stats.py {tpch|tpcds} QID SF [k=v session props...]
+Prints one JSON document to stdout.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from tools._common import configure_jax, make_runner, queries  # noqa: E402
+
+
+def main() -> int:
+    suite, qid, sf = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+    configure_jax()
+    from presto_tpu import compilecache as cc
+
+    runner = make_runner(suite, sf, props=sys.argv[4:])
+    sql = queries(suite)[qid]
+    plan = runner.plan(sql)
+    ex = runner.executor
+
+    out = {
+        "suite": suite, "query": qid, "sf": sf,
+        "cache_dir": cc.cache_dir(), "runs": [],
+    }
+    for label in ("cold", "warm"):
+        base = cc.snapshot()
+        walls_before = len(cc.compile_walls())
+        t0 = time.time()
+        ex.execute(plan)
+        wall = time.time() - t0
+        d = cc.delta(base)
+        d["label"] = label
+        d["wall_s"] = round(wall, 3)
+        d["steady_wall_s"] = round(max(wall - d["compile_wall_s"], 0), 3)
+        walls = cc.compile_walls()[walls_before:]
+        d["per_program_walls_s"] = [
+            round(w, 4) for w in sorted(walls, reverse=True)[:20]
+        ]
+        out["runs"].append(d)
+        print(f"# {label}: wall {wall:.2f}s, compiled "
+              f"{d['programs_compiled']} programs "
+              f"({d['compile_wall_s']}s), "
+              f"{d['program_cache_hits']} persistent-cache hits",
+              file=sys.stderr)
+    warm = out["runs"][1]
+    out["canonical_ok"] = (
+        warm["programs_compiled"] == 0
+        and warm["persistent_cache_misses"] == 0
+    )
+    print(json.dumps(out, indent=1))
+    return 0 if out["canonical_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
