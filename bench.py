@@ -466,12 +466,32 @@ def group_child(only_names) -> int:
 
         def run_device(ex=ex, plan=plan):
             ex._pending_overflow = []
-            ex.pallas_joins_used = 0  # per-run attribution
+            # per-run path attribution (VERDICT Weak #4: rung
+            # discrepancies were unexplainable without it): which
+            # execution paths actually engaged, and how many fused-scan
+            # launches the split batching left
+            ex.pallas_joins_used = 0
+            ex.generated_joins_used = 0
+            ex.fused_partial_aggs = 0
+            ex.program_launches = 0
+            ex.splits_scanned = 0
             pages = list(ex.pages(plan))
             drain(pages)
             flags = list(ex._pending_overflow)
             ex._stream_cache = {}  # free materialized intermediates
             return pages, flags
+
+        def path_counters(ex=ex):
+            return {
+                "pallas_joins_used": ex.pallas_joins_used,
+                "generated_joins_used": ex.generated_joins_used,
+                "fused_partial_aggs": ex.fused_partial_aggs,
+                "program_launches": ex.program_launches,
+                "splits_per_launch": (
+                    round(ex.splits_scanned / ex.program_launches, 1)
+                    if ex.program_launches else 0.0
+                ),
+            }
 
         # ---- first (warm-up) run: compile wall and steady wall are
         # REPORTED SEPARATELY (compilecache.py counters), and the
@@ -528,7 +548,7 @@ def group_child(only_names) -> int:
         steady = statistics.median(times)
         # the last timed run doubles as the validation run: same plan,
         # same initial capacities; pages/flags decode at the end
-        staged.append((name, pages, flags, ex.pallas_joins_used, steady))
+        staged.append((name, pages, flags, path_counters(), steady))
         if profile_dir and name == HEADLINE:
             with jax.profiler.trace(profile_dir):
                 run_device()
@@ -628,7 +648,7 @@ def group_child(only_names) -> int:
     # ---- decode phase: the last timed run's pages ARE the validation
     # artifact (same plan, same initial capacities — overflow-free
     # decode certifies the timed runs). Bulk D2H only from here on.
-    for name, pages, flags, pallas_used, steady in staged:
+    for name, pages, flags, paths, steady in staged:
         t0 = time.time()
         overflow = any(bool(f) for f in flags)
         rows = []
@@ -643,9 +663,12 @@ def group_child(only_names) -> int:
         r["checksum_crc32"] = csum
         r["decode_s"] = round(decode_s, 3)
         r["wall_with_decode_s"] = round(steady + decode_s, 2)
-        # observability: >0 means the Pallas dim-join kernel ran
-        # (auto mode engages it for real on TPU; VERDICT r2 #4)
-        r["pallas_joins_used"] = pallas_used
+        # path attribution for the timed run (VERDICT r2 #4 / Weak #4):
+        # pallas_joins_used > 0 means the Pallas dim-join kernel ran,
+        # generated_joins_used / fused_partial_aggs name the fused
+        # paths, program_launches / splits_per_launch quantify the
+        # split-batched scan phase (ROOFLINE §7)
+        r.update(paths)
         if overflow:
             r["validate_error"] = (
                 "capacity overflow at initial capacities"

@@ -144,7 +144,18 @@ def server_from_etc(etc_dir: str, port: Optional[int] = None, **kw):
         "default-catalog", sorted(catalogs)[0]
     )
     page_rows = int(conf.get("page-rows", str(1 << 18)))
+    # deployment-tier session defaults (reference: config-level system
+    # session property defaults): split-batch.size seeds
+    # split_batch_size for every query that doesn't override it —
+    # e.g. split-batch.size=64 forces split batching on, =false pins
+    # per-split launches fleet-wide
+    session_defaults = dict(kw.pop("session_defaults", None) or {})
+    if conf.get("split-batch.size"):
+        session_defaults.setdefault(
+            "split_batch_size", conf["split-batch.size"]
+        )
     return PrestoTpuServer(
         catalogs, port=port, default_catalog=default_catalog,
-        memory_budget_bytes=mem, page_rows=page_rows, **kw,
+        memory_budget_bytes=mem, page_rows=page_rows,
+        session_defaults=session_defaults or None, **kw,
     )

@@ -240,8 +240,33 @@ class Connector:
         distributed executor can call inside shard_map so each mesh device
         generates its own split on-device. Return None if the connector
         can only produce host pages (the executor then stages host data
-        shard by shard)."""
+        shard by shard).
+
+        Contract (split-batched execution relies on it): the returned
+        function must be traceable under jax.vmap and inside
+        jax.lax.scan bodies — pure jnp elementwise in the traced start
+        row, no host reads, no python control flow on start — so the
+        executor can fold a whole batch of splits into one XLA program
+        (exec/executor._fused_stream)."""
         return None
+
+    def gen_batch(self, table: str, n: int, names: Tuple[str, ...]):
+        """Optional traceable BATCHED chunk generator: a pure function
+        ``starts[int64, B] -> (tuple of [B, n] column arrays,
+        valid[B, n])`` generating one n-row chunk per start row in a
+        single program — the generation half of split-batched
+        execution (exec/executor._fused_stream stacks B splits into a
+        [B, n] leading dim and vmaps the fused pipeline body over it).
+        Default derives from gen_body via jax.vmap, which the gen_body
+        traceability contract guarantees is valid; connectors with a
+        cheaper closed batched form may override. None when gen_body
+        is None."""
+        body = self.gen_body(table, n, names)
+        if body is None:
+            return None
+        import jax
+
+        return jax.vmap(body)
 
     def gen_at(self, table: str, names: Tuple[str, ...]):
         """Optional traceable RANDOM-ACCESS generator: a pure function

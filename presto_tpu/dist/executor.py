@@ -247,12 +247,18 @@ class DistExecutor(Executor):
         spec = NamedSharding(self.mesh, PS("d"))
         for r in range(0, len(starts), self.D):
             chunk = starts[r:r + self.D]
+            real = len(chunk)
             # pad the tail round; padded starts generate fully-masked rows
             chunk = chunk + [total] * (self.D - len(chunk))
             start_arr = jax.device_put(
                 np.asarray(chunk, dtype=np.int64), spec
             )
             datas, valid = fn(start_arr)
+            # launch amortization (ROOFLINE §7): a mesh round is one
+            # program covering D splits — the same accounting the
+            # split-batched local scan reports
+            self.program_launches += 1
+            self.splits_scanned += real
             blocks = tuple(
                 Block(
                     data=data,

@@ -313,6 +313,31 @@ class Executor:
         # generated_joins_used).
         self.agg_fusion = "auto"
         self.fused_partial_aggs = 0
+        # Split-batched execution (session property split_batch_size):
+        # fold the per-SPLIT driver loop of a fused pipeline into XLA.
+        # Fused scan→filter→project→partial-agg chains run a whole
+        # batch of splits as ONE program — a lax.scan over split
+        # indices with the partial-aggregation state as carry — and
+        # page-emitting chains (probe-side join pipelines) vmap the
+        # fused body over a [B, n_pad] stacked batch, emitting the
+        # batch as one page. Batch sizes quantize onto the shapes.py
+        # ladder (one canonical program per bucket); tail batches pad
+        # with zero traced row counts (every generated row masks out);
+        # overflow flags OR-reduce across the batch into the deferred
+        # ladder. "auto" engages on TPU only — the win is the ~6ms
+        # per-LAUNCH tunnel tax, which CPU doesn't pay, while the
+        # scanned/vmapped programs cost real CPU compile time (the
+        # pallas_join_enabled policy); an int forces that max batch.
+        # Counters: program_launches = fused-scan program launches
+        # this attempt, splits_scanned = real (unpadded) splits they
+        # covered — splits_per_launch in EXPLAIN ANALYZE is their
+        # ratio. split_batch_fallbacks counts streams that fell back
+        # to the per-split loop because the chain did not trace under
+        # vmap/scan (diagnostic; never reset).
+        self.split_batch = "auto"
+        self.program_launches = 0
+        self.splits_scanned = 0
+        self.split_batch_fallbacks = 0
         # blocking-aggregation sizing heuristics (session properties
         # agg_optimistic_rows / agg_compact_enabled): start group
         # capacities tight and densify join-sparse inputs, both guarded
@@ -513,13 +538,23 @@ class Executor:
         Returns None when the subtree has any non-fusable node.
 
         ``agg_tail`` extends the fusion THROUGH partial aggregation
-        (see _fused_partial_tail): a ("map", fn) tail appends a plain
-        page transform (global partial states), an ("aggflag", fn) tail
-        appends a grouped partial step whose overflow flag joins the
-        deferred ladder — scan→filter→project→partial-agg in ONE
-        program per split (ROOFLINE §4: ~6 launches total for Q1 SF1
-        instead of ~8 per page). ``key_extra`` salts the jit key with
-        the caller's boost-dependent parameters."""
+        (see _fused_partial_tail): a ("map", fn, None) tail appends a
+        plain page transform (global partial states), an
+        ("aggflag", fn, merge) tail appends a grouped partial step
+        whose overflow flag joins the deferred ladder — scan→filter→
+        project→partial-agg in ONE program per split (ROOFLINE §4: ~6
+        launches total for Q1 SF1 instead of ~8 per page). ``merge``
+        is the state-merge kernel the split-batched scan carries
+        partial state through. ``key_extra`` salts the jit key with
+        the caller's boost-dependent parameters.
+
+        Split batching (split_batch_size, ROOFLINE §7) then folds the
+        per-SPLIT loop itself into XLA: batches of splits run as ONE
+        program — lax.scan with the partial-agg state as carry for agg
+        tails, a vmapped [B, n_pad] stack emitted as one page for
+        page-emitting chains — so the whole multi-split scan phase of
+        a Q1/Q6-shaped query pays ceil(splits/B) launches instead of
+        one per split."""
         if not self.use_jit:
             return None
         walked = self._scan_chain(node, through_joins=True)
@@ -567,12 +602,13 @@ class Executor:
                 fn = _node_replay_fn(nd)
                 if fn is not None:
                     steps.append(("map", fn))
+        batch_merge = None
         if agg_tail is not None:
-            steps.append(agg_tail)
+            kind, fn, batch_merge = agg_tail
+            steps.append((kind, fn))
             self.fused_partial_aggs += 1
 
-        def run_split(gen_fn, n_pad, start, count):
-            datas, valid = gen_fn(start)
+        def make_page(datas, valid, n_pad, count):
             # canonical split shape: generation is padded to the ladder
             # bucket; rows past the split's real count mask out here
             # (generators have no bound — the dist scan relies on the
@@ -581,12 +617,14 @@ class Executor:
             valid = valid & (
                 jnp.arange(n_pad, dtype=jnp.int64) < count
             )
-            page = Page(blocks=tuple(
+            return Page(blocks=tuple(
                 Block(data=d, type=t, nulls=None, dictionary=dic)
                 for d, t, dic in zip(datas, scan_types, scan_dicts)
             ), valid=valid)
+
+        def apply_steps(page, use_steps):
             flags = []
-            for kind, fn in steps:
+            for kind, fn in use_steps:
                 if kind in ("joinw", "aggflag"):
                     page, flag = fn(page)
                     flags.append(flag)
@@ -594,35 +632,187 @@ class Executor:
                     page = fn(page)
             return page, tuple(flags)
 
-        def stream():
-            for split in splits:
-                if not split.row_count:
-                    continue
-                n_pad = SH.bucket(split.row_count)
-                key = ("fused", node, key_extra, cur.table, n_pad)
-                if key not in self._jit_cache:
-                    gen_fn = conn.gen_body(cur.table, n_pad, names)
-                    self._jit_cache[key] = jax.jit(
-                        functools.partial(run_split, gen_fn, n_pad))
-                page, flags = self._jit_cache[key](
-                    jnp.int64(split.start_row),
-                    jnp.int64(split.row_count),
+        def run_split(gen_fn, n_pad, start, count):
+            datas, valid = gen_fn(start)
+            return apply_steps(make_page(datas, valid, n_pad, count),
+                               steps)
+
+        def launch_one(split):
+            n_pad = SH.bucket(split.row_count)
+            key = ("fused", node, key_extra, cur.table, n_pad)
+            if key not in self._jit_cache:
+                gen_fn = conn.gen_body(cur.table, n_pad, names)
+                self._jit_cache[key] = jax.jit(
+                    functools.partial(run_split, gen_fn, n_pad))
+            page, flags = self._jit_cache[key](
+                jnp.int64(split.start_row),
+                jnp.int64(split.row_count),
+            )
+            self.program_launches += 1
+            self.splits_scanned += 1
+            self._pending_overflow.extend(flags)
+            return page
+
+        live = [s for s in splits if s.row_count]
+
+        def stream_single():
+            for split in live:
+                yield launch_one(split)
+
+        bmax = 0
+        if len(live) > 1:
+            n_pad_all = max(SH.bucket(s.row_count) for s in live)
+            bmax = self._split_batch_max(
+                n_pad_all, scanned=agg_tail is not None)
+        if bmax < 2:
+            return stream_single()
+
+        # ---------------- split-batched execution (one program per
+        # batch of splits; ROOFLINE §7). One canonical program per
+        # (pipeline, n_pad, batch bucket): full batches are the pow-2
+        # bmax, the tail batch is its own bucket, padded slots carry
+        # count=0 so every generated row masks out.
+        def or_flags(flags):
+            out = jnp.zeros((), dtype=jnp.bool_)
+            for f in flags:
+                out = out | f
+            return out
+
+        def build_batch_fn():
+            if agg_tail is None:
+                # page-emitting chain: vmap the fused body over the
+                # stacked [B, n_pad] batch; the batch emits as ONE
+                # page of B*n_pad slots (the exact concatenation of
+                # the per-split pages), so downstream per-page
+                # programs amortize their launches by B too
+                gen_b = conn.gen_batch(cur.table, n_pad_all, names)
+
+                def post(datas, valid, count):
+                    return apply_steps(
+                        make_page(datas, valid, n_pad_all, count),
+                        steps,
+                    )
+
+                def run_batch(starts, counts):
+                    datas, valid = gen_b(starts)
+                    pages, flags = jax.vmap(post)(datas, valid, counts)
+                    return (
+                        _merge_leading(pages),
+                        tuple(jnp.any(f) for f in flags),
+                    )
+
+                return run_batch
+            gen_fn = conn.gen_body(cur.table, n_pad_all, names)
+            if steps[-1][0] == "map":
+                # global partial-agg tail: scan over splits, stacking
+                # the 1-row state pages — the batch emits exactly the
+                # concat of the per-split states, so parity with the
+                # unbatched driver loop is bit-exact
+                def body(_, x):
+                    page, flags = run_split(
+                        gen_fn, n_pad_all, x[0], x[1])
+                    return 0, (page, or_flags(flags))
+
+                def run_batch(starts, counts):
+                    _, (states, flags) = jax.lax.scan(
+                        body, 0, (starts, counts))
+                    return _merge_leading(states), (jnp.any(flags),)
+
+                return run_batch
+            # grouped partial-agg tail: lax.scan over splits with the
+            # partial-aggregation STATE as carry — generation,
+            # filtering, and accumulation never return to the host.
+            # The carry is one merge-capacity state page; each split's
+            # partial states fold in through the same merge kernel the
+            # host _FoldBuffer uses, and every overflow (agg, join
+            # window, merge) ORs into one deferred flag per batch.
+            pre = steps[:-1]
+            tail_fn = steps[-1][1]
+
+            def one_state(start, count):
+                datas, valid = gen_fn(start)
+                page, flags = apply_steps(
+                    make_page(datas, valid, n_pad_all, count), pre)
+                st, ovf = tail_fn(page)
+                return st, or_flags(flags) | ovf
+
+            def run_batch(starts, counts):
+                # split 0 seeds the carry (merged alone into the carry
+                # capacity, so init and body share one state shape)
+                st0, f0 = one_state(starts[0], counts[0])
+                acc, m0 = batch_merge(st0)
+
+                def body(carry, x):
+                    acc, ovf = carry
+                    st, f = one_state(x[0], x[1])
+                    acc2, mo = batch_merge(concat_all([acc, st]))
+                    return (acc2, ovf | f | mo), None
+
+                (acc, ovf), _ = jax.lax.scan(
+                    body, (acc, f0 | m0),
+                    (starts[1:], counts[1:]),
                 )
+                return acc, (ovf,)
+
+            return run_batch
+
+        def stream_batched():
+            i = 0
+            while i < len(live):
+                chunk = live[i:i + bmax]
+                if len(chunk) == 1:
+                    # a lone tail split reuses the per-split program
+                    # instead of padding a 2-batch (a padded slot
+                    # still runs the full generator)
+                    yield launch_one(chunk[0])
+                    i += 1
+                    continue
+                B = SH.split_batch_bucket(len(chunk))
+                key = ("fused_batch", node, key_extra, cur.table,
+                       n_pad_all, B)
+                if key not in self._jit_cache:
+                    self._jit_cache[key] = jax.jit(build_batch_fn())
+                starts = np.zeros(B, np.int64)
+                counts = np.zeros(B, np.int64)
+                for j, s in enumerate(chunk):
+                    starts[j] = s.start_row
+                    counts[j] = s.row_count
+                try:
+                    page, flags = self._jit_cache[key](
+                        jnp.asarray(starts), jnp.asarray(counts))
+                except Exception:
+                    if i > 0:
+                        raise
+                    # conservative escape: a chain that does not trace
+                    # under vmap/scan (custom kernels, host callbacks)
+                    # runs the per-split loop instead — nothing has
+                    # been yielded yet, so the stream restarts whole
+                    self._jit_cache.pop(key, None)
+                    self.split_batch_fallbacks += 1
+                    yield from stream_single()
+                    return
+                self.program_launches += 1
+                self.splits_scanned += len(chunk)
                 self._pending_overflow.extend(flags)
                 yield page
+                i += len(chunk)
 
-        return stream()
+        return stream_batched()
 
     def _fused_partial_tail(self, node: P.Aggregation, layouts,
                             cap: Optional[int], max_iters: Optional[int]):
-        """The partial-aggregation tail step for _fused_stream, or None
-        when the shape should not fuse. Global aggregations always
-        qualify. Grouped ones qualify unless fusing would bypass the
-        join-output compaction stream (_agg_source_pages): big group
-        capacity AND a join in the chain — there the blocking agg's
-        per-sparse-page cost dwarfs the saved launches. Everywhere else
-        the fused tail does EXACTLY the per-page work of the unfused
-        driver loop, minus the launches."""
+        """The partial-aggregation tail step for _fused_stream — a
+        (kind, fn, batch_merge) triple — or None when the shape should
+        not fuse. Global aggregations always qualify. Grouped ones
+        qualify unless fusing would bypass the join-output compaction
+        stream (_agg_source_pages): big group capacity AND a join in
+        the chain — there the blocking agg's per-sparse-page cost
+        dwarfs the saved launches. Everywhere else the fused tail does
+        EXACTLY the per-page work of the unfused driver loop, minus
+        the launches. ``batch_merge`` (grouped tails only) is the
+        state-merge kernel the split-batched lax.scan carries partial
+        state through — the in-program analog of the host
+        _FoldBuffer's merge."""
         mode = self.agg_fusion
         if mode in (False, None, "false", "off") or not self.use_jit:
             return None
@@ -631,7 +821,7 @@ class Executor:
         layouts_t = tuple(tuple(l) for l in layouts)
         if not node.group_channels:
             return ("map", functools.partial(
-                _partial_global_agg, node.aggregates, layouts_t))
+                _partial_global_agg, node.aggregates, layouts_t), None)
         if cap is None:
             return None
         if (node.capacity > A.MATMUL_AGG_MAX_GROUPS
@@ -641,8 +831,45 @@ class Executor:
             _partial_agg_page, node.group_channels, node.aggregates,
             layouts_t, collect_k=self._collect_k_eff,
         )
-        return ("aggflag",
-                functools.partial(_fused_agg_step, raw, cap, max_iters))
+        merge_raw = functools.partial(
+            _merge_partials_page, node.aggregates, layouts_t,
+            len(node.group_channels), collect_k=self._collect_k_eff,
+        )
+        return (
+            "aggflag",
+            functools.partial(_fused_agg_step, raw, cap, max_iters),
+            functools.partial(_fused_merge_step, merge_raw, cap,
+                              max_iters),
+        )
+
+    def _split_batch_max(self, n_pad: int, scanned: bool) -> int:
+        """Effective max splits per batched launch for one fused
+        stream, or 0 when split batching is off. split_batch_size
+        resolution: "auto" engages on TPU only (the win is the
+        per-launch tunnel tax, which CPU doesn't pay, while the
+        scanned/vmapped programs cost real CPU compile time — the
+        pallas_join_enabled policy); an int forces that max on any
+        backend. vmapped page batches (scanned=False) additionally
+        bound B*n_pad under the axon kernel fault line; the lax.scan
+        agg paths carry one split at a time and are exempt. The
+        result is floored to a power of two so full batches land on
+        the shapes.py ladder and only the tail batch pads."""
+        mode = self.split_batch
+        if mode in (False, None, 0, "false", "off", "0"):
+            return 0
+        if not self.use_jit:
+            return 0
+        if mode == "auto":
+            if jax.default_backend() != "tpu":
+                return 0
+            cap = SH.SPLIT_BATCH_MAX
+        else:
+            cap = int(mode)
+        if not scanned and n_pad > 0:
+            cap = min(cap, SH.SPLIT_BATCH_ROWS_MAX // max(n_pad, 1))
+        if cap < 2:
+            return 0
+        return 1 << (cap.bit_length() - 1)
 
     def _pages_impl(self, node: P.PhysicalNode) -> Iterator[Page]:
         if isinstance(node, (P.Filter, P.Project, P.HashJoin)):
@@ -928,6 +1155,8 @@ class Executor:
         self.gathers_deferred = 0
         self.gathers_materialized = 0
         self.fused_partial_aggs = 0
+        self.program_launches = 0
+        self.splits_scanned = 0
 
     def _overflow_flagged(self) -> bool:
         """OR-reduce the attempt's deferred overflow flags — the ONE
@@ -1032,6 +1261,15 @@ class Executor:
             "gathers_deferred": self.gathers_deferred,
             "gathers_materialized": self.gathers_materialized,
             "fused_partial_aggs": self.fused_partial_aggs,
+            # split-batched execution (ROOFLINE §7): fused-scan
+            # program launches this attempt and the real splits they
+            # covered — splits_per_launch > 1 means the per-split
+            # driver loop folded into XLA
+            "program_launches": self.program_launches,
+            "splits_per_launch": (
+                round(self.splits_scanned / self.program_launches, 1)
+                if self.program_launches else 0.0
+            ),
             "generated_joins_used": self.generated_joins_used - base_gen,
             "pallas_joins_used": self.pallas_joins_used - base_pal,
             # compile-cost deltas for THIS query (compilecache.py):
@@ -3311,6 +3549,27 @@ def _fused_agg_step(raw, cap, max_iters, page: Page):
     <= rows, so the group capacity clips to the page like the unfused
     driver loop does."""
     return raw(page, min(cap, _next_pow2(page.capacity)), max_iters)
+
+
+def _fused_merge_step(merge_raw, cap, max_iters, page: Page):
+    """State-merge step of the split-batched lax.scan (kernel): fold a
+    carry + one split's partial states back into the carry capacity.
+    The output capacity is a pure function of (cap, key structure) —
+    never of the input page's capacity — so the scan carry keeps one
+    static shape whether it was seeded from a lone state page or fed
+    the concat of carry + state."""
+    return merge_raw(page, cap, max_iters)
+
+
+def _merge_leading(tree):
+    """Collapse the leading batch dim of a stacked Page pytree:
+    [B, n, ...] leaves become [B*n, ...] — the in-program equivalent
+    of concat_all over the B per-split pages a batched launch covers
+    (block metadata is static aux data and survives untouched)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),
+        tree,
+    )
 
 
 def _compact_with_flag(page: Page, cap: int):

@@ -65,3 +65,32 @@ def chunk_bucket(total: int, parts: int, floor: int = 1024) -> int:
     expected total/parts occupancy — absorbing partition-hash
     fluctuation without a boosted retry — quantized to the ladder."""
     return bucket(max(total // max(parts, 1) * 2, floor))
+
+
+# --------------------------------------------------- split batching
+# Split-batched execution (exec/executor._fused_stream): how many
+# splits of a fused scan pipeline fold into ONE XLA program launch.
+# The per-LAUNCH tunnel tax (~6ms on axon, ROOFLINE §1) multiplies by
+# splits x programs; batching divides the split factor away. 64 bounds
+# the tail-batch padding waste (a padded slot still runs the full
+# generator) while keeping SF100's ~600 splits at ~10 launches.
+SPLIT_BATCH_MAX = 64
+
+# vmapped page-emitting batches materialize [B, n_pad] stacked buffers
+# for the whole batch at once; B * n_pad stays under the axon
+# >=4M-row kernel fault line (the same ceiling max_join_build_rows
+# exists for). The lax.scan paths carry one split at a time and are
+# exempt.
+SPLIT_BATCH_ROWS_MAX = 1 << 22
+
+
+def split_batch_bucket(n: int) -> int:
+    """Batch-size bucket for split-batched execution: the smallest
+    power of two >= n (floor 2, not LADDER_MIN — batch counts are a
+    different family from row capacities). Full batches are sized to a
+    power of two by the caller, so only the tail batch pads — with
+    traced zero row counts that mask every generated row out — and
+    distinct batched programs per pipeline are bounded by the ladder's
+    log2 depth, composing with the persistent compile cache exactly
+    like every other program shape."""
+    return bucket(n, floor=2)

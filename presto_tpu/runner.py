@@ -216,6 +216,13 @@ class LocalRunner:
         ex.agg_fusion = {
             "auto": "auto", "true": True, "false": False,
         }[self.session.get("fused_partial_agg_enabled")]
+        sb = self.session.get("split_batch_size")
+        # "auto" resolves per backend inside the executor (the
+        # pallas_join_enabled policy); a digit forces that max batch
+        ex.split_batch = (
+            int(sb) if sb.isdigit()
+            else ("auto" if sb == "auto" else 0)
+        )
         # persistent compile cache (process-global jax config, so the
         # wiring is idempotent; compilecache.py): programs compile once
         # per canonical shape per machine, not per process

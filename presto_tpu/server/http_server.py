@@ -557,6 +557,7 @@ class PrestoTpuServer:
         plugins=(),
         resource_groups=None,
         memory_budget_bytes: Optional[int] = None,
+        session_defaults=None,
     ):
         from presto_tpu.runner import LocalRunner
 
@@ -602,7 +603,23 @@ class PrestoTpuServer:
         if memory_budget_bytes:
             memory_arbiter = MemoryArbiter(memory_budget_bytes)
 
+        # fail-fast validation: a bad deployment default (unknown name,
+        # rejected value) must abort startup, not fail every query
+        if session_defaults:
+            Session(properties=session_defaults)
+
         def runner_factory(session: Session):
+            # deployment-tier session defaults (etc/config.properties,
+            # see config.server_from_etc): seed properties the client
+            # session did not explicitly set — an explicit
+            # X-Presto-Session header or SET SESSION always wins.
+            # Seeded values read as set() for this query's session (a
+            # deployment default behaves like a header-supplied
+            # property); they re-seed on every query, so there is no
+            # cross-query unset() path back to the code default.
+            for k, v in (session_defaults or {}).items():
+                if not session.is_set(k):
+                    session.set(k, v)
             if memory_arbiter is None:
                 # serial path: one engine, re-sessioned per query
                 self._runner.session = session

@@ -208,6 +208,23 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             validate=lambda v: v in ("auto", "true", "false"),
         ),
         PropertyMetadata(
+            "split_batch_size",
+            "fold up to this many splits of a fused scan pipeline "
+            "into ONE XLA program launch (a lax.scan over split "
+            "indices with the partial-aggregation state as carry for "
+            "scan->filter->project->partial-agg chains; a vmapped "
+            "[B, page] stacked batch emitted as one page for "
+            "page-emitting chains). auto = on when running on TPU "
+            "with the default max batch (the win is the per-launch "
+            "tunnel tax, which CPU doesn't pay — the "
+            "pallas_join_enabled policy); false = per-split launches. "
+            "Observability: program_launches / splits_per_launch "
+            "counters in EXPLAIN ANALYZE",
+            str, "auto",
+            validate=lambda v: v in ("auto", "false", "off")
+            or v.isdigit(),
+        ),
+        PropertyMetadata(
             "compile_cache_dir",
             "directory for jax's persistent compilation cache: programs "
             "compile once per canonical shape per MACHINE, not per "

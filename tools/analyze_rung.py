@@ -42,6 +42,14 @@ def main() -> int:
         print("# counters: " + ", ".join(
             f"{k}={ctr[k]}" for k in sorted(ctr)
         ), file=sys.stderr)
+    if ctr.get("program_launches"):
+        # launch amortization (ROOFLINE §7): at ~6ms of tunnel tax per
+        # launch, the fused scan phase's dispatch floor is launches*6ms
+        print(f"# launch amortization: {ctr['program_launches']} "
+              f"fused-scan launches x ~6ms tunnel tax, "
+              f"{ctr['splits_per_launch']} splits/launch "
+              f"(split_batch_size folds the per-split driver loop "
+              f"into XLA)", file=sys.stderr)
     print(f"# analyzed wall (incl. per-page drain overhead): {total:.2f}s")
     return 0
 
