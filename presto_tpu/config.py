@@ -154,6 +154,12 @@ def server_from_etc(etc_dir: str, port: Optional[int] = None, **kw):
         session_defaults.setdefault(
             "split_batch_size", conf["split-batch.size"]
         )
+    # device-memory.budget seeds the HBM governor's budget for every
+    # query that doesn't override it (exec/membudget.py; 0 = auto)
+    if conf.get("device-memory.budget"):
+        session_defaults.setdefault(
+            "device_memory_budget", conf["device-memory.budget"]
+        )
     return PrestoTpuServer(
         catalogs, port=port, default_catalog=default_catalog,
         memory_budget_bytes=mem, page_rows=page_rows,

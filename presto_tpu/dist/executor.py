@@ -73,6 +73,48 @@ class DistExecutor(Executor):
         self.D = int(mesh.devices.size)
         self._dist_cache: Dict[int, str] = {}
 
+    # ------------------------------------------------- memory governor
+    def _budget(self) -> int:
+        """Mesh-wide device-memory budget: the per-chip share (the
+        inherited resolution — one chip's HBM minus headroom, or the
+        session's device_memory_budget per chip) times the mesh size.
+        The dominant governed buffers — partitioned join builds,
+        repartitioned aggregation state — are sharded row-wise across
+        the mesh, so the mesh collectively holds D chips' shares.
+        Replicated (broadcast) builds are bounded separately by the
+        stats-driven broadcast decision, which uses the PER-CHIP share
+        (runner._session_dist_options -> fragmenter.broadcast_bytes)."""
+        return super()._budget() * self.D
+
+    # -------------------------------------------- collective dispatch
+    def _fenced(self, fn):
+        """Serialize collective programs on the CPU backend.
+
+        The in-process CPU runtime schedules enqueued executables by
+        DATAFLOW READINESS, not dispatch order: two in-flight programs
+        that both contain cross-device collectives can start in
+        different orders on different virtual devices — device 0 enters
+        program B's all-reduce rendezvous while devices 1..7 wait in
+        program A's, and the rendezvous aborts after its timeout
+        (MULTICHIP_r05 rc=134: TPC-DS Q17's windowed generated-join
+        `psum` interleaved with the dim-join pipeline's gathers,
+        "Expected 8 threads to join the rendezvous, but only 1
+        arrived"). Blocking on each collective program's outputs before
+        the next one can be dispatched enforces ONE consistent
+        execution order across all devices. TPU per-device queues
+        execute strictly in dispatch order, so the fence is CPU-only
+        and costs hardware nothing — the deferred-sync discipline
+        (Executor.__init__) is a TPU-runtime concern and unaffected."""
+        if jax.default_backend() != "cpu":
+            return fn
+
+        def fenced(*args):
+            out = fn(*args)
+            jax.block_until_ready(out)
+            return out
+
+        return fenced
+
     # ---------------------------------------------------------- dist tags
     def dist(self, node: P.PhysicalNode) -> str:
         # keyed by id() with the node itself retained: a bare id key goes
@@ -318,10 +360,10 @@ class DistExecutor(Executor):
 
             # check_vma=False: all_gather(tiled) output IS replicated but
             # jax's varying-axis inference cannot prove it
-            self._jit_cache[key] = jax.jit(jax.shard_map(
+            self._jit_cache[key] = self._fenced(jax.jit(jax.shard_map(
                 body, mesh=self.mesh, in_specs=(PS("d"),), out_specs=PS(),
                 check_vma=False,
-            ))
+            )))
         return self._jit_cache[key]
 
     def _key_hash(self, page: Page, keys: Tuple[int, ...]) -> jnp.ndarray:
@@ -401,10 +443,10 @@ class DistExecutor(Executor):
 
         key = ("d_repart", keys, self.D, boost)
         if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(jax.shard_map(
+            self._jit_cache[key] = self._fenced(jax.jit(jax.shard_map(
                 body, mesh=self.mesh, in_specs=(PS("d"),),
                 out_specs=(PS("d"), PS()), check_vma=False,
-            ))
+            )))
         return self._jit_cache[key]
 
     def _residue_fn(self, keys: Tuple[int, ...]):
@@ -458,10 +500,10 @@ class DistExecutor(Executor):
                     return out, jax.lax.psum(
                         ovf.astype(jnp.int32), "d") > 0
 
-                return jax.jit(jax.shard_map(
+                return self._fenced(jax.jit(jax.shard_map(
                     body, mesh=self.mesh, in_specs=(PS("d"),),
                     out_specs=(PS("d"), PS()), check_vma=False,
-            ))
+            )))
 
             for page in self.pages(node.source):
                 local_cap = min(
@@ -508,11 +550,11 @@ class DistExecutor(Executor):
                    layouts, tuple(in_types), local_caps, fcap,
                    max_iters)
             if key not in self._jit_cache:
-                self._jit_cache[key] = jax.jit(jax.shard_map(
+                self._jit_cache[key] = self._fenced(jax.jit(jax.shard_map(
                     body, mesh=self.mesh,
                     in_specs=tuple(PS("d") for _ in pages),
                     out_specs=(PS("d"), PS()), check_vma=False,
-            ))
+            )))
             out, overflow = self._jit_cache[key](*pages)
             self._pending_overflow.append(overflow)
             yield out
@@ -558,10 +600,12 @@ class DistExecutor(Executor):
 
         key = ("d_genjoin_win", node, dl)
         if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(jax.shard_map(
+            # fenced: the windowed multi-match psum is THE collective
+            # whose free interleaving deadlocked MULTICHIP_r05 (Q17)
+            self._jit_cache[key] = self._fenced(jax.jit(jax.shard_map(
                 win_body, mesh=self.mesh, in_specs=(spec,),
                 out_specs=(spec, PS()), check_vma=False,
-            ))
+            )))
         for page in self.pages(node.left):
             out, multi = self._jit_cache[key](page)
             self._pending_overflow.append(multi)
@@ -638,7 +682,7 @@ class DistExecutor(Executor):
             key = ("d_probe", node, page.capacity, build_all.capacity,
                    oc, dl, dr)
             if key not in self._jit_cache:
-                self._jit_cache[key] = jax.jit(jax.shard_map(
+                self._jit_cache[key] = self._fenced(jax.jit(jax.shard_map(
                     probe_body, mesh=self.mesh,
                     in_specs=(probe_spec, build_spec),
                     out_specs=(
@@ -646,7 +690,7 @@ class DistExecutor(Executor):
                         PS() if dr == REPLICATED else PS("d"),
                         PS(),
                     ), check_vma=False,
-            ))
+            )))
             out, matched, overflow = self._jit_cache[key](page, build_all)
             self._pending_overflow.append(overflow)
             matched_acc = (

@@ -18,7 +18,7 @@ Distributions (PartitioningHandle analogs):
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from presto_tpu.exec import plan as P
 
@@ -80,12 +80,36 @@ def add_exchanges(
     *,
     broadcast_rows: int = BROADCAST_ROWS,
     gather_capacity: int = GATHER_CAPACITY,
+    broadcast_bytes: Optional[int] = None,
+    row_bytes_of: Optional[Callable[[P.PhysicalNode], int]] = None,
 ) -> Tuple[P.PhysicalNode, str]:
     """Rewrite a single-stream physical plan into a distributed one.
 
     Returns (plan', distribution of its output). The root is always
     gathered so Output decodes a replicated page.
-    """
+
+    Broadcast-vs-partitioned: with `broadcast_bytes` + `row_bytes_of`
+    supplied (runner wires them from exact connector row counts and the
+    per-chip memory-governor share, membudget.py), the decision is
+    STATS-DRIVEN — a build side replicates only when its estimated
+    byte footprint fits one chip's broadcast share — replacing the
+    fixed `broadcast_rows` threshold (reference: the table-stats path
+    of DetermineJoinDistributionType vs its row-count fallback)."""
+
+    def build_broadcasts(n_right) -> bool:
+        rows = est_rows(n_right, catalogs)
+        if broadcast_bytes is not None and row_bytes_of is not None:
+            # byte-governed, but a replicated build is still ONE device
+            # buffer — it must also stay under the per-buffer row
+            # ceiling (shapes.SAFE_BUFFER_ROWS, the axon fault line
+            # with headroom) that the fixed row threshold used to
+            # enforce implicitly; a narrow-but-long build that fits the
+            # byte share would otherwise all_gather past the line
+            from presto_tpu.exec import shapes as SH
+
+            return (rows <= SH.SAFE_BUFFER_ROWS
+                    and rows * row_bytes_of(n_right) <= broadcast_bytes)
+        return rows <= broadcast_rows
 
     def rewrite(n) -> Tuple[P.PhysicalNode, str]:
         if isinstance(n, P.TableScan):
@@ -139,7 +163,7 @@ def add_exchanges(
                 return dataclasses.replace(
                     n, left=left, right=right), REPLICATED
             if dr == SHARDED:
-                if est_rows(n.right, catalogs) <= broadcast_rows:
+                if build_broadcasts(n.right):
                     right = P.Exchange(source=right, kind="broadcast")
                     dr = REPLICATED
                 elif dl == REPLICATED:

@@ -30,6 +30,7 @@ from presto_tpu import types as T
 from presto_tpu.connectors.base import Connector
 from presto_tpu.exec import agg_states as S
 from presto_tpu.exec import latemat as LM
+from presto_tpu.exec import membudget as MB
 from presto_tpu.exec import plan as P
 from presto_tpu.exec import prune as PR
 from presto_tpu.exec import shapes as SH
@@ -39,7 +40,12 @@ from presto_tpu.ops import hashing as H
 from presto_tpu.ops import hll as HLL
 from presto_tpu.ops import join as J
 from presto_tpu.ops import keys as K
-from presto_tpu.ops.compact import compact_page, concat_all, gather_rows
+from presto_tpu.ops.compact import (
+    compact_page,
+    concat_all,
+    gather_rows,
+    slice_page,
+)
 from presto_tpu.ops.sort import sort_page
 from presto_tpu.page import Block, Dictionary, Page
 
@@ -137,6 +143,12 @@ class _FoldBuffer:
 
     def add(self, page) -> None:
         self.saw_input = True
+        if self.buf and self.slots + page.capacity > self.flush_slots:
+            # pre-flush: the merge concat stays bounded by
+            # acc + flush_slots + one page, never creeping past it by
+            # a whole buffered batch (the governor's fold bound —
+            # membudget.py — relies on this)
+            self.flush()
         self.buf.append(page)
         self.slots += page.capacity
         if self.slots >= self.flush_slots:
@@ -356,6 +368,27 @@ class Executor:
         self.programs_compiled = 0
         self.program_cache_hits = 0
         self.compile_wall_s = 0.0
+        # Device-memory governor (session property device_memory_budget;
+        # exec/membudget.py): every buffer capacity already quantizes
+        # onto the shapes.py ladder, so a pipeline's peak live device
+        # bytes is computable BEFORE compile — and pipelines that would
+        # exceed the budget rewrite into chunked/streaming forms
+        # (grace-partition join passes, probe-side position chunking,
+        # generation-chunked scans, partitioned aggregation, PageStore
+        # host/disk overflow) instead of faulting the device. 0 = auto:
+        # real HBM minus headroom on TPU, a generous cap on CPU (tier-1
+        # behavior unchanged unless a test forces a tiny budget).
+        self.device_memory_budget = 0
+        self._budget_resolved: Optional[Tuple[int, int]] = None
+        # fault_rows: per-buffer row-capacity ceiling. None = auto
+        # (SAFE_BUFFER_ROWS on TPU — the axon >=4M-row kernel fault,
+        # with construction headroom — unlimited elsewhere); 0 = off;
+        # an int forces the ceiling (tests, the static audit).
+        self.fault_rows: Optional[int] = None
+        # memory_chunked_pipelines: governed rewrites this attempt
+        # (reset in _begin_attempt, reported in EXPLAIN ANALYZE and
+        # BENCH_DETAILS alongside peak_device_bytes)
+        self.memory_chunked_pipelines = 0
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
@@ -370,6 +403,93 @@ class Executor:
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(fn, static_argnums=static_argnums)
         return self._jit_cache[key]
+
+    # ------------------------------------------- device-memory governor
+    def _budget(self) -> int:
+        """Resolved device-memory budget in bytes (membudget.py): an
+        explicit device_memory_budget wins; auto = HBM minus headroom
+        on TPU, a generous cap on CPU. Cached per setting — resolution
+        may query device memory stats once."""
+        key = self.device_memory_budget
+        if self._budget_resolved is None or self._budget_resolved[0] != key:
+            self._budget_resolved = (key, MB.resolve_budget(key))
+        return self._budget_resolved[1]
+
+    def _fault_rows(self) -> Optional[int]:
+        """Per-buffer row-capacity ceiling for governed sizing: on TPU
+        the axon >=4M-row kernel fault line with construction headroom
+        (shapes.SAFE_BUFFER_ROWS); None elsewhere. Tests and the static
+        audit force it via self.fault_rows."""
+        if self.fault_rows is not None:
+            return self.fault_rows or None
+        return (
+            SH.SAFE_BUFFER_ROWS
+            if jax.default_backend() == "tpu" else None
+        )
+
+    def _governed_target_rows(self, types, count: bool = True,
+                              row_bytes: Optional[int] = None) -> int:
+        """Generation chunk (page) size for a scan of `types`-typed
+        columns: the configured page_rows, shrunk so ONE page buffer
+        fits its budget share — the rewrite that lets a Q1/Q6-shaped
+        pipeline stream an arbitrarily large table through fixed-size
+        resident buffers (the SF100 on-ramp). count=False lets the
+        static audit ask without bumping the rewrite counter;
+        row_bytes overrides the width (fused chains govern by their
+        WIDEST row — a generated-join chain's output page is wider
+        than its scan)."""
+        cap = MB.rows_cap(
+            row_bytes or _row_bytes(types), self._budget(),
+            self._fault_rows(), MB.SCAN_SHARE_DIV,
+        )
+        if cap is None or self.page_rows <= cap:
+            return self.page_rows
+        if count:
+            self.memory_chunked_pipelines += 1
+        return max(cap, SH.LADDER_MIN)
+
+    def _join_parts(self, node: P.HashJoin, left_types, right_types,
+                    est_build: Optional[int] = None,
+                    row_b: Optional[int] = None):
+        """Grace-partition pass count for a materialized join build:
+        the legacy session thresholds (spill_bytes byte threshold,
+        max_join_build_rows kernel ceiling) and the governor's
+        model-driven sizing — one pass's build materialization must fit
+        its budget share AND stay under the device fault line. Returns
+        (parts, governed): governed means the MODEL forced chunking
+        beyond what the thresholds asked for. Shared verbatim by the
+        static audit (membudget.audit), so prediction and execution
+        cannot drift."""
+        if not (
+            self._keys_partitionable(right_types, node.right_keys)
+            and self._keys_partitionable(left_types, node.left_keys)
+        ):
+            return 1, False
+        if est_build is None:
+            est_build = self.estimate_rows(node.right)
+        if row_b is None:
+            row_b = _row_bytes(right_types)
+        parts = 1
+        if self.spill_bytes is not None:
+            parts = self._spill_partitions(est_build * row_b)
+        if self.max_build_rows:
+            # kernel-size ceiling, independent of the byte threshold
+            parts = max(
+                parts,
+                _next_pow2(-(-est_build // self.max_build_rows)),
+            )
+        budget = self._budget()
+        # est_build * 2: a grace pass sizes its per-pass build chunks
+        # with 2x slack over the expected 1/parts occupancy (partition-
+        # hash fluctuation, _exec_join_partitioned) — the governed caps
+        # must hold for the SLACKED buffer, or a "governed" pass lands
+        # right back on the fault line
+        gparts = SH.parts_for(
+            est_build * 2, row_b,
+            rows_cap=self._fault_rows(),
+            bytes_cap=budget // MB.BUILD_SHARE_DIV if budget else None,
+        )
+        return max(parts, gparts), gparts > parts
 
     def output_types(self, node: P.PhysicalNode) -> List[T.SqlType]:
         """Static output channel types (reference: PlanNode.getOutputSymbols
@@ -586,7 +706,18 @@ class Executor:
         scan_types = tuple(schema.column_type(c) for c in names)
         dicts = getattr(conn, "_dicts", {}).get(cur.table, {})
         scan_dicts = tuple(dicts.get(c) for c in names)
-        splits = conn.splits(cur.table, self.page_rows)
+        # generation-chunked splits (membudget.py): one split's padded
+        # buffer fits its budget share AT THE CHAIN'S WIDEST ROW — a
+        # generated-join chain emits left+right columns per slot, so
+        # the output page, not the scan, is the binding width
+        chain_row_b = max(
+            _row_bytes(scan_types), _row_bytes(self.output_types(node))
+        )
+        splits = conn.splits(
+            cur.table,
+            self._governed_target_rows(scan_types,
+                                       row_bytes=chain_row_b),
+        )
         if cur.constraint:
             splits = conn.prune_splits(cur.table, splits, cur.constraint)
 
@@ -637,6 +768,8 @@ class Executor:
             return apply_steps(make_page(datas, valid, n_pad, count),
                                steps)
 
+        scan_row_b = chain_row_b
+
         def launch_one(split):
             n_pad = SH.bucket(split.row_count)
             key = ("fused", node, key_extra, cur.table, n_pad)
@@ -647,6 +780,12 @@ class Executor:
             page, flags = self._jit_cache[key](
                 jnp.int64(split.start_row),
                 jnp.int64(split.row_count),
+            )
+            # the generation buffer lives INSIDE the fused program and
+            # never passes _account_page — account it here so
+            # peak_device_bytes stays honest for fused pipelines
+            self.peak_memory_bytes = max(
+                self.peak_memory_bytes, n_pad * scan_row_b
             )
             self.program_launches += 1
             self.splits_scanned += 1
@@ -663,7 +802,8 @@ class Executor:
         if len(live) > 1:
             n_pad_all = max(SH.bucket(s.row_count) for s in live)
             bmax = self._split_batch_max(
-                n_pad_all, scanned=agg_tail is not None)
+                n_pad_all, scanned=agg_tail is not None,
+                row_bytes=chain_row_b)
         if bmax < 2:
             return stream_single()
 
@@ -794,6 +934,15 @@ class Executor:
                 self.program_launches += 1
                 self.splits_scanned += len(chunk)
                 self._pending_overflow.extend(flags)
+                # vmapped batches materialize the [B, n_pad] stack;
+                # scanned (agg-tail) batches carry one split at a time
+                live_rows = (
+                    n_pad_all if agg_tail is not None
+                    else B * n_pad_all
+                )
+                self.peak_memory_bytes = max(
+                    self.peak_memory_bytes, live_rows * scan_row_b
+                )
                 yield page
                 i += len(chunk)
 
@@ -842,7 +991,8 @@ class Executor:
                               max_iters),
         )
 
-    def _split_batch_max(self, n_pad: int, scanned: bool) -> int:
+    def _split_batch_max(self, n_pad: int, scanned: bool,
+                         row_bytes: int = 0) -> int:
         """Effective max splits per batched launch for one fused
         stream, or 0 when split batching is off. split_batch_size
         resolution: "auto" engages on TPU only (the win is the
@@ -867,6 +1017,15 @@ class Executor:
             cap = int(mode)
         if not scanned and n_pad > 0:
             cap = min(cap, SH.SPLIT_BATCH_ROWS_MAX // max(n_pad, 1))
+            # governed: the stacked [B, n_pad] batch buffer fits its
+            # budget share too (membudget.py), not just the row line
+            budget = self._budget()
+            if budget and row_bytes:
+                cap = min(
+                    cap,
+                    max((budget // MB.SCAN_SHARE_DIV)
+                        // (n_pad * row_bytes), 1),
+                )
         if cap < 2:
             return 0
         return 1 << (cap.bit_length() - 1)
@@ -879,8 +1038,14 @@ class Executor:
                 return
         if isinstance(node, P.TableScan):
             conn = self.catalogs[node.catalog]
+            # generation-chunked scan (membudget.py): page size shrinks
+            # so one generated buffer fits its budget share — the same
+            # stream shape, smaller resident chunks
             yield from conn.pages(
-                node.table, node.columns, target_rows=self.page_rows,
+                node.table, node.columns,
+                target_rows=self._governed_target_rows(
+                    self.output_types(node)
+                ),
                 constraint=node.constraint,
             )
             return
@@ -1157,6 +1322,7 @@ class Executor:
         self.fused_partial_aggs = 0
         self.program_launches = 0
         self.splits_scanned = 0
+        self.memory_chunked_pipelines = 0
 
     def _overflow_flagged(self) -> bool:
         """OR-reduce the attempt's deferred overflow flags — the ONE
@@ -1277,6 +1443,11 @@ class Executor:
             "programs_compiled": self.programs_compiled,
             "program_cache_hits": self.program_cache_hits,
             "compile_wall_s": self.compile_wall_s,
+            # device-memory governor (membudget.py): the attempt's
+            # largest single device buffer and how many pipelines the
+            # governor rewrote into chunked/streaming form
+            "peak_device_bytes": self.peak_memory_bytes,
+            "memory_chunked_pipelines": self.memory_chunked_pipelines,
         }
         return names, rows, stats
 
@@ -1442,15 +1613,20 @@ class Executor:
             return
 
         parts = 1
-        src_types = (
-            self.output_types(node.source)
-            if self.spill_bytes is not None else None
-        )
-        if src_types is not None and self._keys_partitionable(
+        src_types = self.output_types(node.source)
+        can_partition = self._keys_partitionable(
             src_types, node.group_channels
-        ):
+        )
+        if can_partition:
             est_rows = self.estimate_rows(node.source)
-            cap_est = _next_pow2(max(node.capacity, 8))
+            # boost-scaled: a fold-overflow retry (true cardinality
+            # past the planner estimate AND the governed fold cap,
+            # which is pinned under the fault line and cannot grow)
+            # must eventually cross INTO the partitioned path — the
+            # single path's only remaining escape
+            cap_est = _next_pow2(
+                max(node.capacity, 8) * self._capacity_boost
+            )
             n_pages = max(-(-est_rows // max(self.page_rows, 1)), 1)
             state_types = [src_types[c] for c in node.group_channels]
             for spec, in_t in zip(node.aggregates, in_types):
@@ -1458,9 +1634,34 @@ class Executor:
                     st.type for st in S.state_layout(spec.function, in_t)
                 )
             merged_slots = min(est_rows, n_pages * cap_est)
-            parts = self._spill_partitions(
-                merged_slots * _row_bytes(state_types)
+            if self._capacity_boost > 1:
+                # a boosted retry is EVIDENCE the estimates are low
+                # (something overflowed at the previous capacities):
+                # stop letting an under-estimated est_rows cap the
+                # partition decision, or the boost ladder can climb
+                # forever without the escape ever engaging
+                merged_slots = max(merged_slots, cap_est)
+            state_row_b = _row_bytes(state_types)
+            if self.spill_bytes is not None:
+                parts = self._spill_partitions(merged_slots * state_row_b)
+            # governed (membudget.py): aggregation state must fit its
+            # budget share regardless of the spill threshold — over
+            # budget, the aggregation runs in hash-partition passes.
+            # rows_cap = the single path's governed FOLD cap (fr>>2,
+            # see fold_cap below), not the raw fault line: a state the
+            # fold can never hold must partition, or boosted retries
+            # would never converge
+            budget = self._budget()
+            fr = self._fault_rows()
+            gparts = SH.parts_for(
+                merged_slots, state_row_b,
+                rows_cap=max(fr >> 2, 8192) if fr else None,
+                bytes_cap=(budget // MB.BUILD_SHARE_DIV
+                           if budget else None),
             )
+            if gparts > parts:
+                parts = gparts
+                self.memory_chunked_pipelines += 1
         if parts > 1:
             yield from self._exec_agg_partitioned(
                 node, parts, in_types, layouts
@@ -1504,6 +1705,16 @@ class Executor:
         # group-bys overflow onto the boosted-retry ladder (and, when
         # spill is on, onto partitioned passes).
         fold_cap = min(cap, _next_pow2((1 << 20) * self._capacity_boost))
+        fr = self._fault_rows()
+        if fr and can_partition:
+            # governed: acc + flush batch + one page stays under the
+            # device fault line even at full boost — safe to PIN only
+            # because true high-cardinality states have an escape (the
+            # boost-scaled partitioned path above). Non-partitionable
+            # keys (strings) have no such rewrite: they keep the
+            # legacy boost-growing cap, same exposure as before the
+            # governor, rather than a pin that can never converge
+            fold_cap = min(fold_cap, max(fr >> 2, 8192))
         merge_fn = self._jit(
             ("agg_merge", node.aggregates,
              tuple(tuple(l) for l in layouts),
@@ -1958,12 +2169,27 @@ class Executor:
             est = self.estimate_rows(node) * _row_bytes(
                 self.output_types(node)
             )
+            budget = self._budget()
+            store_share = (
+                budget // MB.STORE_SHARE_DIV if budget else None
+            )
             if (self.disk_spill_bytes is not None
                     and est > self.disk_spill_bytes):
                 tier = "disk"
             elif (self.host_spill_bytes is not None
                     and est > self.host_spill_bytes):
                 tier = "host"
+            elif store_share is not None and est > store_share:
+                # governed overflow home (membudget.py): an
+                # intermediate that cannot stay HBM-resident under the
+                # budget stages to host RAM — and past several budgets'
+                # worth, to the pagestore disk tier — even when no
+                # explicit spill threshold was configured
+                tier = (
+                    "disk"
+                    if est > max(budget * 4, MB.CPU_BUDGET) else "host"
+                )
+                self.memory_chunked_pipelines += 1
             else:
                 tier = "device"
             store = PageStore(tier, spill_dir=self.spill_path)
@@ -2166,22 +2392,12 @@ class Executor:
             self._scan_column_unique(node.right, k)
             for k in node.right_keys
         )
-        parts = 1
-        if self._keys_partitionable(
-            right_types, node.right_keys
-        ) and self._keys_partitionable(left_types, node.left_keys):
-            est_build = self.estimate_rows(node.right)
-            if self.spill_bytes is not None:
-                parts = self._spill_partitions(
-                    est_build * _row_bytes(right_types)
-                )
-            if self.max_build_rows:
-                # kernel-size ceiling, independent of the byte threshold
-                parts = max(
-                    parts,
-                    _next_pow2(-(-est_build // self.max_build_rows)),
-                )
+        parts, governed = self._join_parts(node, left_types, right_types)
         if parts > 1:
+            if governed:
+                # the GOVERNOR (not a session threshold) rewrote this
+                # join into grace-partition passes sized to fit
+                self.memory_chunked_pipelines += 1
             yield from self._exec_join_partitioned(
                 node, parts, left_types, right_types, unique_build
             )
@@ -2668,6 +2884,18 @@ class Executor:
 
         build_matched = jnp.zeros((build.capacity,), dtype=jnp.bool_)
         n_right = len(build.blocks)
+        # governed output-capacity ceiling (membudget.py): a join
+        # output page claims at most its budget share and stays under
+        # the device fault line; a page whose naturally-sized output
+        # would exceed it is position-chunked below
+        out_row_b = _row_bytes(left_types) + _row_bytes(
+            [b.type for b in build.blocks]
+        )
+        oc_cap = MB.rows_cap(
+            out_row_b, self._budget(), self._fault_rows(),
+            MB.PAGE_SHARE_DIV,
+        )
+        chunk_counted = False
         # canonical key encodings depend on the probe page's dictionaries
         # (merged-universe remap), which can differ across pages when the
         # probe side unions differently-coded streams — index per
@@ -2731,36 +2959,72 @@ class Executor:
                 # partition-hash fluctuation without a boosted retry
                 oc = max(oc * 2 // density, 8192)
             oc = _next_pow2(max(oc, 8192) * self._capacity_boost)
+            slices = 1
+            if oc_cap is not None and oc > oc_cap:
+                # probe-side POSITION chunking (the governed rewrite):
+                # slice the probe page so each slice keeps the full
+                # per-probe-row output allowance inside a cap-sized
+                # buffer. Boosted retries grow `oc`, hence the slice
+                # count — capacity per probe row still climbs the
+                # ladder while the buffer stays at the cap (except the
+                # pathological tiny-probe/huge-fan-out corner, where
+                # the LADDER_MIN slice floor binds and oc keeps the
+                # allowance instead — slots must exist somewhere).
+                # Both factors are powers of two, so slice shapes land
+                # on the shared ladder and chunk programs are reused.
+                slices = min(
+                    oc // oc_cap,
+                    max(page.capacity // SH.LADDER_MIN, 1),
+                )
+                oc = max(oc // slices, oc_cap)
+                if slices > 1 and not chunk_counted:
+                    # counted only when chunking actually happens (the
+                    # LADDER_MIN floor can pin slices at 1, in which
+                    # case oc simply keeps the allowance)
+                    self.memory_chunked_pipelines += 1
+                    chunk_counted = True
             defer_item = defer_allowed and (
                 defer == "always" or lz is not None
             )
-            out, matched, overflow = probe_fn_for(pkeys, defer_item)(
-                page, build, index, oc
-            )
-            self._pending_overflow.append(overflow)
-            build_matched = build_matched | matched
-            if defer_item:
-                width_l = lz.width if lz is not None else (
-                    page.channel_count
+            pfn = probe_fn_for(pkeys, defer_item)
+            # ceil-divide: a concat-produced probe page's capacity is a
+            # SUM of buckets and need not be a multiple of the slice
+            # count — floor division would silently drop the tail rows.
+            # slice_page clamps the final slice; recomputing the slice
+            # count from the ceil'd chunk keeps every chunk non-empty.
+            ccap = -(-page.capacity // max(slices, 1))
+            n_slices = -(-page.capacity // max(ccap, 1))
+            for s in range(n_slices):
+                chunk = (
+                    page if n_slices == 1
+                    else slice_page(page, s * ccap, ccap)
                 )
-                mat = lz.mat if lz is not None else tuple(
-                    range(page.channel_count)
-                )
-                sides = (lz.sides if lz is not None else ()) + (
-                    LM.LazySide(
-                        build,
-                        tuple((width_l + j, j) for j in range(n_right)),
-                    ),
-                )
-                self.gathers_deferred += sum(
-                    len(s.channel_map) for s in sides
-                )
-                yield LM.LazyPage(
-                    reduced=out, width=width_l + n_right, mat=mat,
-                    sides=sides,
-                )
-            else:
-                yield out
+                out, matched, overflow = pfn(chunk, build, index, oc)
+                self._pending_overflow.append(overflow)
+                build_matched = build_matched | matched
+                if defer_item:
+                    width_l = lz.width if lz is not None else (
+                        page.channel_count
+                    )
+                    mat = lz.mat if lz is not None else tuple(
+                        range(page.channel_count)
+                    )
+                    sides = (lz.sides if lz is not None else ()) + (
+                        LM.LazySide(
+                            build,
+                            tuple((width_l + j, j)
+                                  for j in range(n_right)),
+                        ),
+                    )
+                    self.gathers_deferred += sum(
+                        len(s.channel_map) for s in sides
+                    )
+                    yield LM.LazyPage(
+                        reduced=out, width=width_l + n_right, mat=mat,
+                        sides=sides,
+                    )
+                else:
+                    yield out
         if node.join_type in ("right", "full"):
             # emit unmatched build rows with null left side (reference:
             # LookupOuterOperator draining unvisited positions)

@@ -27,6 +27,11 @@ The TPU translation has two tiers:
 
 Stores are owned by the Executor per query attempt (capacity-boost
 retries invalidate them — cached pages may embed overflowed results).
+Tier selection is governed: beyond the explicit host/disk spill
+thresholds, the device-memory budget (exec/membudget.py) routes any
+materialization that cannot stay HBM-resident to the host tier, and
+past several budgets' worth to the disk tier — the overflow home that
+lets SF100-scale partitioned state exceed both HBM and host RAM.
 
 Shape contract (exec/shapes.py): stores preserve page shapes exactly
 across tiers — a restreamed page re-enters the very programs its

@@ -67,6 +67,44 @@ def chunk_bucket(total: int, parts: int, floor: int = 1024) -> int:
     return bucket(max(total // max(parts, 1) * 2, floor))
 
 
+# ------------------------------------------------ device-memory model
+# The axon XLA:TPU runtime faults kernels touching >=~4M-row buffers
+# (bisected round 4; the reason max_join_build_rows and
+# SPLIT_BATCH_ROWS_MAX exist). The memory governor (exec/membudget.py)
+# keeps every PLANNED buffer capacity under this line by construction.
+DEVICE_FAULT_ROWS = 1 << 22
+
+# Construction headroom under the fault line: governed buffers size to
+# at most half of it, so one boosted-retry rung (x4 capped by the
+# governor's own chunking) cannot land exactly ON the line.
+SAFE_BUFFER_ROWS = DEVICE_FAULT_ROWS >> 1
+
+
+def buffer_bytes(rows: int, row_bytes: int) -> int:
+    """Static footprint of one operator buffer sized for `rows`: the
+    capacity quantizes to the ladder first (that IS the allocation the
+    executor makes), so the byte model predicts real allocations, not
+    raw row counts."""
+    return bucket(rows) * max(int(row_bytes), 1)
+
+
+def parts_for(rows: int, row_bytes: int, rows_cap, bytes_cap,
+              max_parts: int = 256) -> int:
+    """Grace-partition pass count that keeps ONE pass's materialization
+    of `rows` x `row_bytes` under both caps (None = unconstrained).
+    Power of two so partition passes land on the shared ladder."""
+    need = 1
+    b = bucket(rows)
+    if rows_cap:
+        need = max(need, -(-b // int(rows_cap)))
+    if bytes_cap:
+        per_row = max(int(row_bytes), 1)
+        need = max(need, -(-(b * per_row) // int(bytes_cap)))
+    if need <= 1:
+        return 1
+    return min(bucket(need, floor=2), max_parts)
+
+
 # --------------------------------------------------- split batching
 # Split-batched execution (exec/executor._fused_stream): how many
 # splits of a fused scan pipeline fold into ONE XLA program launch.
@@ -81,7 +119,7 @@ SPLIT_BATCH_MAX = 64
 # >=4M-row kernel fault line (the same ceiling max_join_build_rows
 # exists for). The lax.scan paths carry one split at a time and are
 # exempt.
-SPLIT_BATCH_ROWS_MAX = 1 << 22
+SPLIT_BATCH_ROWS_MAX = DEVICE_FAULT_ROWS
 
 
 def split_batch_bucket(n: int) -> int:

@@ -135,6 +135,24 @@ class LocalRunner:
                 opts["broadcast_rows"] = self.session.get(
                     "broadcast_join_rows"
                 )
+                if not self.session.is_set("broadcast_join_rows"):
+                    # stats-driven broadcast-vs-partitioned (membudget
+                    # + exact connector row counts): a build replicates
+                    # only when its byte footprint fits one chip's
+                    # broadcast share. Engages only when nothing pinned
+                    # an explicit row threshold (constructor
+                    # dist_options or SET SESSION always win).
+                    from presto_tpu.exec import membudget as MB
+                    from presto_tpu.exec.executor import _row_bytes
+
+                    ex = self.executor
+                    per_chip = ex._budget() // getattr(ex, "D", 1)
+                    opts["broadcast_bytes"] = (
+                        per_chip // MB.PAGE_SHARE_DIV
+                    )
+                    opts["row_bytes_of"] = lambda n: _row_bytes(
+                        ex.output_types(n)
+                    )
         if "gather_capacity" not in opts:
             opts["gather_capacity"] = self.session.get(
                 "agg_gather_capacity"
@@ -190,6 +208,9 @@ class LocalRunner:
         )
         ex.max_build_rows = (
             int(self.session.get("max_join_build_rows")) or None
+        )
+        ex.device_memory_budget = int(
+            self.session.get("device_memory_budget")
         )
         pj = self.session.get("pallas_join_enabled")
         ex.pallas_join = {"auto": "auto", "true": "force",

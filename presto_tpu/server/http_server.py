@@ -272,7 +272,7 @@ class QueryManager:
             )
         )
 
-    def metrics_text(self, uptime: float) -> str:
+    def metrics_text(self, uptime: float, executor=None) -> str:
         """Prometheus text exposition (reference role: JMX beans +
         presto-jmx; a /metrics scrape replaces the MBean server)."""
         lines = [
@@ -297,6 +297,20 @@ class QueryManager:
                 "# TYPE presto_tpu_query_wall_ms_total counter",
                 f"presto_tpu_query_wall_ms_total "
                 f"{self.query_wall_ms_total}",
+            ]
+        if executor is not None:
+            # device-memory governor (exec/membudget.py): resolved
+            # budget plus the last attempt's peak and rewrite count
+            lines += [
+                "# TYPE presto_tpu_device_memory_budget_bytes gauge",
+                f"presto_tpu_device_memory_budget_bytes "
+                f"{executor._budget()}",
+                "# TYPE presto_tpu_peak_device_bytes gauge",
+                f"presto_tpu_peak_device_bytes "
+                f"{executor.peak_memory_bytes}",
+                "# TYPE presto_tpu_memory_chunked_pipelines gauge",
+                f"presto_tpu_memory_chunked_pipelines "
+                f"{executor.memory_chunked_pipelines}",
             ]
         return "\n".join(lines) + "\n"
 
@@ -490,7 +504,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if parts == ["metrics"]:
             body = self.app.manager.metrics_text(
-                time.time() - self.app.started
+                time.time() - self.app.started,
+                executor=self.app._runner.executor,
             ).encode()
             self.send_response(200)
             self.send_header("Content-Type",
@@ -696,6 +711,14 @@ class PrestoTpuServer:
                 by_state = dict(mgr.completed_by_state)
             for state, n in sorted(by_state.items()):
                 out.append((f"queries_completed_{state.lower()}", n))
+            # device-memory governor (exec/membudget.py): the serial
+            # runner's resolved budget and last-attempt peak — the
+            # fleet-visible half of the peak_device_bytes contract
+            ex = self._runner.executor
+            out.append(("device_memory_budget_bytes", ex._budget()))
+            out.append(("peak_device_bytes", ex.peak_memory_bytes))
+            out.append(("memory_chunked_pipelines",
+                        ex.memory_chunked_pipelines))
             return out
 
         sys_conn.register(

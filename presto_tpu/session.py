@@ -235,6 +235,20 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             str, "",
         ),
         PropertyMetadata(
+            "device_memory_budget",
+            "device-memory budget in bytes for the HBM governor "
+            "(exec/membudget.py): pipelines whose planned peak device "
+            "footprint exceeds their budget share rewrite into "
+            "chunked/streaming form (grace-partition join passes, "
+            "probe-side position chunking, generation-chunked scans, "
+            "partitioned aggregation, PageStore host/disk overflow) "
+            "before anything launches. 0 = auto: real HBM minus "
+            "headroom on TPU, a generous cap on CPU. Observability: "
+            "peak_device_bytes / memory_chunked_pipelines counters in "
+            "EXPLAIN ANALYZE",
+            int, 0,
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

@@ -1160,14 +1160,22 @@ class Planner:
         "Best" = SAFE joins first — build keys that include a provably
         unique column of the build unit guarantee <=1 match per probe
         row, so the join can never expand the probe — then smallest
-        estimated size. Without the safety term, a small-but-non-unique
-        build (TPC-H Q5's customer joined on c_nationkey: 25 distinct
-        values) fans out catastrophically at scale even though it looks
-        cheapest."""
+        estimated BYTE footprint (exact generator/table row counts x
+        static row width, the same stats the memory governor sizes
+        buffers with — a narrow-but-long table no longer beats a
+        wide-but-short one for the build side). Without the safety
+        term, a small-but-non-unique build (TPC-H Q5's customer joined
+        on c_nationkey: 25 distinct values) fans out catastrophically
+        at scale even though it looks cheapest."""
+        from presto_tpu.exec.executor import _row_bytes
+
         n = len(units)
         if n == 1:
             return units[0], {0: 0}
-        est = [self.estimate(u.node) for u in units]
+        est = [
+            self.estimate(u.node) * _row_bytes([f.type for f in u.fields])
+            for u in units
+        ]
         uniq = [self._unit_unique_channels(u) for u in units]
         start = max(range(n), key=lambda i: est[i])
         placed = {start: 0}

@@ -50,6 +50,18 @@ def main() -> int:
               f"{ctr['splits_per_launch']} splits/launch "
               f"(split_batch_size folds the per-split driver loop "
               f"into XLA)", file=sys.stderr)
+    # memory governor (ROOFLINE §8): measured largest buffer vs the
+    # static model's prediction for the same plan
+    from presto_tpu.exec import membudget as MB
+
+    report = MB.audit(ex, plan)
+    print(f"# hbm governor: peak_device_bytes="
+          f"{ctr.get('peak_device_bytes', 0)} "
+          f"(model max {report.max_buffer_bytes}, "
+          f"pipeline peak {report.peak_bytes}), "
+          f"memory_chunked_pipelines="
+          f"{ctr.get('memory_chunked_pipelines', 0)} "
+          f"(model planned {report.chunked_count})", file=sys.stderr)
     print(f"# analyzed wall (incl. per-page drain overhead): {total:.2f}s")
     return 0
 

@@ -30,6 +30,10 @@ def render() -> str:
             steady = f"— ({(r.get('time_error') or '?')[:40]})"
         rows = r.get("result_rows", "—")
         valid = "yes" if r.get("valid") else "no"
+        boost = r.get("capacity_boost", 0)
+        if valid == "yes" and boost > 1:
+            # honest-but-boosted: timed at the settled capacity rung
+            valid = f"yes (boost {boost})"
         sp = r.get("speedup_vs_sqlite")
         sp = f"{sp}x" if sp else "—"
         lines.append(f"| {name} | {steady} | {rows} | {valid} | {sp} |")
