@@ -499,6 +499,10 @@ def group_child(only_names) -> int:
                 # device buffer this run + governed chunked rewrites
                 "peak_device_bytes": ex.peak_memory_bytes,
                 "memory_chunked_pipelines": ex.memory_chunked_pipelines,
+                # fault tolerance: >0 means this rung survived a real
+                # (or injected) device fault via the OOM-degradation
+                # ladder — a slow correct rung, not a crashed one
+                "device_oom_retries": ex.device_oom_retries,
             }
 
         # ---- first (warm-up) run doubles as the BOOST-SETTLE loop:

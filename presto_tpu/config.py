@@ -160,6 +160,17 @@ def server_from_etc(etc_dir: str, port: Optional[int] = None, **kw):
         session_defaults.setdefault(
             "device_memory_budget", conf["device-memory.budget"]
         )
+    # fault-tolerance tier defaults (ISSUE 5): task-retry.attempts /
+    # task-retry.backoff-ms govern DCN task re-dispatch and the
+    # executor's device-OOM degradation ladder; query.max-run-time-ms
+    # is the fleet-wide query deadline (reference: query.max-run-time)
+    for etc_key, prop in (
+        ("task-retry.attempts", "task_retry_attempts"),
+        ("task-retry.backoff-ms", "retry_backoff_ms"),
+        ("query.max-run-time-ms", "query_max_run_time"),
+    ):
+        if conf.get(etc_key):
+            session_defaults.setdefault(prop, conf[etc_key])
     return PrestoTpuServer(
         catalogs, port=port, default_catalog=default_catalog,
         memory_budget_bytes=mem, page_rows=page_rows,

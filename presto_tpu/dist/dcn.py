@@ -26,15 +26,35 @@ PlanFragment); workers execute exactly that tree, never re-planning.
 Scans split round-robin or hash-co-partitioned on join keys (both big
 join sides 1/N per worker; hash_fanout_source).
 
-Failure model matches the reference: a worker death or exhausted fetch
-retries fails the QUERY cleanly (no task-level recovery; SURVEY §6.3),
-while the heartbeat detector tracks liveness for scheduling decisions.
+Failure model: FAULT-TOLERANT task retry (reference: Project
+Tardigrade's task-level retry, "A Decade of SQL Analytics at Meta"
+VLDB 2023), made cheap by deterministic generation — a dead worker's
+fragment re-dispatches to a surviving ALIVE worker carrying the SAME
+split assignment, the survivor re-generates that split share at the
+scan (gen_at/key_inverse SPI + connectors/split_filter.py), and pages
+the coordinator already consumed dedupe by fetch token — the new
+placement's regenerated prefix is VERIFIED byte-identical (rolling
+sha256) before the fetch resumes at the consumed token, so delivery
+stays effectively exactly-once and a non-deterministic sequence fails
+loudly instead of silently — no spooled shuffle tier required.
+Governed by session properties: `task_retry_attempts` re-dispatches per
+task (0 pins the classic fail-query-cleanly model), `retry_backoff_ms`
+seeds the exponential-backoff-with-jitter ladder between retries, and
+`query_max_run_time` is a hard wall-clock deadline enforced in the
+fetch loop and at executor page boundaries (QueryDeadlineExceeded).
+The heartbeat detector is consulted BEFORE task submit so FAILED nodes
+are never picked, and every recovery action is observable: the
+coordinator executor's `task_retries` / `workers_excluded` counters
+(EXPLAIN ANALYZE, /metrics, system.metrics) and `TaskRetryEvent` on
+the EventListener SPI.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import random
 import time
 import urllib.error
 import urllib.request
@@ -43,6 +63,7 @@ from typing import Dict, List, Optional
 
 from presto_tpu.dist import plan_serde, serde
 from presto_tpu.exec import plan as P
+from presto_tpu.exec.executor import QueryDeadlineExceeded
 from presto_tpu.server.heartbeat import HeartbeatFailureDetector
 from presto_tpu.server.worker import (
     fanout_safe,
@@ -55,8 +76,45 @@ from presto_tpu.server.worker import (
 
 
 class DcnQueryFailed(RuntimeError):
-    """Query-level failure (reference: the fail-query-and-let-the-
-    client-retry model — no task-level recovery)."""
+    """Query-level failure: task retries exhausted / no survivors (or,
+    with task_retry_attempts=0, the classic fail-query-and-let-the-
+    client-retry model with no task-level recovery)."""
+
+
+class _TaskLost(RuntimeError):
+    """Internal: one task placement is gone (submit failure, exhausted
+    fetch retries, or — with task_error=True — a deterministic
+    worker-side task failure) — the recovery path decides whether to
+    re-dispatch or fail the query."""
+
+    def __init__(self, msg: str, task_error: bool = False):
+        super().__init__(msg)
+        # True when the TASK failed on a healthy worker (the fragment
+        # raised; X-Task-Error from the results endpoint): re-dispatch
+        # is still attempted (the fault may be environmental) but the
+        # node is NOT excluded — workers_excluded counts node loss only
+        self.task_error = task_error
+
+
+@dataclasses.dataclass
+class _TaskState:
+    """One logical task (= one split share of the fragment) and its
+    current placement. `next_token` is the count of pages the
+    coordinator has consumed and `hasher` a rolling sha256 of their
+    serialized bytes — a re-dispatched task resumes fetching at
+    next_token AFTER the new placement's regenerated prefix is verified
+    byte-identical to what was consumed (deterministic generation makes
+    that the common case; a mismatch — e.g. the survivor's device-OOM
+    ladder re-chunked its page boundaries — fails the query loudly
+    instead of silently skipping/duplicating rows)."""
+
+    uri: str
+    task_id: str
+    payload: Dict
+    next_token: int = 0
+    retries_used: int = 0
+    hasher: "hashlib._Hash" = dataclasses.field(
+        default_factory=lambda: hashlib.sha256())
 
 
 def _replace_node(root, target, repl):
@@ -91,7 +149,8 @@ class DcnRunner:
                  page_rows: int = 1 << 16,
                  fetch_retries: int = 3,
                  session_props: Optional[Dict] = None,
-                 partition_threshold: int = 1 << 17):
+                 partition_threshold: int = 1 << 17,
+                 listeners=()):
         from presto_tpu.runner import LocalRunner
         from presto_tpu.session import Session
 
@@ -101,7 +160,19 @@ class DcnRunner:
         # introspection: distribution used by the last execute()
         # ("hash" partitioned join | "roundrobin" | "local")
         self.last_distribution = "local"
+        # workers the last execute() actually submitted to (the
+        # heartbeat-gated pool; FAILED nodes are never picked)
+        self.last_pool: List[str] = []
         self.session_props = dict(session_props or {})
+        self.listeners = list(listeners)
+        # fault-tolerance bookkeeping: nodes excluded after a mid-query
+        # failure (re-admitted only on a fresh successful ping — a
+        # rebooted worker on the same uri rejoins between queries, the
+        # reference's node-rejoin model); DELETE-release skips on dead
+        # workers (the scoped except path, counted not swallowed)
+        self._excluded: set = set()
+        self.release_skips = 0
+        self._rng = random.Random()
         cat = default_catalog or next(iter(catalogs))
         self.runner = LocalRunner(
             catalogs,
@@ -113,6 +184,28 @@ class DcnRunner:
         self.heartbeat = HeartbeatFailureDetector(
             [f"{u}" for u in self.worker_uris]
         )
+        # background detector: dead-node connect timeouts are paid on
+        # the daemon thread, never on the query path (the submit gate
+        # reads CACHED state; reference: NodeScheduler consulting an
+        # async failure detector)
+        self.heartbeat.start()
+        # per-node rate limit for synchronous re-admission probes of
+        # excluded nodes (a still-dead node costs its connect timeout
+        # at most once per heartbeat interval, not per query)
+        self._probe_at: Dict[str, float] = {}
+
+    def close(self) -> None:
+        """Stop the background heartbeat thread. DcnRunner owns it, so
+        long-lived embedders (and the chaos harness) can shut it down
+        instead of leaking a pinging daemon per runner."""
+        self.heartbeat.stop()
+
+    # ------------------------------------------------- session-prop knobs
+    def _retry_attempts(self) -> int:
+        return int(self.runner.session.get("task_retry_attempts"))
+
+    def _backoff_ms(self) -> int:
+        return int(self.runner.session.get("retry_backoff_ms"))
 
     # --------------------------------------------------------- protocol
     def _post_task(self, uri: str, payload: Dict) -> Dict:
@@ -124,17 +217,61 @@ class DcnRunner:
         with urllib.request.urlopen(req, timeout=30) as resp:
             return json.loads(resp.read().decode())
 
-    def _fetch_pages(self, uri: str, task_id: str):
-        """Token-acked page fetch with bounded retries (the
+    @staticmethod
+    def _raise_if_task_error(e: BaseException, uri: str,
+                             task_id: str) -> None:
+        """X-Task-Error on a results response marks a DETERMINISTIC
+        task failure on a healthy worker: surface the real error text
+        at once instead of spinning fetch retries against a dead
+        task."""
+        if isinstance(e, urllib.error.HTTPError) and \
+                e.headers.get("X-Task-Error"):
+            try:
+                msg = json.loads(e.read().decode()).get("error", "")
+            except (ValueError, OSError):
+                msg = ""
+            raise _TaskLost(
+                f"task {task_id} FAILED on worker {uri}: {msg or e}",
+                task_error=True,
+            ) from e
+
+    @staticmethod
+    def _check_deadline(deadline: Optional[float]) -> None:
+        if deadline is not None and time.monotonic() > deadline:
+            raise QueryDeadlineExceeded(
+                "query exceeded query_max_run_time in the DCN fetch "
+                "loop"
+            )
+
+    def _sleep_backoff(self, attempt: int,
+                       deadline: Optional[float]) -> None:
+        """Exponential backoff with jitter between retries (reference:
+        HttpPageBufferClient's backoff; jitter de-synchronizes N
+        coordinators hammering one recovering worker). Never sleeps
+        past the query deadline."""
+        base = self._backoff_ms() / 1000.0
+        delay = min(base * (2 ** max(attempt - 1, 0)), 5.0)
+        delay *= 0.5 + self._rng.random()  # jitter: [0.5x, 1.5x)
+        if deadline is not None:
+            delay = min(delay, max(deadline - time.monotonic(), 0.0))
+        if delay > 0:
+            time.sleep(delay)
+
+    def _fetch_pages(self, st: _TaskState,
+                     deadline: Optional[float]):
+        """Token-acked page fetch with bounded, backed-off retries (the
         HttpPageBufferClient protocol: at-least-once + dedupe by
-        token)."""
-        token = 0
+        token). Starts at st.next_token — a re-dispatched task resumes
+        where the dead worker left off. Raises _TaskLost when this
+        placement is unreachable; the caller decides recovery."""
         while True:
             attempt = 0
             while True:
+                self._check_deadline(deadline)
                 try:
                     req = urllib.request.Request(
-                        f"{uri}/v1/task/{task_id}/results/{token}"
+                        f"{st.uri}/v1/task/{st.task_id}/results/"
+                        f"{st.next_token}"
                     )
                     with urllib.request.urlopen(req, timeout=60) as r:
                         if r.status == 204:
@@ -142,24 +279,181 @@ class DcnRunner:
                                 return
                             break  # long-poll timeout; re-ask
                         body = r.read()
-                        token = int(r.headers["X-Next-Token"])
-                        yield serde.deserialize_page(body)
+                        nxt = int(r.headers["X-Next-Token"])
+                        page = serde.deserialize_page(body)
+                        st.hasher.update(body)
+                        st.next_token = nxt
+                        yield page
                         break
                 except (urllib.error.URLError, urllib.error.HTTPError,
                         ConnectionError, OSError) as e:
+                    self._raise_if_task_error(e, st.uri, st.task_id)
                     attempt += 1
                     if attempt > self.fetch_retries:
-                        raise DcnQueryFailed(
-                            f"worker {uri} task {task_id}: page fetch "
-                            f"failed after {self.fetch_retries} "
+                        raise _TaskLost(
+                            f"worker {st.uri} task {st.task_id}: page "
+                            f"fetch failed after {self.fetch_retries} "
                             f"retries: {e}"
                         ) from e
-                    time.sleep(0.1 * attempt)
+                    self._sleep_backoff(attempt, deadline)
+
+    def _prefix_matches(self, uri: str, task_id: str, st: _TaskState,
+                        deadline: Optional[float]) -> bool:
+        """Verify a re-dispatched task regenerated the already-consumed
+        page prefix byte-for-byte before resuming at st.next_token —
+        dedupe-by-token is only sound for identical sequences.
+        Deterministic generation makes a match the common case; re-
+        fetching the prefix is cheap (workers buffer the full page
+        list). Raises _TaskLost(task_error=True) if the new task
+        failed; lets transport errors through after bounded retries so
+        the recovery loop excludes this placement too."""
+        h = hashlib.sha256()
+        token = 0
+        attempt = 0
+        while token < st.next_token:
+            self._check_deadline(deadline)
+            try:
+                req = urllib.request.Request(
+                    f"{uri}/v1/task/{task_id}/results/{token}")
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    if r.status == 204:
+                        if r.headers.get("X-Done") == "1":
+                            return False  # fewer pages than consumed
+                        continue  # long-poll timeout; re-ask
+                    h.update(r.read())
+                    token = int(r.headers["X-Next-Token"])
+                    attempt = 0
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                self._raise_if_task_error(e, uri, task_id)
+                attempt += 1
+                if attempt > self.fetch_retries:
+                    raise
+                self._sleep_backoff(attempt, deadline)
+        return h.hexdigest() == st.hasher.hexdigest()
+
+    # ------------------------------------------------------- fault model
+    def _exclude(self, uri: str) -> None:
+        if uri not in self._excluded:
+            self._excluded.add(uri)
+            self.runner.executor.workers_excluded += 1
+
+    def _alive_for_submit(self) -> List[str]:
+        """The heartbeat-gated worker pool for this query: nodes the
+        detector marks FAILED are never picked (reference:
+        NodeScheduler consulting the failure detector). State is read
+        from the BACKGROUND detector's cache — no pings on the query
+        path except re-admission probes of excluded nodes (a fresh
+        successful, recorded probe lets a worker rebooted on the same
+        uri rejoin between queries), and those are rate-limited per
+        node so a still-dead node costs its connect timeout at most
+        once per heartbeat interval. A node that died since the last
+        heartbeat tick is caught by the submit-failure recovery path."""
+        pool = []
+        now = time.monotonic()
+        for u in self.worker_uris:
+            if u in self._excluded:
+                # excluded nodes are probed REGARDLESS of cached state:
+                # a worker killed mid-query is usually FAILED in the
+                # cache too, and a reboot on the same uri must be able
+                # to rejoin before the background loop's next tick
+                last = self._probe_at.get(u)
+                if last is not None and \
+                        now - last < self.heartbeat.interval_s:
+                    continue  # probed recently and still excluded
+                self._probe_at[u] = now
+                if not self.heartbeat.probe(u):
+                    continue
+                self._excluded.discard(u)
+                self._probe_at.pop(u, None)
+            elif not self.heartbeat.is_alive(u):
+                continue
+            pool.append(u)
+        return pool
+
+    def _recover_task(self, st: _TaskState, pool: List[str],
+                      retry_attempts: int, deadline: Optional[float],
+                      cause: BaseException) -> None:
+        """Re-dispatch one lost task to a surviving ALIVE worker: same
+        fragment, same split assignment (splitIndex/splitCount), new
+        taskId — the survivor re-generates the split share
+        deterministically at the scan, and the fetch loop resumes at
+        st.next_token so already-consumed pages dedupe by token.
+        Raises DcnQueryFailed when retries are exhausted (or pinned
+        off) or no survivors remain."""
+        from presto_tpu import events as E
+
+        if not getattr(cause, "task_error", False):
+            # node loss (unreachable / dead). A DETERMINISTIC task
+            # failure on a healthy worker is NOT excluded — the node is
+            # fine, the fragment raised; re-dispatch is still tried in
+            # case the fault was environmental (e.g. device pressure)
+            self._exclude(st.uri)
+        while True:
+            if st.retries_used >= retry_attempts:
+                raise DcnQueryFailed(
+                    f"worker {st.uri} task {st.task_id}: {cause} "
+                    f"(task retries exhausted: "
+                    f"task_retry_attempts={retry_attempts})"
+                ) from cause
+            # prefer a DIFFERENT worker: the failed placement's node
+            # sorts last (it stays in the pool only for task_error)
+            survivors = sorted(
+                (u for u in pool if u not in self._excluded),
+                key=lambda u: u == st.uri)
+            if not survivors:
+                raise DcnQueryFailed(
+                    f"task {st.task_id}: no surviving workers to "
+                    f"re-dispatch to (pool {pool}, all excluded)"
+                ) from cause
+            st.retries_used += 1
+            self._sleep_backoff(st.retries_used, deadline)
+            self._check_deadline(deadline)
+            target = survivors[(st.retries_used - 1) % len(survivors)]
+            base_id = st.payload["taskId"].split(".r", 1)[0]
+            new_id = f"{base_id}.r{st.retries_used}"
+            payload = dict(st.payload, taskId=new_id)
+            from_uri = st.uri
+            try:
+                self._post_task(target, payload)
+                prefix_ok = (st.next_token == 0 or self._prefix_matches(
+                    target, new_id, st, deadline))
+            except (urllib.error.URLError, OSError) as e:
+                # the survivor failed too: exclude it and keep going
+                # (each failed placement consumes one retry)
+                self._exclude(target)
+                st.uri, cause = target, e
+                continue
+            except _TaskLost as e:
+                # the re-dispatched task itself failed deterministically
+                # during prefix verification
+                st.uri, cause = target, e
+                continue
+            if not prefix_ok:
+                raise DcnQueryFailed(
+                    f"task {new_id}: the re-dispatched placement "
+                    f"regenerated a DIFFERENT page sequence for the "
+                    f"already-consumed prefix ({st.next_token} pages) "
+                    f"— non-deterministic task output (e.g. the "
+                    f"survivor's device-OOM ladder re-chunked page "
+                    f"boundaries); failing loudly instead of silently "
+                    f"skipping or duplicating rows"
+                ) from cause
+            st.uri, st.task_id, st.payload = target, new_id, payload
+            self.runner.executor.task_retries += 1
+            E.dispatch(
+                self.listeners, "task_retried", E.TaskRetryEvent(
+                    query_id=base_id.split(".", 1)[0],
+                    task_id=new_id, from_uri=from_uri, to_uri=target,
+                    attempt=st.retries_used, cause=str(cause)[:400],
+                )
+            )
+            return
 
     # ---------------------------------------------------------- execute
     def execute(self, sql: str):
         plan = self.runner.plan(sql)
         ex = self.runner.executor
+        retry_attempts = self._retry_attempts()
         cut = find_partial_cut(plan)
         partial = coord_plan = partition_cols = split_table = None
         if cut is not None:
@@ -196,7 +490,10 @@ class DcnRunner:
                     if split_table is not None else None)
             if ucut is None:
                 # nothing distributable: run locally rather than wrong
+                # (no pool computed — local queries never pay dead-node
+                # probe timeouts)
                 self.last_distribution = "local"
+                self.last_pool = []
                 return self.runner.execute(sql).rows
             partition_cols = hash_fanout_source(
                 ucut, self.runner.catalogs,
@@ -208,34 +505,54 @@ class DcnRunner:
             )
             cut, partial = ucut, ucut
         # coordinator-side final stage honors the same session the
-        # workers were sent
+        # workers were sent (also anchors ex.query_deadline from
+        # query_max_run_time — query start for deadline purposes)
         self.runner.apply_session()
+        deadline = ex.query_deadline
 
-        # launch one task per worker; the task body carries the
+        # the plan IS distributable from here on — now workers are
+        # mandatory. task_retry_attempts=0 pins the classic model end
+        # to end: all configured workers are picked (no heartbeat
+        # gate), the first submit/fetch failure fails the QUERY cleanly
+        pool = (self._alive_for_submit() if retry_attempts > 0
+                else list(self.worker_uris))
+        self.last_pool = list(pool)
+        if not pool:
+            raise DcnQueryFailed(
+                f"no ALIVE workers among {self.worker_uris}"
+            )
+        # launch one task per pooled worker; the task body carries the
         # SERIALIZED fragment (plan shipping — reference:
         # TaskUpdateRequest.fragment), not SQL to replay
         fragment = plan_serde.dumps(partial)
         qid = uuid.uuid4().hex[:12]
-        tasks = []
-        for w, uri in enumerate(self.worker_uris):
+        tasks: List[_TaskState] = []
+        for w, uri in enumerate(pool):
             payload = {
                 "taskId": f"{qid}.{w}",
                 "fragment": fragment,
                 "splitTable": split_table,
                 "splitIndex": w,
-                "splitCount": len(self.worker_uris),
+                "splitCount": len(pool),
                 "session": self.session_props,
             }
             if partition_cols is not None:
                 payload["splitMode"] = "hash"
                 payload["partitionColumns"] = partition_cols
+            st = _TaskState(uri=uri, task_id=payload["taskId"],
+                            payload=payload)
             try:
                 self._post_task(uri, payload)
             except (urllib.error.URLError, OSError) as e:
-                raise DcnQueryFailed(
-                    f"worker {uri}: task submit failed: {e}"
-                ) from e
-            tasks.append((uri, f"{qid}.{w}"))
+                if retry_attempts <= 0:
+                    raise DcnQueryFailed(
+                        f"worker {uri}: task submit failed: {e}"
+                    ) from e
+                # submit retry: re-dispatch this split share to a
+                # different ALIVE worker (it runs two tasks)
+                self._recover_task(st, pool, retry_attempts,
+                                   deadline, e)
+            tasks.append(st)
 
         # coordinator-side plan: shipped subtree -> RemoteSource
         state_types = tuple(ex.output_types(partial))
@@ -250,8 +567,26 @@ class DcnRunner:
             coord_plan = _replace_node(plan, cut, final)
 
         def supplier():
-            for uri, task_id in tasks:
-                yield from self._fetch_pages(uri, task_id)
+            for st in tasks:
+                # a fresh supplier invocation (coordinator boosted
+                # retry re-pulling the remote source) refetches from
+                # token 0 — workers buffer the full page list; within
+                # ONE invocation next_token advances so a re-dispatched
+                # task resumes at the consumed token (dedupe)
+                st.next_token = 0
+                st.hasher = hashlib.sha256()
+                while True:
+                    try:
+                        yield from self._fetch_pages(st, deadline)
+                        break
+                    except _TaskLost as e:
+                        if retry_attempts <= 0:
+                            raise DcnQueryFailed(str(e)) from e
+                        # worker death mid-query: exclude the node and
+                        # re-run ONLY the lost fragment on a survivor,
+                        # resuming the fetch at the consumed token
+                        self._recover_task(st, pool, retry_attempts,
+                                           deadline, e)
 
         ex.remote_sources[key] = supplier
         try:
@@ -259,12 +594,16 @@ class DcnRunner:
             return rows
         finally:
             ex.remote_sources.pop(key, None)
-            # release worker-side page buffers (reference: task expiry)
-            for uri, task_id in tasks:
+            # release worker-side page buffers (reference: task expiry).
+            # Scoped to transport errors ONLY — a programming error in
+            # the release path must surface, not vanish; dead-worker
+            # skips are counted, not swallowed silently.
+            for st in tasks:
                 try:
                     req = urllib.request.Request(
-                        f"{uri}/v1/task/{task_id}", method="DELETE"
+                        f"{st.uri}/v1/task/{st.task_id}",
+                        method="DELETE"
                     )
                     urllib.request.urlopen(req, timeout=5).close()
-                except (urllib.error.URLError, OSError):
-                    pass  # dead worker: nothing to free
+                except (urllib.error.URLError, OSError, TimeoutError):
+                    self.release_skips += 1  # dead worker: nothing to free

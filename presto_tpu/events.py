@@ -37,14 +37,34 @@ class QueryCompletedEvent:
     error_message: Optional[str] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class TaskRetryEvent:
+    """One fault-tolerant task re-dispatch (dist/dcn.py): the fragment
+    placed on `from_uri` was lost (worker death / submit failure /
+    exhausted fetch retries) and re-ran on `to_uri` with the same split
+    assignment. Reference analog: Project Tardigrade's task-retry
+    events in QueryMonitor."""
+
+    query_id: str
+    task_id: str
+    from_uri: str
+    to_uri: str
+    attempt: int
+    cause: str
+
+
 class EventListener:
     """Subclass and override; register via PrestoTpuServer(
-    event_listeners=[...]) or QueryManager(listeners=[...])."""
+    event_listeners=[...]), QueryManager(listeners=[...]), or
+    DcnRunner(listeners=[...])."""
 
     def query_created(self, event: QueryCreatedEvent) -> None:
         pass
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+    def task_retried(self, event: TaskRetryEvent) -> None:
         pass
 
 

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -179,6 +181,41 @@ class MemoryBudgetExceeded(RuntimeError):
     """Reference: ExceededMemoryLimitException — the query fails rather
     than thrash (SURVEY §6.4: kill-don't-spill is the v1 policy; spill to
     host RAM is the documented follow-up)."""
+
+
+class QueryDeadlineExceeded(RuntimeError):
+    """query_max_run_time expired (reference: QueryTracker's
+    enforceTimeLimits failing queries past query.max-run-time). Raised
+    at page boundaries in the execute()/stream_fragment() driver loops
+    — a compiled program in flight cannot be interrupted, but the query
+    can never outlive its deadline by more than one launch."""
+
+
+_DEVICE_FAULT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "Failed to allocate",
+)
+
+
+def _is_device_fault(e: BaseException) -> bool:
+    """Whether an exception is a device memory/allocation fault the
+    OOM-degradation ladder may absorb. Deliberately conservative:
+    only XlaRuntimeError and EXACTLY RuntimeError (the runtime's and
+    the fault hook's type) are eligible — engine control-flow
+    exceptions (DcnQueryFailed, MemoryBudgetExceeded, ...) subclass
+    RuntimeError and are rejected by the exact-type check even when
+    they QUOTE a worker's device-fault text, so a worker-side OOM
+    surfaced through the coordinator never triggers a useless
+    budget-halved re-run of the whole query. The memory markers must
+    match for BOTH types: a non-memory XlaRuntimeError (INVALID_ARGUMENT,
+    INTERNAL, ...) is a bug to surface, not a footprint to shrink."""
+    if type(e).__name__ != "XlaRuntimeError" and \
+            type(e) is not RuntimeError:
+        return False
+    msg = str(e)
+    return any(m in msg for m in _DEVICE_FAULT_MARKERS)
 
 
 def page_bytes(page: Page) -> int:
@@ -379,7 +416,7 @@ class Executor:
         # real HBM minus headroom on TPU, a generous cap on CPU (tier-1
         # behavior unchanged unless a test forces a tiny budget).
         self.device_memory_budget = 0
-        self._budget_resolved: Optional[Tuple[int, int]] = None
+        self._budget_resolved: Optional[Tuple] = None
         # fault_rows: per-buffer row-capacity ceiling. None = auto
         # (SAFE_BUFFER_ROWS on TPU — the axon >=4M-row kernel fault,
         # with construction headroom — unlimited elsewhere); 0 = off;
@@ -389,6 +426,35 @@ class Executor:
         # (reset in _begin_attempt, reported in EXPLAIN ANALYZE and
         # BENCH_DETAILS alongside peak_device_bytes)
         self.memory_chunked_pipelines = 0
+        # ---- fault tolerance (ISSUE 5: task retry + deadlines + OOM
+        # degradation). query_deadline: absolute time.monotonic()
+        # deadline set per query by runner.apply_session from the
+        # query_max_run_time session property; checked at page
+        # boundaries in the execute()/stream_fragment() driver loops.
+        self.query_deadline: Optional[float] = None
+        # device-OOM degradation: a caught XLA RESOURCE_EXHAUSTED /
+        # allocation fault re-enters execution with the resolved
+        # device-memory budget halved (the membudget governor then
+        # rewrites over-share pipelines into their chunked forms), up
+        # to device_oom_attempts times — an HBM-model miss becomes a
+        # slow correct query, not a crash. Wired from the
+        # task_retry_attempts session property (0 restores raise-
+        # through). device_oom_retries is per-query observability.
+        self.device_oom_attempts = 2
+        self.device_oom_retries = 0
+        self._oom_divisor = 1
+        # test/chaos hook: raise a synthetic RESOURCE_EXHAUSTED on the
+        # next N attempts (FAULT_DEVICE_OOM env seeds subprocess
+        # workers; tests set the attribute directly)
+        self.inject_device_oom = int(
+            os.environ.get("FAULT_DEVICE_OOM", "0")
+        )
+        # DCN coordinator task recovery, maintained by DcnRunner on ITS
+        # executor (lifetime-cumulative, like the join counters):
+        # task_retries = fragments re-dispatched to a surviving worker,
+        # workers_excluded = nodes dropped from the query's pool.
+        self.task_retries = 0
+        self.workers_excluded = 0
 
     # ------------------------------------------------------------ plumbing
     def _jit(self, key, fn, static_argnums=()):
@@ -405,15 +471,65 @@ class Executor:
         return self._jit_cache[key]
 
     # ------------------------------------------- device-memory governor
+    # floor for OOM-tightened budgets: the governor's sizing math stays
+    # sane however many times the ladder halves. Capped at the resolved
+    # budget itself so an EXPLICIT tiny test budget is never silently
+    # raised back above what the test forced.
+    _OOM_BUDGET_FLOOR = 1 << 20
+
     def _budget(self) -> int:
         """Resolved device-memory budget in bytes (membudget.py): an
         explicit device_memory_budget wins; auto = HBM minus headroom
-        on TPU, a generous cap on CPU. Cached per setting — resolution
-        may query device memory stats once."""
-        key = self.device_memory_budget
+        on TPU, a generous cap on CPU; a device-OOM retry halves it
+        (_tighten_budget) so the governor re-plans chunked. Cached per
+        (setting, tightening) — resolution may query device memory
+        stats once."""
+        key = (self.device_memory_budget, self._oom_divisor)
         if self._budget_resolved is None or self._budget_resolved[0] != key:
-            self._budget_resolved = (key, MB.resolve_budget(key))
+            resolved = MB.resolve_budget(self.device_memory_budget)
+            floor = min(resolved, self._OOM_BUDGET_FLOOR)
+            self._budget_resolved = (
+                key,
+                max(resolved // self._oom_divisor, floor),
+            )
         return self._budget_resolved[1]
+
+    def _tighten_budget(self) -> None:
+        """Halve the resolved budget for the next attempt (the device
+        itself just proved the HBM model optimistic)."""
+        self._oom_divisor = min(self._oom_divisor * 2, 1 << 10)
+
+    def _check_deadline(self) -> None:
+        dl = self.query_deadline
+        if dl is not None and time.monotonic() > dl:
+            raise QueryDeadlineExceeded(
+                "query exceeded query_max_run_time (deadline passed "
+                f"{time.monotonic() - dl:.2f}s ago)"
+            )
+
+    def _absorb_device_fault(self, e: BaseException,
+                             oom_left: int) -> int:
+        """Shared OOM-degradation gate for the execute()/
+        stream_fragment() driver loops: absorb a device fault by
+        tightening the budget (the membudget governor re-plans the
+        next attempt chunked) and return the decremented retry budget;
+        re-raise anything else, or anything once the budget is
+        exhausted."""
+        if oom_left <= 0 or not _is_device_fault(e):
+            raise e
+        self.device_oom_retries += 1
+        self._tighten_budget()
+        return oom_left - 1
+
+    def _maybe_inject_oom(self) -> None:
+        """Fault-injection hook for tests/chaos (SURVEY §6.3 extended
+        inward): synthesize the device allocator's failure mode so the
+        OOM-degradation ladder is exercisable on CPU."""
+        if self.inject_device_oom > 0:
+            self.inject_device_oom -= 1
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: injected device OOM (fault hook)"
+            )
 
     def _fault_rows(self) -> Optional[int]:
         """Per-buffer row-capacity ceiling for governed sizing: on TPU
@@ -1272,6 +1388,8 @@ class Executor:
         self.host_spill_bytes_used = 0
         self.disk_spill_pages = 0
         self.skew_chunks_used = 0
+        self.device_oom_retries = 0
+        self._oom_divisor = 1
         # generated/pallas counters accumulate for the executor's
         # lifetime (tests assert before/after deltas); snapshot them so
         # EXPLAIN ANALYZE can report THIS query's engagement
@@ -1279,24 +1397,44 @@ class Executor:
             self.generated_joins_used, self.pallas_joins_used
         )
         cc_base = CC.snapshot()
+        oom_left = self.device_oom_attempts
         try:
-            for _attempt in range(6):
+            attempts = 0
+            while attempts < 6:
                 self._begin_attempt()
                 if self._collect_stats is not None:
                     # drop failed-attempt stats
                     self._collect_stats.clear()
-                out_pages = list(self.pages(node))
-                if self._overflow_flagged():
+                try:
+                    self._maybe_inject_oom()
+                    out_pages = []
+                    for page in self.pages(node):
+                        self._check_deadline()
+                        out_pages.append(page)
+                    overflow = self._overflow_flagged()
+                    rows: List[tuple] = []
+                    if not overflow:
+                        for page in out_pages:
+                            rows.extend(_decode_result_page(page))
+                except QueryDeadlineExceeded:
+                    raise
+                except Exception as e:  # noqa: BLE001 - ladder gate
+                    # device-OOM degradation: a RESOURCE_EXHAUSTED /
+                    # allocation fault re-enters under a HALVED budget
+                    # — an HBM-model miss becomes a slow correct query
+                    # instead of a crashed one. Anything else (and an
+                    # exhausted OOM budget) raises through.
+                    oom_left = self._absorb_device_fault(e, oom_left)
+                    continue
+                if overflow:
                     # re-enter at the next rung of the SHARED ladder
                     # (shapes.py): boosted sizes coincide with a larger
                     # query's first-attempt shapes, so the retry reuses
                     # cached programs instead of minting fresh ones
                     self._capacity_boost = SH.next_boost(
                         self._capacity_boost)
+                    attempts += 1
                     continue
-                rows: List[tuple] = []
-                for page in out_pages:
-                    rows.extend(_decode_result_page(page))
                 return names, rows
             raise RuntimeError(
                 "capacity overflow persisted after 6 boosted retries"
@@ -1344,20 +1482,38 @@ class Executor:
         set can never escape because results publish only per
         completed attempt. Raises after 6 boosted retries."""
         self._capacity_boost = 1
+        self.device_oom_retries = 0
+        self._oom_divisor = 1
         cc_base = CC.snapshot()
+        oom_left = self.device_oom_attempts
         try:
-            for _attempt in range(6):
+            attempts = 0
+            while attempts < 6:
                 self._begin_attempt()
-                out: List = []
-                for page in self.pages(node):
-                    if cancelled():
-                        return out
-                    out.append(emit(page))
+                try:
+                    self._maybe_inject_oom()
+                    out: List = []
+                    for page in self.pages(node):
+                        if cancelled():
+                            return out
+                        self._check_deadline()
+                        out.append(emit(page))
+                except QueryDeadlineExceeded:
+                    raise
+                except Exception as e:  # noqa: BLE001 - ladder gate
+                    # same device-OOM degradation as execute(): retry
+                    # under a halved budget so the worker's fragment
+                    # degrades to chunked execution instead of failing
+                    # the task (the coordinator's long-poll tolerates
+                    # the delay)
+                    oom_left = self._absorb_device_fault(e, oom_left)
+                    continue
                 if not self._overflow_flagged():
                     return out
                 # same shared-ladder re-entry as execute(): fragment
                 # retries land on rungs the cache already paid for
                 self._capacity_boost = SH.next_boost(self._capacity_boost)
+                attempts += 1
             raise RuntimeError(
                 "fragment capacity overflow persisted after 6 boosted "
                 "retries"
@@ -1448,6 +1604,18 @@ class Executor:
             # governor rewrote into chunked/streaming form
             "peak_device_bytes": self.peak_memory_bytes,
             "memory_chunked_pipelines": self.memory_chunked_pipelines,
+            # fault tolerance (ISSUE 5): device-OOM re-entries this
+            # query; DCN task re-dispatches / node exclusions (the
+            # coordinator maintains these on ITS executor —
+            # lifetime-cumulative, spanning submit and fetch); wall
+            # left under query_max_run_time (-1 = no deadline)
+            "device_oom_retries": self.device_oom_retries,
+            "task_retries": self.task_retries,
+            "workers_excluded": self.workers_excluded,
+            "deadline_ms_remaining": (
+                int((self.query_deadline - time.monotonic()) * 1000)
+                if self.query_deadline is not None else -1
+            ),
         }
         return names, rows, stats
 

@@ -212,6 +212,21 @@ class LocalRunner:
         ex.device_memory_budget = int(
             self.session.get("device_memory_budget")
         )
+        # fault tolerance (ISSUE 5): task_retry_attempts also bounds
+        # the executor's device-OOM re-entries (the same retry
+        # discipline extended inward); query_max_run_time anchors a
+        # fresh absolute deadline at apply-time — execute() calls this
+        # per query, so the deadline measures from query start
+        ex.device_oom_attempts = int(
+            self.session.get("task_retry_attempts")
+        )
+        _deadline_ms = int(self.session.get("query_max_run_time"))
+        import time as _time
+
+        ex.query_deadline = (
+            _time.monotonic() + _deadline_ms / 1000.0
+            if _deadline_ms else None
+        )
         pj = self.session.get("pallas_join_enabled")
         ex.pallas_join = {"auto": "auto", "true": "force",
                           "false": "off"}[pj]

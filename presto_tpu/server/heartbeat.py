@@ -101,21 +101,31 @@ class HeartbeatFailureDetector:
         with self._lock:
             uris = list(self.nodes)
         for uri in uris:
-            ok, err = self._ping(uri)
-            with self._lock:
-                n = self.nodes[uri]
-                if ok:
-                    n.successes += 1
-                    n.consecutive_failures = 0
-                    n.alive = True
-                    n.last_seen = time.time()
-                    n.last_error = ""
-                else:
-                    n.failures += 1
-                    n.consecutive_failures += 1
-                    n.last_error = err
-                    if n.consecutive_failures >= self.fail_after:
-                        n.alive = False
+            self.probe(uri)
+
+    def probe(self, uri: str) -> bool:
+        """Ping ONE node now and record the outcome in its NodeHealth
+        stats — direct probes (e.g. the DCN re-admission path) stay
+        visible in /v1/node snapshots instead of bypassing the
+        bookkeeping."""
+        ok, err = self._ping(uri)
+        with self._lock:
+            n = self.nodes.get(uri)
+            if n is None:
+                return ok
+            if ok:
+                n.successes += 1
+                n.consecutive_failures = 0
+                n.alive = True
+                n.last_seen = time.time()
+                n.last_error = ""
+            else:
+                n.failures += 1
+                n.consecutive_failures += 1
+                n.last_error = err
+                if n.consecutive_failures >= self.fail_after:
+                    n.alive = False
+        return ok
 
     def _ping(self, uri: str):
         try:

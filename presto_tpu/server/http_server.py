@@ -311,6 +311,18 @@ class QueryManager:
                 "# TYPE presto_tpu_memory_chunked_pipelines gauge",
                 f"presto_tpu_memory_chunked_pipelines "
                 f"{executor.memory_chunked_pipelines}",
+                # fault tolerance (dist/dcn.py + the executor's
+                # device-OOM degradation ladder): recovery actions are
+                # fleet-observable, not silent
+                "# TYPE presto_tpu_task_retries_total counter",
+                f"presto_tpu_task_retries_total "
+                f"{getattr(executor, 'task_retries', 0)}",
+                "# TYPE presto_tpu_workers_excluded_total counter",
+                f"presto_tpu_workers_excluded_total "
+                f"{getattr(executor, 'workers_excluded', 0)}",
+                "# TYPE presto_tpu_device_oom_retries gauge",
+                f"presto_tpu_device_oom_retries "
+                f"{getattr(executor, 'device_oom_retries', 0)}",
             ]
         return "\n".join(lines) + "\n"
 
@@ -719,6 +731,15 @@ class PrestoTpuServer:
             out.append(("peak_device_bytes", ex.peak_memory_bytes))
             out.append(("memory_chunked_pipelines",
                         ex.memory_chunked_pipelines))
+            # fault tolerance: task re-dispatches / node exclusions
+            # (DCN coordinator) and device-OOM degradations, queryable
+            # with SQL like every other engine metric
+            out.append(("task_retries",
+                        getattr(ex, "task_retries", 0)))
+            out.append(("workers_excluded",
+                        getattr(ex, "workers_excluded", 0)))
+            out.append(("device_oom_retries",
+                        getattr(ex, "device_oom_retries", 0)))
             return out
 
         sys_conn.register(

@@ -16,8 +16,19 @@ send (sql, role) for worker-side replay.
 
 Fault-injection hooks (SURVEY §6.3: inject at the host page proxy —
 ICI collectives cannot be faulted): FAULT_DELAY_MS delays every
-results fetch; FAULT_DROP_EVERY=n returns HTTP 500 on every nth fetch.
-Token-indexed re-fetch makes drops recoverable (at-least-once).
+results fetch; FAULT_DROP_EVERY=n returns HTTP 500 on every nth fetch;
+FAULT_KILL_AFTER_FETCHES=n hard-exits the worker PROCESS once n result
+fetches have been served (worker death mid-query — the coordinator's
+task-retry path re-dispatches the fragment to a survivor);
+FAULT_SUBMIT_DROP_EVERY=n returns HTTP 500 on every nth task submit
+(exercises the coordinator's submit retry). Each knob reads the
+runtime `fault_config` posted via POST /v1/fault as an OVERLAY on the
+environment: posted keys win (an explicit 0 disables an env-seeded
+fault), absent keys fall back to the environment, and `{}` restores
+pure env-ruled mode (tools/chaos.py reconfigures live workers between
+iterations without reboots). Token-indexed re-fetch makes drops
+recoverable
+(at-least-once); kills are recoverable only with task_retry_attempts>0.
 """
 
 from __future__ import annotations
@@ -260,20 +271,35 @@ class _WorkerHandler(BaseHTTPRequestHandler):
     def app(self) -> "WorkerServer":
         return self.server.app  # type: ignore[attr-defined]
 
-    def _json(self, obj, status=200):
+    def _json(self, obj, status=200, headers=()):
         body = json.dumps(obj).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def do_POST(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(n) or b"{}"
+        if self.path.startswith("/v1/fault"):
+            # runtime fault reconfiguration (chaos harness): the posted
+            # overlay replaces the previous one; {} clears every
+            # RUNTIME fault and restores env-ruled mode
+            self.app.set_fault_config({
+                k: int(v) for k, v in json.loads(body).items()
+            })
+            self._json({"ok": True, "fault": self.app.fault_config})
+            return
         if not self.path.startswith("/v1/task"):
             self._json({"error": "not found"}, 404)
             return
-        n = int(self.headers.get("Content-Length", "0"))
-        req = json.loads(self.rfile.read(n) or b"{}")
+        if self.app.maybe_inject_submit_fault():
+            self._json({"error": "injected submit fault"}, 500)
+            return
+        req = json.loads(body)
         task = self.app.create_task(req)
         self._json({"taskId": task.task_id, "state": "RUNNING"})
 
@@ -304,7 +330,13 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             while time.time() < deadline:
                 with task.lock:
                     if task.error:
-                        self._json({"error": task.error}, 500)
+                        # X-Task-Error marks a DETERMINISTIC task
+                        # failure (the fragment itself failed, not the
+                        # transport): the coordinator surfaces the real
+                        # message instead of spinning fetch retries
+                        # against a dead task
+                        self._json({"error": task.error}, 500,
+                                   headers=(("X-Task-Error", "1"),))
                         return
                     if token < len(task.pages):
                         body = task.pages[token]
@@ -377,20 +409,60 @@ class WorkerServer:
         self._thread: Optional[threading.Thread] = None
         self._fault_lock = threading.Lock()
         self._results_calls = 0
+        self._submit_calls = 0
+        # runtime-settable fault injection (POST /v1/fault): posted
+        # keys OVERRIDE the environment (an explicit 0 disables an
+        # env-seeded fault); absent keys fall back to the environment,
+        # so `{}` restores env-ruled mode — the overlay is never
+        # one-way
+        self.fault_config: Dict[str, int] = {}
 
     # -------------------------------------------------- fault injection
+    def set_fault_config(self, cfg: Dict[str, int]) -> None:
+        """Install a runtime fault config and RESET the call counters —
+        'kill after n fetches' / 'drop every nth' count from the posted
+        schedule, not from process-lifetime totals accumulated across
+        earlier chaos iterations."""
+        with self._fault_lock:
+            self.fault_config = cfg
+            self._results_calls = 0
+            self._submit_calls = 0
+
+    def _fault(self, name: str) -> int:
+        if name in self.fault_config:
+            return int(self.fault_config[name])
+        return int(os.environ.get(name, "0") or 0)
+
     def maybe_inject_fault(self) -> bool:
         """SURVEY §6.3: faults inject at the host page proxy (delay /
-        drop); returns True when this fetch should fail with HTTP 500.
-        Token-indexed re-fetch makes drops recoverable."""
-        delay = int(os.environ.get("FAULT_DELAY_MS", "0"))
+        drop / kill); returns True when this fetch should fail with
+        HTTP 500. Token-indexed re-fetch makes drops recoverable; a
+        KILL is the real thing — the process hard-exits, recoverable
+        only by the coordinator's task-retry re-dispatch."""
+        delay = self._fault("FAULT_DELAY_MS")
         if delay:
             time.sleep(delay / 1000.0)
-        drop = int(os.environ.get("FAULT_DROP_EVERY", "0"))
+        with self._fault_lock:
+            self._results_calls += 1
+            calls = self._results_calls
+        kill_after = self._fault("FAULT_KILL_AFTER_FETCHES")
+        if kill_after and calls > kill_after:
+            # worker death mid-query: bypass every finally/atexit, like
+            # a real OOM-kill or host loss
+            os._exit(137)
+        drop = self._fault("FAULT_DROP_EVERY")
+        if drop and calls % drop == 0:
+            return True
+        return False
+
+    def maybe_inject_submit_fault(self) -> bool:
+        """HTTP 500 on every nth /v1/task submit — exercises the
+        coordinator's submit-retry-to-a-different-worker path."""
+        drop = self._fault("FAULT_SUBMIT_DROP_EVERY")
         if drop:
             with self._fault_lock:
-                self._results_calls += 1
-                if self._results_calls % drop == 0:
+                self._submit_calls += 1
+                if self._submit_calls % drop == 0:
                     return True
         return False
 

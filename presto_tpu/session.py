@@ -249,6 +249,35 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0,
         ),
         PropertyMetadata(
+            "task_retry_attempts",
+            "fault-tolerant execution (reference: Project Tardigrade's "
+            "task-level retry): re-dispatch a lost DCN task to a "
+            "surviving ALIVE worker up to this many times — the "
+            "fragment re-generates its split share deterministically "
+            "at the scan and already-consumed pages dedupe by fetch "
+            "token, so delivery stays effectively exactly-once. Also "
+            "bounds the executor's device-OOM re-entries (each under a "
+            "halved device-memory budget). 0 pins the classic "
+            "fail-query-cleanly model",
+            int, 2,
+        ),
+        PropertyMetadata(
+            "retry_backoff_ms",
+            "base delay for the exponential-backoff-with-jitter ladder "
+            "between DCN fetch/submit retries (reference: "
+            "HttpPageBufferClient backoff)",
+            int, 100,
+        ),
+        PropertyMetadata(
+            "query_max_run_time",
+            "wall-clock deadline in milliseconds for a query "
+            "(0 = unlimited; reference: query.max-run-time). Enforced "
+            "in QueryManager, at executor page boundaries, and in the "
+            "DCN fetch loop — expiry surfaces as FAILED with a "
+            "QueryDeadlineExceeded cause instead of hanging",
+            int, 0,
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

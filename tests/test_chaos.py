@@ -1,0 +1,33 @@
+"""Slow-marked subprocess wrapper around tools/chaos.py so the chaos
+harness cannot bit-rot: a short seeded run (randomized delay / drop /
+kill / submit-drop schedules over real OS-process workers) must exit 0
+— every query correct, no hangs past the query deadline.
+
+The full matrix (`tools/chaos.py --iterations 20 --seed 0`) is the
+acceptance gate; this wrapper keeps the harness wired into tier-1's
+slow lane at an affordable iteration count.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_chaos_harness_exits_zero():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--iterations", "4", "--seed", "0", "--scale", "0.005"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"chaos harness failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "failures" in proc.stdout

@@ -1,0 +1,221 @@
+"""Fault tolerance inside one process (ISSUE 5's inward half): the
+executor's device-OOM degradation ladder (a caught RESOURCE_EXHAUSTED
+re-enters execution under a tightened device-memory budget, so an
+HBM-model miss becomes a slow correct query), the query_max_run_time
+deadline, and the session/etc plumbing that governs both.
+
+The DCN (cross-process) half lives in tests/test_dcn.py; the chaos
+harness wrapper in tests/test_chaos.py.
+"""
+
+import time
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.exec.executor import QueryDeadlineExceeded
+from presto_tpu.runner import LocalRunner
+from presto_tpu.session import Session
+
+SF = 0.01
+PAGE_ROWS = 1 << 13
+
+JOIN_SQL = (
+    "select o_orderpriority, count(*), sum(l_quantity) "
+    "from orders join lineitem on o_orderkey = l_orderkey "
+    "group by o_orderpriority"
+)
+
+
+@pytest.fixture()
+def runner():
+    return LocalRunner({"tpch": TpchConnector(SF)},
+                       page_rows=PAGE_ROWS)
+
+
+@pytest.fixture(scope="module")
+def oracle_rows():
+    r = LocalRunner({"tpch": TpchConnector(SF)}, page_rows=PAGE_ROWS)
+    return sorted(r.execute(JOIN_SQL).rows)
+
+
+# ------------------------------------------------- device-OOM ladder
+def test_injected_oom_retries_and_matches(runner, oracle_rows):
+    """A device fault on the first attempt re-enters under a halved
+    budget and returns correct rows (device_oom_retries observable)."""
+    ex = runner.executor
+    ex.inject_device_oom = 1
+    rows = runner.execute(JOIN_SQL).rows
+    assert sorted(rows) == oracle_rows
+    assert ex.device_oom_retries == 1
+    assert ex.inject_device_oom == 0
+    assert ex._oom_divisor == 2  # the budget really tightened
+
+
+def test_oom_with_forced_tiny_budget_stays_correct(runner,
+                                                   oracle_rows):
+    """The acceptance shape: forced tiny budget + forced device fault
+    on a join — the retry runs under a TIGHTENED budget (the membudget
+    governor re-plans chunked) and the rows stay oracle-correct."""
+    runner.session.set("device_memory_budget", 1 << 22)
+    ex = runner.executor
+    ex.inject_device_oom = 1
+    rows = runner.execute(JOIN_SQL).rows
+    assert sorted(rows) == oracle_rows
+    assert ex.device_oom_retries >= 1
+    # tightened: half the forced budget, never raised above it
+    assert ex._budget() <= (1 << 22) // 2
+
+
+def test_pinned_mode_raises_through(runner):
+    """task_retry_attempts=0 restores raise-through: the device fault
+    surfaces instead of degrading (the classic failure model)."""
+    runner.session.set("task_retry_attempts", 0)
+    runner.executor.inject_device_oom = 1
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        runner.execute(JOIN_SQL)
+
+
+def test_oom_budget_exhausted_raises(runner):
+    """More faults than attempts: the ladder gives up loudly."""
+    runner.session.set("task_retry_attempts", 2)
+    runner.executor.inject_device_oom = 3  # one more than the budget
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        runner.execute(JOIN_SQL)
+
+
+def test_non_device_errors_never_absorbed(runner):
+    """The ladder gate is conservative: an engine programming error
+    must surface on the FIRST attempt, not burn retries."""
+    from presto_tpu.exec.executor import _is_device_fault
+
+    assert not _is_device_fault(ValueError("bad plan"))
+    assert not _is_device_fault(RuntimeError("capacity overflow"))
+    assert _is_device_fault(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert _is_device_fault(
+        RuntimeError("Failed to allocate 123 bytes"))
+    # engine control-flow exceptions subclass RuntimeError; QUOTING a
+    # worker's device-fault text must not re-enter the ladder (the
+    # exact-type gate)
+    from presto_tpu.dist.dcn import DcnQueryFailed
+
+    assert not _is_device_fault(DcnQueryFailed(
+        "worker x task y: RESOURCE_EXHAUSTED: out of memory "
+        "(task retries exhausted)"))
+    # a NON-memory XlaRuntimeError is a bug to surface, not a
+    # footprint to shrink — the markers must match for both types
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert not _is_device_fault(XlaRuntimeError("INVALID_ARGUMENT: x"))
+    assert _is_device_fault(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory"))
+
+
+def test_oom_counters_reset_per_query(runner, oracle_rows):
+    ex = runner.executor
+    ex.inject_device_oom = 1
+    runner.execute(JOIN_SQL)
+    assert ex.device_oom_retries == 1
+    runner.execute("select count(*) from region")
+    assert ex.device_oom_retries == 0  # per-query observability
+    assert ex._oom_divisor == 1  # fresh query runs at full budget
+
+
+def test_explain_analyze_exposes_ft_counters(runner):
+    res = runner.execute(
+        "explain analyze select count(*) from orders")
+    text = "\n".join(r[0] for r in res.rows)
+    assert "device_oom_retries=0" in text
+    assert "task_retries=0" in text
+    assert "workers_excluded=0" in text
+    assert "deadline_ms_remaining=-1" in text  # no deadline set
+
+
+# ------------------------------------------------------- deadlines
+def test_query_deadline_expires(runner):
+    runner.session.set("query_max_run_time", 1)  # 1ms: always expires
+    with pytest.raises(QueryDeadlineExceeded):
+        runner.execute(
+            "select l_returnflag, count(*) from lineitem "
+            "group by l_returnflag")
+
+
+def test_query_deadline_zero_is_unlimited(runner):
+    runner.session.set("query_max_run_time", 0)
+    rows = runner.execute("select count(*) from region").rows
+    assert rows == [(5,)]
+    assert runner.executor.query_deadline is None
+
+
+def test_deadline_remaining_reported(runner):
+    runner.session.set("query_max_run_time", 300_000)
+    res = runner.execute(
+        "explain analyze select count(*) from region")
+    text = "\n".join(r[0] for r in res.rows)
+    assert "deadline_ms_remaining=" in text
+    remaining = int(
+        text.split("deadline_ms_remaining=")[1].split(",")[0]
+        .split()[0])
+    assert 0 < remaining <= 300_000
+
+
+def test_query_manager_deadline_surfaces_failed():
+    """The server path: a deadline expiry lands the query in FAILED
+    with a timeout cause (reference: QueryTracker enforceTimeLimits),
+    visible to listeners and /metrics."""
+    from presto_tpu.server.http_server import QueryManager
+
+    def factory(session):
+        return LocalRunner({"tpch": TpchConnector(SF)},
+                           page_rows=PAGE_ROWS, session=session)
+
+    mgr = QueryManager(factory)
+    session = Session(catalog="tpch",
+                      properties={"query_max_run_time": 1})
+    q = mgr.submit(
+        "select l_returnflag, count(*) from lineitem "
+        "group by l_returnflag", session)
+    assert q.done.wait(timeout=120)
+    assert q.state == "FAILED"
+    assert q.error["errorName"] == "QueryDeadlineExceeded"
+
+
+# ------------------------------------------------------- plumbing
+def test_etc_keys_seed_session_defaults(tmp_path):
+    (tmp_path / "config.properties").write_text(
+        "task-retry.attempts=5\n"
+        "task-retry.backoff-ms=250\n"
+        "query.max-run-time-ms=60000\n"
+    )
+    (tmp_path / "catalog").mkdir()
+    (tmp_path / "catalog" / "tpch.properties").write_text(
+        "connector.name=tpch\ntpch.scale=0.001\n"
+    )
+    from presto_tpu.config import server_from_etc
+
+    srv = server_from_etc(str(tmp_path), port=0)
+    s = Session(catalog="tpch")
+    srv.manager._runner_factory(s)  # seeds deployment-tier defaults
+    assert s.get("task_retry_attempts") == 5
+    assert s.get("retry_backoff_ms") == 250
+    assert s.get("query_max_run_time") == 60000
+
+
+def test_apply_session_wires_ft_knobs(runner):
+    runner.session.set("task_retry_attempts", 4)
+    runner.session.set("query_max_run_time", 120_000)
+    runner.apply_session()
+    ex = runner.executor
+    assert ex.device_oom_attempts == 4
+    assert ex.query_deadline is not None
+    assert ex.query_deadline - time.monotonic() <= 120.0
+
+
+def test_metrics_text_exposes_ft_counters(runner):
+    from presto_tpu.server.http_server import QueryManager
+
+    mgr = QueryManager(lambda s: runner)
+    text = mgr.metrics_text(1.0, executor=runner.executor)
+    assert "presto_tpu_task_retries_total 0" in text
+    assert "presto_tpu_workers_excluded_total 0" in text
+    assert "presto_tpu_device_oom_retries 0" in text

@@ -62,6 +62,16 @@ def main() -> int:
           f"memory_chunked_pipelines="
           f"{ctr.get('memory_chunked_pipelines', 0)} "
           f"(model planned {report.chunked_count})", file=sys.stderr)
+    # fault tolerance (ISSUE 5): a rung that needed device-OOM
+    # degradation (or, behind a DCN coordinator, task re-dispatch) is
+    # reporting a real HBM-model miss — BENCH_DETAILS carries the same
+    # counters so the driver's artifact shows it too
+    print(f"# fault tolerance: device_oom_retries="
+          f"{ctr.get('device_oom_retries', 0)} "
+          f"task_retries={ctr.get('task_retries', 0)} "
+          f"workers_excluded={ctr.get('workers_excluded', 0)} "
+          f"deadline_ms_remaining="
+          f"{ctr.get('deadline_ms_remaining', -1)}", file=sys.stderr)
     print(f"# analyzed wall (incl. per-page drain overhead): {total:.2f}s")
     return 0
 

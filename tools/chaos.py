@@ -1,0 +1,207 @@
+"""Chaos harness: a small TPC-H query matrix under randomized fault
+schedules (delay / drop / kill / submit-drop, seeded RNG) against the
+fault-tolerant DCN slice (dist/dcn.py task retry + query deadlines).
+
+Every iteration picks a query and a fault mode, applies the fault to a
+random worker via the runtime POST /v1/fault surface, executes through
+a DcnRunner with task_retry_attempts enabled, and compares the rows
+against a single-process oracle computed once up front. Killed workers
+reboot on the SAME port between iterations (the coordinator's excluded
+set re-admits them on a fresh ping — the node-rejoin model). Exits
+nonzero on ANY wrong result, unexpected error, or hang past the query
+deadline.
+
+Usage: chaos.py [--iterations 20] [--seed 0] [--scale 0.01]
+                [--workers 2] [--deadline-ms 180000]
+"""
+
+import argparse
+import collections
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PAGE_ROWS = 1 << 13
+FAULT_KEYS = (
+    "FAULT_DELAY_MS", "FAULT_DROP_EVERY", "FAULT_KILL_AFTER_FETCHES",
+    "FAULT_SUBMIT_DROP_EVERY", "FAULT_DEVICE_OOM",
+)
+FAULT_MODES = ("none", "delay", "drop", "kill", "submit-drop")
+
+
+def query_matrix():
+    from tests.tpch_queries import QUERIES
+
+    return {
+        "q1": QUERIES[1],
+        "q6": QUERIES[6],
+        "q3": QUERIES[3],
+        "approx": (
+            "select o_orderpriority, approx_distinct(o_custkey), "
+            "sum(o_totalprice) from orders group by o_orderpriority"
+        ),
+    }
+
+
+def rows_equal(a, b) -> bool:
+    return collections.Counter(map(repr, a)) == collections.Counter(
+        map(repr, b)
+    )
+
+
+class Worker:
+    """One subprocess worker, rebootable on a sticky port."""
+
+    def __init__(self, scale: float):
+        self.scale = scale
+        self.port = 0  # 0 = OS-assigned on first boot, sticky after
+        self.proc = None
+        self.uri = ""
+
+    def boot(self) -> None:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        for k in FAULT_KEYS:
+            env.pop(k, None)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "presto_tpu.server.worker",
+             "--port", str(self.port), "--suite", "tpch",
+             "--scale", str(self.scale),
+             "--page-rows", str(PAGE_ROWS)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=REPO, text=True,
+        )
+        line = self.proc.stdout.readline()
+        info = json.loads(line)
+        self.port = info["port"]  # sticky: reboots keep the uri stable
+        self.uri = f"http://127.0.0.1:{self.port}"
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def ensure(self) -> bool:
+        """Reboot if dead; True when a reboot happened."""
+        if self.alive():
+            return False
+        if self.proc is not None:
+            self.proc.wait(timeout=10)
+        # the killed process's port lingers in TIME_WAIT briefly;
+        # retry the bind a few times before giving up
+        for attempt in range(10):
+            try:
+                self.boot()
+                return True
+            except (json.JSONDecodeError, ValueError):
+                time.sleep(0.3 * (attempt + 1))
+        raise RuntimeError(f"worker on port {self.port} failed to boot")
+
+    def set_fault(self, config) -> None:
+        req = urllib.request.Request(
+            f"{self.uri}/v1/fault",
+            data=json.dumps(config).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=5).close()
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--deadline-ms", type=int, default=180_000)
+    args = ap.parse_args()
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.dist.dcn import DcnRunner
+    from presto_tpu.runner import LocalRunner
+
+    rng = random.Random(args.seed)
+    matrix = query_matrix()
+
+    print(f"# oracle: single-process run at SF{args.scale}", flush=True)
+    single = LocalRunner({"tpch": TpchConnector(args.scale)},
+                         page_rows=PAGE_ROWS)
+    want = {name: single.execute(sql).rows
+            for name, sql in matrix.items()}
+
+    workers = [Worker(args.scale) for _ in range(args.workers)]
+    for w in workers:
+        w.boot()
+    coord = DcnRunner(
+        {"tpch": TpchConnector(args.scale)},
+        [w.uri for w in workers],
+        default_catalog="tpch", page_rows=PAGE_ROWS,
+        session_props={
+            "task_retry_attempts": 2,
+            "retry_backoff_ms": 50,
+            "query_max_run_time": args.deadline_ms,
+        },
+    )
+    ex = coord.runner.executor
+
+    failures = 0
+    try:
+        for i in range(args.iterations):
+            qname = rng.choice(sorted(matrix))
+            mode = rng.choice(FAULT_MODES)
+            for w in workers:
+                w.ensure()
+            victim = rng.choice(workers)
+            config = {
+                "none": {},
+                "delay": {"FAULT_DELAY_MS": rng.choice((10, 30, 60))},
+                "drop": {"FAULT_DROP_EVERY": rng.choice((2, 3))},
+                "kill": {"FAULT_KILL_AFTER_FETCHES":
+                         rng.choice((1, 2))},
+                "submit-drop": {"FAULT_SUBMIT_DROP_EVERY": 2},
+            }[mode]
+            for w in workers:
+                w.set_fault(config if w is victim else {})
+            retries0, excl0 = ex.task_retries, ex.workers_excluded
+            t0 = time.monotonic()
+            status = "ok"
+            try:
+                got = coord.execute(matrix[qname])
+                if not rows_equal(got, want[qname]):
+                    status = "WRONG RESULT"
+                    failures += 1
+            except Exception as e:  # noqa: BLE001 - harness verdict
+                status = f"ERROR {type(e).__name__}: {e}"
+                failures += 1
+            wall = time.monotonic() - t0
+            if wall * 1000 > args.deadline_ms:
+                status += " + HANG past deadline"
+                failures += 1
+            print(f"iter {i:02d} q={qname:<6} fault={mode:<11} "
+                  f"wall={wall:6.2f}s task_retries="
+                  f"+{ex.task_retries - retries0} excluded="
+                  f"+{ex.workers_excluded - excl0} dist="
+                  f"{coord.last_distribution}: {status}", flush=True)
+    finally:
+        coord.close()
+        for w in workers:
+            w.kill()
+    print(f"# chaos: {args.iterations} iterations, {failures} failures,"
+          f" task_retries={ex.task_retries} "
+          f"workers_excluded={ex.workers_excluded} "
+          f"release_skips={coord.release_skips}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
