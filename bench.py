@@ -391,7 +391,7 @@ def _col_byte_width(t) -> int:
         return 16
     try:
         return np.dtype(t.numpy_dtype).itemsize
-    except Exception:
+    except (TypeError, AttributeError):  # dict-coded/state types
         return 8
 
 
@@ -740,12 +740,28 @@ def prewarm_child(only_names) -> int:
 
     out = {"cache_dir": None, "rungs": {}}
     audit_failed = []
+    plan_check_failed = []  # separate list: a schema/jit-key
+    # violation is not an HBM failure and must not be reported as one
     selected = [r for r in RUNGS
                 if only_names is None or r[0] in only_names]
     for name, suite, qid, sf, props in selected:
         runner = make_runner(suite, sf, props)
         ex = runner.executor
         plan = runner.plan(queries(suite)[qid])
+        # pre-compile plan verification (exec/plan_check.py, strict):
+        # schema edges, ladder capacities, canonical jit keys — the
+        # same gate tools/plan_audit.py sweeps; a violating rung
+        # surfaces here instead of minting a wrong program set
+        from presto_tpu.exec import plan_check as PC
+
+        try:
+            PC.verify(ex, plan, strict=True)
+        except PC.PlanCheckError as e:
+            plan_check_failed.append(name)
+            print(f"# prewarm {name}: PLAN CHECK FAILED\n{e}",
+                  file=sys.stderr)
+            out["rungs"][name] = {"plan_check_ok": False}
+            continue
         # static HBM audit BEFORE anything launches (tools/hbm_audit.py
         # shares the same model): a rung whose plan would exceed the
         # budget or cross the device fault line surfaces HERE, off the
@@ -782,8 +798,9 @@ def prewarm_child(only_names) -> int:
               f"{d['program_cache_hits']} cache hits", file=sys.stderr)
     out["cache_dir"] = cc.cache_dir()
     out["hbm_audit_failed"] = audit_failed
+    out["plan_check_failed"] = plan_check_failed
     print(json.dumps(out))
-    return 1 if audit_failed else 0
+    return 1 if audit_failed or plan_check_failed else 0
 
 
 def oracle_child() -> int:
@@ -834,7 +851,9 @@ def oracle_child() -> int:
                     out[f"tpcds_{qid}"] = True
                 except AssertionError as e:
                     out[f"tpcds_{qid}"] = f"MISMATCH: {str(e)[:200]}"
-    except Exception as e:  # pragma: no cover
+    # noqa: BLE001 - the oracle child must ALWAYS print its JSON
+    # verdict; any engine/sqlite error becomes the recorded outcome
+    except Exception as e:  # noqa: BLE001 - verdict must print
         out["error"] = repr(e)[:300]
     print(json.dumps(out))
     return 0
@@ -954,7 +973,7 @@ def sqlite_child() -> int:
                 json.dump(
                     {k: v for k, v in cache.items() if v is not None},
                     f, indent=1, sort_keys=True)
-        except Exception:  # pragma: no cover - never poison the cache
+        except Exception:  # noqa: BLE001 - never poison the cache file
             cache[key] = None
     with open(cache_path, "w") as f:
         json.dump({k: v for k, v in cache.items() if v is not None},

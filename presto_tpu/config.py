@@ -109,6 +109,64 @@ def load_catalogs(etc_dir: str) -> Dict[str, object]:
     return catalogs
 
 
+# ---------------------------------------------------------------------
+# THE etc-key <-> session-property registry (reference: airlift @Config
+# bindings — every SystemSessionProperties entry has a config-file
+# counterpart so a deployment can pin fleet-wide defaults without SET
+# SESSION). One mapping, consumed three ways:
+#
+#   - server_from_etc seeds PrestoTpuServer session_defaults from any
+#     of these keys found in etc/config.properties;
+#   - tools/lint's session-props rule fails the build when a session
+#     property lacks an etc key here (or an etc key names a property
+#     that no longer exists);
+#   - tests/test_config_etc.py generates its plumbing assertions from
+#     this dict instead of a hand-maintained list.
+#
+# Keys marked in _ETC_STRUCTURAL_KEYS are consumed by the server
+# wiring itself (constructor arguments / process-global config) rather
+# than seeded as session defaults.
+ETC_SESSION_KEYS: Dict[str, str] = {
+    "tpu-offload.enabled": "tpu_offload_enabled",
+    "join-distribution-type": "join_distribution_type",
+    "broadcast-join.rows": "broadcast_join_rows",
+    "agg-gather.capacity": "agg_gather_capacity",
+    "page-rows": "page_rows",
+    "array-agg.max-elements": "array_agg_max_elements",
+    "query.max-memory-bytes": "query_max_memory_bytes",
+    "hash-partition-count": "hash_partition_count",
+    "pallas-join.enabled": "pallas_join_enabled",
+    "spill.threshold-bytes": "spill_threshold_bytes",
+    "generated-join.enabled": "generated_join_enabled",
+    "agg-optimistic.rows": "agg_optimistic_rows",
+    "agg-compact.enabled": "agg_compact_enabled",
+    "join.max-build-rows": "max_join_build_rows",
+    "spill.host-bytes": "host_spill_bytes",
+    "spill.disk-bytes": "disk_spill_bytes",
+    "spill.path": "spill_path",
+    "late-materialization.enabled": "late_materialization_enabled",
+    "fused-partial-agg.enabled": "fused_partial_agg_enabled",
+    "split-batch.size": "split_batch_size",
+    "compile-cache.dir": "compile_cache_dir",
+    "device-memory.budget": "device_memory_budget",
+    "plan-check.enabled": "plan_check",
+    "task-retry.attempts": "task_retry_attempts",
+    "task-retry.backoff-ms": "retry_backoff_ms",
+    "query.max-run-time-ms": "query_max_run_time",
+    "join-skew.rebalance": "join_skew_rebalance",
+}
+
+# consumed structurally by server_from_etc (constructor args /
+# process-global config), never seeded as session defaults — a session
+# default for page_rows would OVERRIDE the constructor value per-query
+# (session.is_set wins), and compile-cache.dir is enabled ONCE at
+# startup (seeding it would re-run the process-global cache setup on
+# every query's apply_session)
+_ETC_STRUCTURAL_KEYS = frozenset({
+    "page-rows", "query.max-memory-bytes", "compile-cache.dir",
+})
+
+
 def load_node_config(etc_dir: str) -> Dict[str, str]:
     """etc/config.properties, empty when absent (reference: the node/
     service tier; keys consumed by serve_from_etc below)."""
@@ -145,30 +203,15 @@ def server_from_etc(etc_dir: str, port: Optional[int] = None, **kw):
     )
     page_rows = int(conf.get("page-rows", str(1 << 18)))
     # deployment-tier session defaults (reference: config-level system
-    # session property defaults): split-batch.size seeds
-    # split_batch_size for every query that doesn't override it —
-    # e.g. split-batch.size=64 forces split batching on, =false pins
-    # per-split launches fleet-wide
+    # session property defaults): EVERY session property is seedable
+    # from its registered etc key (ETC_SESSION_KEYS — e.g.
+    # split-batch.size=64 forces split batching fleet-wide,
+    # task-retry.attempts=0 pins the classic fail-query model);
+    # structural keys are consumed by the constructor wiring above
     session_defaults = dict(kw.pop("session_defaults", None) or {})
-    if conf.get("split-batch.size"):
-        session_defaults.setdefault(
-            "split_batch_size", conf["split-batch.size"]
-        )
-    # device-memory.budget seeds the HBM governor's budget for every
-    # query that doesn't override it (exec/membudget.py; 0 = auto)
-    if conf.get("device-memory.budget"):
-        session_defaults.setdefault(
-            "device_memory_budget", conf["device-memory.budget"]
-        )
-    # fault-tolerance tier defaults (ISSUE 5): task-retry.attempts /
-    # task-retry.backoff-ms govern DCN task re-dispatch and the
-    # executor's device-OOM degradation ladder; query.max-run-time-ms
-    # is the fleet-wide query deadline (reference: query.max-run-time)
-    for etc_key, prop in (
-        ("task-retry.attempts", "task_retry_attempts"),
-        ("task-retry.backoff-ms", "retry_backoff_ms"),
-        ("query.max-run-time-ms", "query_max_run_time"),
-    ):
+    for etc_key, prop in ETC_SESSION_KEYS.items():
+        if etc_key in _ETC_STRUCTURAL_KEYS:
+            continue
         if conf.get(etc_key):
             session_defaults.setdefault(prop, conf[etc_key])
     return PrestoTpuServer(

@@ -159,8 +159,8 @@ def install_standard_tables(sys_conn: SystemConnector, runner) -> None:
                 for t in conn.tables():
                     if _visible(cat, t):
                         out.append((cat, t))
-            except Exception:
-                continue
+            except Exception:  # noqa: BLE001 - catalog listings omit
+                continue      # broken connectors instead of failing
         return out
 
     def columns():
@@ -168,8 +168,8 @@ def install_standard_tables(sys_conn: SystemConnector, runner) -> None:
         for cat, conn in sorted(runner.catalogs.items()):
             try:
                 names = conn.tables()
-            except Exception:
-                continue
+            except Exception:  # noqa: BLE001 - catalog listings omit
+                continue      # broken connectors instead of failing
             for t in names:
                 if not _visible(cat, t):
                     continue

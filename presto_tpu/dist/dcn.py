@@ -62,6 +62,7 @@ import uuid
 from typing import Dict, List, Optional
 
 from presto_tpu.dist import plan_serde, serde
+from presto_tpu.exec import faults as FAULTS
 from presto_tpu.exec import plan as P
 from presto_tpu.exec.executor import QueryDeadlineExceeded
 from presto_tpu.server.heartbeat import HeartbeatFailureDetector
@@ -168,10 +169,8 @@ class DcnRunner:
         # fault-tolerance bookkeeping: nodes excluded after a mid-query
         # failure (re-admitted only on a fresh successful ping — a
         # rebooted worker on the same uri rejoins between queries, the
-        # reference's node-rejoin model); DELETE-release skips on dead
-        # workers (the scoped except path, counted not swallowed)
+        # reference's node-rejoin model)
         self._excluded: set = set()
-        self.release_skips = 0
         self._rng = random.Random()
         cat = default_catalog or next(iter(catalogs))
         self.runner = LocalRunner(
@@ -199,6 +198,14 @@ class DcnRunner:
         long-lived embedders (and the chaos harness) can shut it down
         instead of leaking a pinging daemon per runner."""
         self.heartbeat.stop()
+
+    @property
+    def release_skips(self) -> int:
+        """DELETE-release skips on dead workers. ONE owner — the
+        executor's registry counter (exec/counters.py), which
+        /metrics, system.metrics, and EXPLAIN ANALYZE render — so the
+        chaos harness and the fleet surfaces can never drift apart."""
+        return self.runner.executor.release_skips
 
     # ------------------------------------------------- session-prop knobs
     def _retry_attempts(self) -> int:
@@ -230,8 +237,16 @@ class DcnRunner:
                 msg = json.loads(e.read().decode()).get("error", "")
             except (ValueError, OSError):
                 msg = ""
+            # classify with the SHARED marker list (exec/faults.py):
+            # a worker-side device-memory fault is environmental — the
+            # retry message says so, and the coordinator's own OOM
+            # ladder stays out of it (is_device_fault's exact-type
+            # check rejects _TaskLost even though it quotes the text)
+            note = (" [worker device-memory fault]"
+                    if FAULTS.text_matches(msg) else "")
             raise _TaskLost(
-                f"task {task_id} FAILED on worker {uri}: {msg or e}",
+                f"task {task_id} FAILED on worker {uri}: "
+                f"{msg or e}{note}",
                 task_error=True,
             ) from e
 
@@ -527,6 +542,7 @@ class DcnRunner:
         fragment = plan_serde.dumps(partial)
         qid = uuid.uuid4().hex[:12]
         tasks: List[_TaskState] = []
+        check_payloads = ex._plan_check_on()
         for w, uri in enumerate(pool):
             payload = {
                 "taskId": f"{qid}.{w}",
@@ -539,6 +555,15 @@ class DcnRunner:
             if partition_cols is not None:
                 payload["splitMode"] = "hash"
                 payload["partitionColumns"] = partition_cols
+            if check_payloads:
+                # deterministic-split invariant (exec/plan_check.py):
+                # the PR-5 retry path re-generates EXACTLY this
+                # (splitIndex, splitCount) share on a survivor — a
+                # payload without it could not be re-dispatched.
+                # Same auto gate as the executor's plan verifier.
+                from presto_tpu.exec import plan_check as PC
+
+                PC.check_task_payload(payload)
             st = _TaskState(uri=uri, task_id=payload["taskId"],
                             payload=payload)
             try:
@@ -606,4 +631,9 @@ class DcnRunner:
                     )
                     urllib.request.urlopen(req, timeout=5).close()
                 except (urllib.error.URLError, OSError, TimeoutError):
-                    self.release_skips += 1  # dead worker: nothing to free
+                    # dead worker: nothing to free. Counted, not
+                    # swallowed — on the executor's registry counter
+                    # (exec/counters.py), the one copy every surface
+                    # (EXPLAIN ANALYZE, /metrics, system.metrics,
+                    # analyze_rung, DcnRunner.release_skips) reads
+                    ex.release_skips += 1

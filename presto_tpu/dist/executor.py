@@ -72,6 +72,18 @@ class DistExecutor(Executor):
         self.mesh = mesh
         self.D = int(mesh.devices.size)
         self._dist_cache: Dict[int, str] = {}
+        # hash_partition_count session property: devices that RECEIVE
+        # repartitioned rows (0 = whole mesh). Routing and residue
+        # filters share _route_devices so both sides of a partitioned
+        # stage agree on the partition function.
+        self.hash_partitions = 0
+
+    def _route_devices(self) -> int:
+        """Devices used for repartitioned stages (reference:
+        hash_partition_count): hash routing targets devices
+        0..P-1; the whole mesh still executes the programs."""
+        hp = int(self.hash_partitions or 0)
+        return min(hp, self.D) if hp > 0 else self.D
 
     # ------------------------------------------------- memory governor
     def _budget(self) -> int:
@@ -381,12 +393,13 @@ class DistExecutor(Executor):
         default and the query retries with 4x landing capacity (SURVEY
         §6.7 — correctness under skew never depends on balance)."""
         D = self.D
+        P = self._route_devices()  # hash_partition_count (<= D)
         boost = self._capacity_boost
 
         def body(page: Page):
             R = page.capacity  # local rows per device
             h = self._key_hash(page, keys)
-            tgt = (h % jnp.uint64(D)).astype(jnp.int32)
+            tgt = (h % jnp.uint64(P)).astype(jnp.int32)
             tgt = jnp.where(page.valid, tgt, D)
             # stable-sort rows by destination, compute position within
             # each destination bucket
@@ -441,7 +454,7 @@ class DistExecutor(Executor):
                 (num > out_cap).astype(jnp.int32), "d") > 0
             return out, overflow
 
-        key = ("d_repart", keys, self.D, boost)
+        key = ("d_repart", keys, self.D, P, boost)
         if key not in self._jit_cache:
             self._jit_cache[key] = self._fenced(jax.jit(jax.shard_map(
                 body, mesh=self.mesh, in_specs=(PS("d"),),
@@ -452,16 +465,16 @@ class DistExecutor(Executor):
     def _residue_fn(self, keys: Tuple[int, ...]):
         """Replicated -> sharded: device i keeps rows with
         hash(keys) % D == i (no data movement; the replica is local)."""
-        D = self.D
+        P = self._route_devices()  # must agree with _repartition_fn
 
         def body(page: Page):
             me = jax.lax.axis_index("d")
             h = self._key_hash(page, keys)
-            mine = (h % jnp.uint64(D)).astype(jnp.int32) == me
+            mine = (h % jnp.uint64(P)).astype(jnp.int32) == me
             out = page.with_valid(page.valid & mine)
             return out, jnp.asarray(False)
 
-        key = ("d_residue", keys, self.D)
+        key = ("d_residue", keys, self.D, P)
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(jax.shard_map(
                 body, mesh=self.mesh, in_specs=(PS(),),

@@ -1,0 +1,111 @@
+"""THE execution-counter registry.
+
+Reference: presto-main OperatorStats/QueryStats — every runtime counter
+the engine maintains is declared once and every surfacing layer
+renders the same declared set (JMX beans enumerate the declared stats;
+nothing is hand-listed per endpoint). Before this registry each
+counter was wired by hand into EXPLAIN ANALYZE, /metrics,
+system.metrics, and analyze_rung separately — and PR after PR the
+wiring drifted (split_batch_fallbacks and the spill counters never
+reached /metrics at all). Now:
+
+  - QUERY_COUNTERS declares every integer counter the Executor (and
+    the DCN coordinator, via mirrored attributes) maintains;
+  - Executor.execute_with_stats builds its EXPLAIN ANALYZE counter
+    dict FROM the registry (plus the few computed entries listed in
+    COMPUTED_COUNTERS);
+  - the HTTP server's /metrics exposition and system.metrics table
+    iterate the registry;
+  - tools/analyze_rung.py prints every key of the stats dict, so
+    registry membership IS analyze_rung coverage;
+  - tools/lint's `counters` rule fails the build when a `self.x += 1`
+    counter in exec/ or dist/ is missing from the registry.
+
+Adding a counter = initialize it to 0 in Executor.__init__, increment
+it, and add one row here; every surface picks it up.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# attr name on Executor -> (prometheus kind, help text).
+# "counter" = monotonically increasing over the executor's lifetime or
+# per query; "gauge" = per-attempt/per-query level.
+QUERY_COUNTERS: Dict[str, tuple] = {
+    "gathers_deferred": (
+        "gauge", "per-page column gathers skipped at join-output time "
+        "(late materialization; per-attempt)"),
+    "gathers_materialized": (
+        "gauge", "per-page column value gathers actually performed "
+        "(late-materialization lift + chain-boundary finish)"),
+    "fused_partial_aggs": (
+        "gauge", "scan→filter→project→partial-agg chains compiled to "
+        "one XLA program per split this attempt"),
+    "program_launches": (
+        "gauge", "fused-scan program launches this attempt "
+        "(split-batched execution)"),
+    "splits_scanned": (
+        "gauge", "real (unpadded) splits covered by this attempt's "
+        "fused-scan launches — splits_per_launch is the ratio"),
+    "split_batch_fallbacks": (
+        "counter", "streams that fell back to the per-split loop "
+        "because the chain did not trace under vmap/scan"),
+    "generated_joins_used": (
+        "counter", "build-free generated joins taken (lifetime; "
+        "EXPLAIN ANALYZE reports the per-query delta)"),
+    "pallas_joins_used": (
+        "counter", "Pallas join kernel engagements (lifetime; EXPLAIN "
+        "ANALYZE reports the per-query delta)"),
+    "programs_compiled": (
+        "gauge", "real XLA backend compiles attributed to this query "
+        "(a persistent-cache hit counts as program_cache_hits)"),
+    "program_cache_hits": (
+        "gauge", "persistent compile-cache hits attributed to this "
+        "query"),
+    "spill_partitions_used": (
+        "gauge", "grace-partition passes taken by joins/aggregations "
+        "this query (spill_threshold_bytes / governed sizing)"),
+    "host_spill_pages": (
+        "gauge", "intermediate pages staged to host RAM this query "
+        "(PageStore host tier)"),
+    "disk_spill_pages": (
+        "gauge", "intermediate pages written to disk spill files this "
+        "query (PageStore disk tier)"),
+    "skew_chunks_used": (
+        "gauge", "hot grace-join partitions rebalanced by position "
+        "chunking on boosted retries"),
+    "memory_chunked_pipelines": (
+        "gauge", "pipelines the HBM governor rewrote into "
+        "chunked/streaming form this attempt (exec/membudget.py)"),
+    "device_oom_retries": (
+        "gauge", "device-OOM re-entries this query, each under a "
+        "halved device-memory budget"),
+    "task_retries": (
+        "counter", "DCN fragments re-dispatched to a surviving worker "
+        "(coordinator lifetime)"),
+    "workers_excluded": (
+        "counter", "DCN nodes dropped from the dispatch pool after a "
+        "mid-query failure (coordinator lifetime)"),
+    "release_skips": (
+        "counter", "worker page-buffer DELETE releases skipped because "
+        "the worker was unreachable (dead-worker cleanup, counted not "
+        "swallowed; mirrored from the DCN coordinator)"),
+}
+
+# stats-dict entries that are COMPUTED in execute_with_stats rather
+# than read off an executor attribute (the lint's counters rule knows
+# not to look for `self.<name> +=` sites for these).
+COMPUTED_COUNTERS = (
+    "splits_per_launch",     # splits_scanned / program_launches
+    "compile_wall_s",        # float wall, not an int counter
+    "peak_device_bytes",     # high-water gauge (max, not +=)
+    "deadline_ms_remaining",  # derived from query_deadline
+)
+
+
+def snapshot(ex) -> Dict[str, int]:
+    """Registry-driven counter snapshot of one executor — the shared
+    source for /metrics and system.metrics (missing attributes read 0
+    so a bare Executor and a DCN coordinator render the same rows)."""
+    return {name: int(getattr(ex, name, 0)) for name in QUERY_COUNTERS}

@@ -29,8 +29,10 @@ import numpy as np
 
 from presto_tpu import compilecache as CC
 from presto_tpu import types as T
+from presto_tpu.exec import counters as CTRS
 from presto_tpu.connectors.base import Connector
 from presto_tpu.exec import agg_states as S
+from presto_tpu.exec import faults as FAULTS
 from presto_tpu.exec import latemat as LM
 from presto_tpu.exec import membudget as MB
 from presto_tpu.exec import plan as P
@@ -70,7 +72,7 @@ def _row_bytes(types) -> int:
         else:
             try:
                 total += np.dtype(t.numpy_dtype).itemsize
-            except Exception:
+            except (TypeError, AttributeError):  # dict-coded/state
                 total += 8
     return total
 
@@ -191,31 +193,12 @@ class QueryDeadlineExceeded(RuntimeError):
     can never outlive its deadline by more than one launch."""
 
 
-_DEVICE_FAULT_MARKERS = (
-    "RESOURCE_EXHAUSTED",
-    "Out of memory",
-    "out of memory",
-    "Failed to allocate",
-)
-
-
-def _is_device_fault(e: BaseException) -> bool:
-    """Whether an exception is a device memory/allocation fault the
-    OOM-degradation ladder may absorb. Deliberately conservative:
-    only XlaRuntimeError and EXACTLY RuntimeError (the runtime's and
-    the fault hook's type) are eligible — engine control-flow
-    exceptions (DcnQueryFailed, MemoryBudgetExceeded, ...) subclass
-    RuntimeError and are rejected by the exact-type check even when
-    they QUOTE a worker's device-fault text, so a worker-side OOM
-    surfaced through the coordinator never triggers a useless
-    budget-halved re-run of the whole query. The memory markers must
-    match for BOTH types: a non-memory XlaRuntimeError (INVALID_ARGUMENT,
-    INTERNAL, ...) is a bug to surface, not a footprint to shrink."""
-    if type(e).__name__ != "XlaRuntimeError" and \
-            type(e) is not RuntimeError:
-        return False
-    msg = str(e)
-    return any(m in msg for m in _DEVICE_FAULT_MARKERS)
+# The device-fault classifier lives in exec/faults.py (shared with the
+# DCN coordinator so the marker list cannot drift between the local
+# OOM-degradation ladder and worker-error recognition); these aliases
+# keep the executor's historical private names importable.
+_DEVICE_FAULT_MARKERS = FAULTS.DEVICE_FAULT_MARKERS
+_is_device_fault = FAULTS.is_device_fault
 
 
 def page_bytes(page: Page) -> int:
@@ -455,8 +438,56 @@ class Executor:
         # workers_excluded = nodes dropped from the query's pool.
         self.task_retries = 0
         self.workers_excluded = 0
+        # release_skips = dead-worker page-buffer DELETE releases
+        # skipped (DcnRunner mirrors its own count here so every
+        # counter surface — EXPLAIN ANALYZE, /metrics, system.metrics,
+        # analyze_rung — reads one registry off one object;
+        # exec/counters.py)
+        self.release_skips = 0
+        # plan_check (exec/plan_check.py): pre-compile verification of
+        # the physical plan — schema-consistent edges, ladder/fault-line
+        # capacities, canonical jit-key material, split determinism.
+        # "auto" = on under pytest and bench --prewarm (the build/test
+        # surface), off on the hot serving path; True/False force.
+        self.plan_check = "auto"
 
     # ------------------------------------------------------------ plumbing
+    def _plan_check_on(self) -> bool:
+        pc = self.plan_check
+        if pc in (True, "true", "on"):
+            return True
+        if pc in (False, "false", "off", 0):
+            return False
+        env = os.environ.get("PRESTO_TPU_PLAN_CHECK", "").lower()
+        if env in ("0", "false", "off"):
+            return False  # explicit operator opt-out wins over auto
+        # only an explicit opt-IN enables outside pytest — a typo'd
+        # env value must not force the verifier onto the serving path
+        return bool(os.environ.get("PYTEST_CURRENT_TEST")
+                    or env in ("1", "true", "on"))
+
+    def _verify_plan(self, node: P.PhysicalNode) -> None:
+        """Run the pre-compile plan verifier when enabled (auto = test
+        and prewarm surfaces only — the serving path pays nothing).
+        A clean verdict is memoized per (plan object, sizing knobs) —
+        retry ladders and repeated executions of one plan re-verify
+        nothing; the held references keep id() stable."""
+        if not self._plan_check_on():
+            return
+        key = (id(node), self.device_memory_budget, self.fault_rows,
+               self.page_rows)
+        cache = getattr(self, "_plan_check_memo", None)
+        if cache is None:
+            cache = self._plan_check_memo = {}
+        if key in cache:
+            return
+        from presto_tpu.exec import plan_check as PC
+
+        PC.verify(self, node)
+        if len(cache) >= 16:
+            cache.clear()
+        cache[key] = node  # keep the ref so id() cannot be reused
+
     def _jit(self, key, fn, static_argnums=()):
         """One jit wrapper per CANONICAL program key. Keys name exactly
         the inputs that shape the traced program (the kernel's bound
@@ -1398,6 +1429,11 @@ class Executor:
         )
         cc_base = CC.snapshot()
         oom_left = self.device_oom_attempts
+        # pre-compile plan verification (exec/plan_check.py): schema-
+        # consistent edges, ladder/fault-line capacities, canonical
+        # jit-key material — auto-on under pytest and bench --prewarm,
+        # off on the hot serving path (plan_check session property)
+        self._verify_plan(node)
         try:
             attempts = 0
             while attempts < 6:
@@ -1486,6 +1522,9 @@ class Executor:
         self._oom_divisor = 1
         cc_base = CC.snapshot()
         oom_left = self.device_oom_attempts
+        # same pre-compile verification as execute(): a shipped
+        # fragment is a plan tree too (worker-side task runtime)
+        self._verify_plan(node)
         try:
             attempts = 0
             while attempts < 6:
@@ -1540,8 +1579,9 @@ class Executor:
         for store in self._stream_cache.values():
             try:
                 store.close()
-            except Exception:  # pragma: no cover - best-effort cleanup
-                pass
+            except Exception:  # noqa: BLE001 - best-effort close; a
+                pass           # failed spill-dir sweep must not mask
+                # the query's own result/error path
         self._stream_cache = {}
 
     def _account_page(self, page: Page) -> None:
@@ -1579,44 +1619,30 @@ class Executor:
         # join counters report as THIS query's delta over the snapshot
         # execute() took.
         base_gen, base_pal = getattr(self, "_joins_counter_base", (0, 0))
-        stats["counters"] = {
-            "gathers_deferred": self.gathers_deferred,
-            "gathers_materialized": self.gathers_materialized,
-            "fused_partial_aggs": self.fused_partial_aggs,
-            # split-batched execution (ROOFLINE §7): fused-scan
-            # program launches this attempt and the real splits they
-            # covered — splits_per_launch > 1 means the per-split
-            # driver loop folded into XLA
-            "program_launches": self.program_launches,
-            "splits_per_launch": (
-                round(self.splits_scanned / self.program_launches, 1)
-                if self.program_launches else 0.0
-            ),
-            "generated_joins_used": self.generated_joins_used - base_gen,
-            "pallas_joins_used": self.pallas_joins_used - base_pal,
-            # compile-cost deltas for THIS query (compilecache.py):
-            # warmed runs report programs_compiled=0
-            "programs_compiled": self.programs_compiled,
-            "program_cache_hits": self.program_cache_hits,
-            "compile_wall_s": self.compile_wall_s,
-            # device-memory governor (membudget.py): the attempt's
-            # largest single device buffer and how many pipelines the
-            # governor rewrote into chunked/streaming form
-            "peak_device_bytes": self.peak_memory_bytes,
-            "memory_chunked_pipelines": self.memory_chunked_pipelines,
-            # fault tolerance (ISSUE 5): device-OOM re-entries this
-            # query; DCN task re-dispatches / node exclusions (the
-            # coordinator maintains these on ITS executor —
-            # lifetime-cumulative, spanning submit and fetch); wall
-            # left under query_max_run_time (-1 = no deadline)
-            "device_oom_retries": self.device_oom_retries,
-            "task_retries": self.task_retries,
-            "workers_excluded": self.workers_excluded,
-            "deadline_ms_remaining": (
-                int((self.query_deadline - time.monotonic()) * 1000)
-                if self.query_deadline is not None else -1
-            ),
-        }
+        # registry-driven (exec/counters.py): every declared counter
+        # surfaces here — and therefore in EXPLAIN ANALYZE text and
+        # analyze_rung, which render all keys — with no per-counter
+        # hand wiring. The lifetime-cumulative join counters override
+        # to THIS query's delta over the snapshot execute() took.
+        ctr = CTRS.snapshot(self)
+        ctr["generated_joins_used"] = self.generated_joins_used - base_gen
+        ctr["pallas_joins_used"] = self.pallas_joins_used - base_pal
+        # computed entries (counters.COMPUTED_COUNTERS):
+        # splits_per_launch > 1 means the per-split driver loop folded
+        # into XLA (ROOFLINE §7); peak_device_bytes is the attempt's
+        # largest single device buffer (membudget.py); warmed runs
+        # report programs_compiled=0 with the wall under compile_wall_s
+        ctr["splits_per_launch"] = (
+            round(self.splits_scanned / self.program_launches, 1)
+            if self.program_launches else 0.0
+        )
+        ctr["compile_wall_s"] = self.compile_wall_s
+        ctr["peak_device_bytes"] = self.peak_memory_bytes
+        ctr["deadline_ms_remaining"] = (
+            int((self.query_deadline - time.monotonic()) * 1000)
+            if self.query_deadline is not None else -1
+        )
+        stats["counters"] = ctr
         return names, rows, stats
 
     # -------------------------------------------------------- aggregation

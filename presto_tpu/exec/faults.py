@@ -1,0 +1,51 @@
+"""THE device-fault classifier: one marker list, one predicate.
+
+Reference: presto-main's StandardErrorCode taxonomy — every layer that
+reacts to an error class consults the SAME classification (raw text
+matching scattered per call site is how retry ladders silently drift).
+Here the class is "device memory/allocation fault": the signal that
+admits an execution into the OOM-degradation ladder (executor
+execute()/stream_fragment() re-enter under a halved budget) and that
+the DCN coordinator uses to recognize a worker-side device fault
+quoted in an X-Task-Error payload. Both importers share this module so
+the marker list cannot drift between the local and distributed paths
+(ISSUE 6 satellite: the classifier was headed for copy-paste
+duplication in dist/dcn.py).
+"""
+
+from __future__ import annotations
+
+# Substrings that mark a device memory/allocation failure in XLA / TPU
+# runtime error text (RESOURCE_EXHAUSTED is the canonical status; the
+# allocator variants appear on CPU/older stacks).
+DEVICE_FAULT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "Failed to allocate",
+)
+
+
+def text_matches(msg: str) -> bool:
+    """Whether error TEXT carries a device-memory marker — the half of
+    the classification the DCN coordinator can apply to a worker's
+    quoted error string (no exception object crosses the wire)."""
+    return any(m in msg for m in DEVICE_FAULT_MARKERS)
+
+
+def is_device_fault(e: BaseException) -> bool:
+    """Whether an exception is a device memory/allocation fault the
+    OOM-degradation ladder may absorb. Deliberately conservative:
+    only XlaRuntimeError and EXACTLY RuntimeError (the runtime's and
+    the fault hook's type) are eligible — engine control-flow
+    exceptions (DcnQueryFailed, MemoryBudgetExceeded, ...) subclass
+    RuntimeError and are rejected by the exact-type check even when
+    they QUOTE a worker's device-fault text, so a worker-side OOM
+    surfaced through the coordinator never triggers a useless
+    budget-halved re-run of the whole query. The memory markers must
+    match for BOTH types: a non-memory XlaRuntimeError (INVALID_ARGUMENT,
+    INTERNAL, ...) is a bug to surface, not a footprint to shrink."""
+    if type(e).__name__ != "XlaRuntimeError" and \
+            type(e) is not RuntimeError:
+        return False
+    return text_matches(str(e))

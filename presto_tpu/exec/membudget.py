@@ -83,8 +83,8 @@ def device_hbm_bytes() -> Optional[int]:
             )
             if limit:
                 return int(limit)
-    except Exception:
-        pass
+    except Exception:  # noqa: BLE001 - memory_stats is an optional
+        pass           # backend API; absence means "unknown HBM"
     return None
 
 

@@ -171,5 +171,5 @@ class PageStore:
     def __del__(self):  # best-effort file cleanup
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: BLE001 - __del__ must never raise
             pass

@@ -35,7 +35,7 @@ def _int_domain(t: T.SqlType) -> bool:
         import numpy as np
 
         return np.issubdtype(np.dtype(t.numpy_dtype), np.integer)
-    except Exception:
+    except (TypeError, AttributeError):  # dict-coded/state types
         return False
 
 

@@ -76,8 +76,8 @@ def _json_canon(v) -> str:
 def _parse_json(s):
     try:
         return json.loads(s)
-    except Exception:
-        return _JSON_BAD
+    except Exception:  # noqa: BLE001 - malformed JSON is a value
+        return _JSON_BAD  # (SQL json functions return NULL), not an error
 
 
 _JSON_BAD = object()
@@ -295,8 +295,8 @@ def _string_cast_val(ctx: Ctx, col: Val, to: T.SqlType) -> Val:
         # string literal: parse once on the host
         try:
             r = _parse_scalar(str(col.py_value), to)
-        except Exception:
-            r = None
+        except Exception:  # noqa: BLE001 - SQL CAST yields NULL on
+            r = None       # unparseable input, not a query failure
         if r is None:
             return Val(
                 ctx.xp.zeros((ctx.capacity,),
@@ -312,8 +312,8 @@ def _string_cast_val(ctx: Ctx, col: Val, to: T.SqlType) -> Val:
     def one(v):
         try:
             return _parse_scalar(str(v), to)
-        except Exception:
-            return None
+        except Exception:  # noqa: BLE001 - SQL CAST yields NULL on
+            return None    # unparseable input, not a query failure
 
     return _elem_result_val(ctx, col, [one(v) for v in d.values], to)
 
@@ -415,8 +415,8 @@ def _url_part(part: str):
     def one(v):
         try:
             u = urllib.parse.urlsplit(str(v))
-        except Exception:
-            return None
+        except Exception:  # noqa: BLE001 - url functions yield NULL
+            return None    # on malformed input (reference semantics)
         if part == "protocol":
             return u.scheme or None
         if part == "host":
@@ -460,8 +460,8 @@ def _impl_url_extract_parameter(ctx: Ctx, rt, vals: List[Val]) -> Val:
         try:
             q = urllib.parse.urlsplit(str(v)).query
             params = urllib.parse.parse_qs(q, keep_blank_values=True)
-        except Exception:
-            return None
+        except Exception:  # noqa: BLE001 - url functions yield NULL
+            return None    # on malformed input (reference semantics)
         vs = params.get(name)
         return vs[0] if vs else None
 
@@ -791,8 +791,8 @@ def _impl_date_parse(ctx: Ctx, rt, vals: List[Val]) -> Val:
     def one(v):
         try:
             dt = datetime.datetime.strptime(str(v), pyfmt)
-        except Exception:
-            return None
+        except Exception:  # noqa: BLE001 - unparseable datetime text
+            return None    # yields NULL (reference semantics)
         epoch = datetime.datetime(1970, 1, 1)
         return int((dt - epoch).total_seconds() * 1_000_000)
 
@@ -823,8 +823,8 @@ def _impl_from_hex(ctx: Ctx, rt, vals: List[Val]) -> Val:
     def one(v):
         try:
             return bytes.fromhex(str(v)).decode("utf-8")
-        except Exception:
-            return None
+        except Exception:  # noqa: BLE001 - undecodable input yields
+            return None    # NULL (reference semantics)
 
     return _varchar_results(
         ctx, col, [one(v) for v in _dict_of(col).values]
@@ -871,8 +871,8 @@ def _impl_from_base64(ctx: Ctx, rt, vals: List[Val]) -> Val:
     def one(v):
         try:
             return base64.b64decode(str(v)).decode("utf-8")
-        except Exception:
-            return None
+        except Exception:  # noqa: BLE001 - undecodable input yields
+            return None    # NULL (reference semantics)
 
     return _varchar_results(
         ctx, vals[0], [one(v) for v in _dict_of(vals[0]).values]

@@ -381,8 +381,8 @@ def _impl_url_part(part):
     def one(v):
         try:
             u = urlparse(str(v))
-        except Exception:
-            return None
+        except Exception:  # noqa: BLE001 - url functions yield NULL
+            return None    # on malformed input (reference semantics)
         got = {
             "host": u.hostname, "path": u.path or "",
             "protocol": u.scheme, "query": u.query,
@@ -406,8 +406,8 @@ def _impl_url_port(ctx: Ctx, rt, vals: List[Val]) -> Val:
     def one(v):
         try:
             p = urlparse(str(v)).port
-        except Exception:
-            return None
+        except Exception:  # noqa: BLE001 - url functions yield NULL
+            return None    # on malformed input (reference semantics)
         return p
 
     d = _dict_of(_strcol(vals[0]))

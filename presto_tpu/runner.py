@@ -212,6 +212,14 @@ class LocalRunner:
         ex.device_memory_budget = int(
             self.session.get("device_memory_budget")
         )
+        # pre-compile plan verification (exec/plan_check.py): "auto"
+        # resolves inside the executor (on under pytest / prewarm)
+        ex.plan_check = self.session.get("plan_check")
+        # devices receiving repartitioned rows (0 = whole mesh);
+        # consumed by DistExecutor._route_devices — harmless no-op on
+        # the single-stream executor
+        ex.hash_partitions = int(
+            self.session.get("hash_partition_count"))
         # fault tolerance (ISSUE 5): task_retry_attempts also bounds
         # the executor's device-OOM re-entries (the same retry
         # discipline extended inward); query_max_run_time anchors a
@@ -298,8 +306,8 @@ class LocalRunner:
         floor = 1 << 24
         try:
             plan = self.plan(sql)
-        except Exception:
-            return floor
+        except Exception:  # noqa: BLE001 - non-query statements
+            return floor   # (DDL/SET/...) estimate at the floor
         ex = self.executor
         total = 0
 

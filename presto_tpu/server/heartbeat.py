@@ -50,6 +50,12 @@ class HeartbeatFailureDetector:
     after `fail_after` consecutive misses and returns to ALIVE on the
     first success (reference: success-rate window + expiry)."""
 
+    # lock discipline (tools/lint `locks` rule): the nodes map (and
+    # the NodeHealth records inside it) is shared between the
+    # background ping loop and query-path readers — every access goes
+    # through self._lock
+    _shared_attrs = ("nodes",)
+
     def __init__(
         self,
         node_uris: List[str],

@@ -97,6 +97,12 @@ class QueryManager:
     """Reference: execution/SqlQueryManager.java — registry + lifecycle
     (QUEUED -> RUNNING -> FINISHED/FAILED/CANCELED)."""
 
+    # lock discipline (tools/lint `locks` rule): attributes touched
+    # from both HTTP handler threads and query-execution threads —
+    # written ONLY under self._lock outside __init__
+    _shared_attrs = ("_queries", "_seq", "completed_by_state",
+                     "rows_returned_total", "query_wall_ms_total")
+
     def __init__(self, runner_factory, listeners=(),
                  resource_groups=None, memory_arbiter=None):
         self._runner_factory = runner_factory
@@ -238,7 +244,10 @@ class QueryManager:
                         q.set_session[stmt.name] = str(stmt.value)
                 if not q.cancelled:
                     q.state = "FINISHED"
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 - the protocol
+                # surfaces EVERY query failure as a FAILED state with
+                # an error body (reference: QueryResults.error), never
+                # as a dropped HTTP connection
                 if not q.cancelled:
                     q.error = {
                         "message": str(e)[:2000],
@@ -300,7 +309,7 @@ class QueryManager:
             ]
         if executor is not None:
             # device-memory governor (exec/membudget.py): resolved
-            # budget plus the last attempt's peak and rewrite count
+            # budget plus the last attempt's peak
             lines += [
                 "# TYPE presto_tpu_device_memory_budget_bytes gauge",
                 f"presto_tpu_device_memory_budget_bytes "
@@ -308,22 +317,22 @@ class QueryManager:
                 "# TYPE presto_tpu_peak_device_bytes gauge",
                 f"presto_tpu_peak_device_bytes "
                 f"{executor.peak_memory_bytes}",
-                "# TYPE presto_tpu_memory_chunked_pipelines gauge",
-                f"presto_tpu_memory_chunked_pipelines "
-                f"{executor.memory_chunked_pipelines}",
-                # fault tolerance (dist/dcn.py + the executor's
-                # device-OOM degradation ladder): recovery actions are
-                # fleet-observable, not silent
-                "# TYPE presto_tpu_task_retries_total counter",
-                f"presto_tpu_task_retries_total "
-                f"{getattr(executor, 'task_retries', 0)}",
-                "# TYPE presto_tpu_workers_excluded_total counter",
-                f"presto_tpu_workers_excluded_total "
-                f"{getattr(executor, 'workers_excluded', 0)}",
-                "# TYPE presto_tpu_device_oom_retries gauge",
-                f"presto_tpu_device_oom_retries "
-                f"{getattr(executor, 'device_oom_retries', 0)}",
             ]
+            # every declared execution counter (exec/counters.py): the
+            # registry IS the exposition list, so a counter added to
+            # the engine cannot silently miss the fleet surface (the
+            # pre-registry wiring lost split_batch_fallbacks and the
+            # spill counters). Lifetime counters keep their historical
+            # _total suffix.
+            from presto_tpu.exec import counters as CTRS
+
+            snap = CTRS.snapshot(executor)
+            for name, (kind, _help) in CTRS.QUERY_COUNTERS.items():
+                suffix = "_total" if kind == "counter" else ""
+                lines += [
+                    f"# TYPE presto_tpu_{name}{suffix} {kind}",
+                    f"presto_tpu_{name}{suffix} {snap[name]}",
+                ]
         return "\n".join(lines) + "\n"
 
 
@@ -609,8 +618,8 @@ class PrestoTpuServer:
             import jax
 
             self.backend_name = jax.default_backend()
-        except Exception:  # pragma: no cover
-            self.backend_name = "unknown"
+        except Exception:  # noqa: BLE001 - /v1/info stays serveable
+            self.backend_name = "unknown"  # without a jax runtime
 
         # bootstrap runner installs plugins into catalogs/registries;
         # it also serves the serial (no-arbiter) path
@@ -631,7 +640,10 @@ class PrestoTpuServer:
             memory_arbiter = MemoryArbiter(memory_budget_bytes)
 
         # fail-fast validation: a bad deployment default (unknown name,
-        # rejected value) must abort startup, not fail every query
+        # rejected value) must abort startup, not fail every query.
+        # Kept introspectable (tests/test_config_etc.py verifies the
+        # etc-registry plumbing against it; SHOW-style tooling can too)
+        self.session_defaults = dict(session_defaults or {})
         if session_defaults:
             Session(properties=session_defaults)
 
@@ -729,17 +741,13 @@ class PrestoTpuServer:
             ex = self._runner.executor
             out.append(("device_memory_budget_bytes", ex._budget()))
             out.append(("peak_device_bytes", ex.peak_memory_bytes))
-            out.append(("memory_chunked_pipelines",
-                        ex.memory_chunked_pipelines))
-            # fault tolerance: task re-dispatches / node exclusions
-            # (DCN coordinator) and device-OOM degradations, queryable
-            # with SQL like every other engine metric
-            out.append(("task_retries",
-                        getattr(ex, "task_retries", 0)))
-            out.append(("workers_excluded",
-                        getattr(ex, "workers_excluded", 0)))
-            out.append(("device_oom_retries",
-                        getattr(ex, "device_oom_retries", 0)))
+            # every declared execution counter (exec/counters.py),
+            # queryable with SQL like every other engine metric — the
+            # same registry /metrics and EXPLAIN ANALYZE render, so
+            # the three surfaces cannot drift
+            from presto_tpu.exec import counters as CTRS
+
+            out.extend(sorted(CTRS.snapshot(ex).items()))
             return out
 
         sys_conn.register(

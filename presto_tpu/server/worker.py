@@ -572,7 +572,10 @@ class WorkerServer:
             with task.lock:
                 task.pages.extend(blobs)
                 task.done = True
-        except Exception as e:  # pragma: no cover - error path
+        except Exception as e:  # noqa: BLE001 - task failures surface
+            # to the coordinator via the X-Task-Error results header
+            # (real error text, no fetch-retry spinning), never as a
+            # hung task
             with task.lock:
                 task.error = repr(e)[:400]
                 task.done = True

@@ -278,6 +278,19 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0,
         ),
         PropertyMetadata(
+            "plan_check",
+            "pre-compile plan verification (exec/plan_check.py): "
+            "schema-consistent operator/fragment edges, ladder-"
+            "quantized capacities under the device fault line, "
+            "canonical jit-cache key material, deterministic split "
+            "assignment fields. auto = on under pytest and bench "
+            "--prewarm, off on the hot serving path; true/false "
+            "force. Violations fail the query BEFORE compile with a "
+            "pointed PlanCheckError",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

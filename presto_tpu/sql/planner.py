@@ -290,8 +290,8 @@ def _agg_capacity(node: P.PhysicalNode, catalogs) -> int:
 
     try:
         est = est_rows(node, catalogs)
-    except Exception:
-        est = 1 << 16
+    except Exception:  # noqa: BLE001 - estimation must never fail
+        est = 1 << 16  # planning; unknown shapes get a default
     return max(4096, min(int(est), 1 << 22))
 
 

@@ -11,6 +11,8 @@ import time
 import pytest
 
 from presto_tpu.config import (
+    ETC_SESSION_KEYS,
+    _ETC_STRUCTURAL_KEYS,
     load_catalogs,
     load_node_config,
     parse_properties,
@@ -78,6 +80,73 @@ def test_server_from_etc(etc):
         ).rows[0][0] == 25
     finally:
         srv.stop()
+
+
+# ---------------------------------------- etc-key <-> session registry
+# These assertions are GENERATED from config.ETC_SESSION_KEYS (ISSUE 6
+# satellite: no hand-maintained prop list to drift) — adding a session
+# property without registering an etc key fails tools/lint, and a
+# registered key that doesn't plumb through to a session default fails
+# here.
+
+def test_registry_covers_every_session_property():
+    from presto_tpu.session import SYSTEM_SESSION_PROPERTIES
+
+    mapped = set(ETC_SESSION_KEYS.values())
+    props = set(SYSTEM_SESSION_PROPERTIES)
+    assert props - mapped == set(), (
+        f"session properties without an etc key: {props - mapped}")
+    assert mapped - props == set(), (
+        f"etc keys naming unknown session properties: {mapped - props}")
+    assert _ETC_STRUCTURAL_KEYS <= set(ETC_SESSION_KEYS)
+
+
+def test_every_registered_etc_key_seeds_its_session_default(tmp_path):
+    """One synthesized config.properties row per NON-structural
+    registry entry; the server's session must show the seeded value
+    for every property (bool/int/str alike)."""
+    from presto_tpu.session import SYSTEM_SESSION_PROPERTIES, Session
+
+    def synth(prop):
+        """A value distinguishable from the default, valid per type."""
+        p = SYSTEM_SESSION_PROPERTIES[prop]
+        if p.type is bool:
+            return str(not p.default).lower()
+        if p.type is int:
+            return str(int(p.default) + 7)
+        if p.validate is not None:  # enum-domain strings
+            for cand in ("true", "false", "broadcast", "partitioned"):
+                if p.validate(cand) and cand != p.default:
+                    return cand
+        return "/tmp/etc-seeded" if "dir" in prop or "path" in prop \
+            else "etc-seeded"
+
+    (tmp_path / "catalog").mkdir()
+    (tmp_path / "catalog" / "tiny.properties").write_text(
+        "connector.name=tpch\ntpch.scale-factor=0.001\n")
+    lines = ["http-server.http.port=0"]
+    expect = {}
+    for etc_key, prop in sorted(ETC_SESSION_KEYS.items()):
+        if etc_key in _ETC_STRUCTURAL_KEYS:
+            # node-tier keys (incl. compile-cache.dir, whose seeding
+            # would re-run process-global cache setup per query) are
+            # consumed by the server constructor, not session defaults
+            continue
+        val = synth(prop)
+        lines.append(f"{etc_key}={val}")
+        expect[prop] = val
+    (tmp_path / "config.properties").write_text(
+        "\n".join(lines) + "\n")
+    srv = server_from_etc(str(tmp_path))
+    # the server seeds these into every query session that didn't set
+    # them (runner_factory); each must parse under the property's type
+    for prop, raw in sorted(expect.items()):
+        assert srv.session_defaults.get(prop) == raw, (
+            f"{prop}: etc key value {raw!r} did not reach the "
+            f"server's session defaults "
+            f"(got {srv.session_defaults.get(prop)!r})")
+        s = Session(properties={prop: raw})
+        assert s.is_set(prop)
 
 
 # ------------------------------------------------- hierarchical groups

@@ -1,0 +1,101 @@
+"""Static plan audit: sweep every bench-rung plan and the TPC-H/
+TPC-DS test corpus through the pre-compile plan verifier
+(exec/plan_check.py, strict mode) and exit nonzero on any violation.
+
+Reference: presto-verifier's suite replay, applied to PLANS instead of
+results — the point is catching invariant drift (schema-inconsistent
+edges, off-ladder capacities, non-canonical jit keys, missing split
+determinism) across the WHOLE query corpus before a PR lands, not
+after a bench rung hangs on real hardware. Planning is pure host
+Python; nothing traces, compiles, or touches a device, so the sweep
+is cheap enough for the pre-PR gate (tools/ci_static.sh) and for
+`bench.py --prewarm`, which runs the same verifier per rung.
+
+Usage:
+    python tools/plan_audit.py                 # rungs + both corpora
+    python tools/plan_audit.py --rungs         # bench rungs only
+    python tools/plan_audit.py --corpus tpch   # one corpus only
+    python tools/plan_audit.py --sf 0.001      # corpus scale factor
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from tools._common import make_runner, queries  # noqa: E402
+
+
+def _audit_one(runner, label: str, sql: str, failures: list) -> None:
+    from presto_tpu.exec import plan_check as PC
+
+    try:
+        plan = runner.plan(sql)
+    except Exception as e:  # noqa: BLE001 - a plan failure is a verdict
+        failures.append((label, [f"planning failed: {e!r}"]))
+        print(f"# {label}: PLANNING FAILED {e!r}", file=sys.stderr)
+        return
+    try:
+        PC.verify(runner.executor, plan, strict=True)
+    except PC.PlanCheckError as e:
+        failures.append((label, e.violations))
+        print(f"# {label}: {len(e.violations)} violation(s)",
+              file=sys.stderr)
+        for v in e.violations:
+            print(f"#   - {v}", file=sys.stderr)
+    else:
+        print(f"# {label}: ok", file=sys.stderr)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rungs", action="store_true",
+                    help="bench rungs only")
+    ap.add_argument("--corpus", choices=("tpch", "tpcds", "all"),
+                    default=None, help="corpus only (default both "
+                    "plus rungs)")
+    ap.add_argument("--sf", type=float, default=0.001,
+                    help="corpus scale factor (planning-only)")
+    args = ap.parse_args()
+    do_rungs = args.rungs or args.corpus is None
+    corpora = ([] if args.rungs else
+               ["tpch", "tpcds"] if args.corpus in (None, "all")
+               else [args.corpus])
+
+    t0 = time.time()
+    failures: list = []
+    n = 0
+    if do_rungs:
+        from bench import RUNGS
+
+        for name, suite, qid, sf, props in RUNGS:
+            # plan at the rung's REAL scale + session props (generator
+            # connectors are lazy — row counts, not rows); the bench
+            # prewarm path verifies the same plans before compiling
+            runner = make_runner(suite, sf, props)
+            _audit_one(runner, f"rung {name}",
+                       queries(suite)[qid], failures)
+            n += 1
+    for suite in corpora:
+        runner = make_runner(suite, args.sf)
+        for qid, sql in sorted(queries(suite).items()):
+            _audit_one(runner, f"{suite} q{qid}", sql, failures)
+            n += 1
+    wall = time.time() - t0
+    print(f"# plan_audit: {n} plans, {len(failures)} with violations, "
+          f"{wall:.1f}s", file=sys.stderr)
+    if failures:
+        print("PLAN AUDIT FAILED:")
+        for label, violations in failures:
+            for v in violations:
+                print(f"  {label}: {v}")
+        return 1
+    print(f"plan audit clean: {n} plans verified in {wall:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
