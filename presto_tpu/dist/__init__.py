@@ -9,7 +9,13 @@ stage programs (all_to_all repartition, all_gather broadcast/gather)
 instead of serialized HTTP pages.
 """
 
-from presto_tpu.dist.fragmenter import add_exchanges
+from presto_tpu.dist.fragmenter import (
+    Fragment,
+    StageDag,
+    add_exchanges,
+    fragment_dag,
+)
 from presto_tpu.dist.executor import DistExecutor, make_mesh
 
-__all__ = ["add_exchanges", "DistExecutor", "make_mesh"]
+__all__ = ["add_exchanges", "fragment_dag", "Fragment", "StageDag",
+           "DistExecutor", "make_mesh"]

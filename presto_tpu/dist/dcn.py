@@ -164,6 +164,12 @@ class DcnRunner:
         # workers the last execute() actually submitted to (the
         # heartbeat-gated pool; FAILED nodes are never picked)
         self.last_pool: List[str] = []
+        # stage-DAG introspection: the last StageScheduler (per-stage
+        # pools, task placements) and an optional test/chaos hook
+        # called after each completed stage (deterministic mid-query
+        # fault injection)
+        self.last_scheduler = None
+        self._stage_hook = None
         self.session_props = dict(session_props or {})
         self.listeners = list(listeners)
         # fault-tolerance bookkeeping: nodes excluded after a mid-query
@@ -284,6 +290,10 @@ class DcnRunner:
             while True:
                 self._check_deadline(deadline)
                 try:
+                    # no ?part: the coordinator drains gather edges
+                    # only (partition 0 / legacy byte buffers) —
+                    # worker-to-worker partition fetches live in
+                    # dist/spool.fetch_spool_blobs
                     req = urllib.request.Request(
                         f"{st.uri}/v1/task/{st.task_id}/results/"
                         f"{st.next_token}"
@@ -345,6 +355,23 @@ class DcnRunner:
                     raise
                 self._sleep_backoff(attempt, deadline)
         return h.hexdigest() == st.hasher.hexdigest()
+
+    def _release_task(self, uri: str, task_id: str) -> None:
+        """DELETE one worker task's buffers/spools (reference: task
+        expiry). Scoped to transport errors ONLY — a programming error
+        in the release path must surface, not vanish; dead-worker
+        skips are counted, not swallowed silently — on the executor's
+        registry counter (exec/counters.py), the one copy every
+        surface (EXPLAIN ANALYZE, /metrics, system.metrics,
+        analyze_rung, DcnRunner.release_skips) reads. THE one release
+        site for both the legacy cuts and the stage-DAG scheduler."""
+        try:
+            req = urllib.request.Request(
+                f"{uri}/v1/task/{task_id}", method="DELETE"
+            )
+            urllib.request.urlopen(req, timeout=5).close()
+        except (urllib.error.URLError, OSError, TimeoutError):
+            self.runner.executor.release_skips += 1
 
     # ------------------------------------------------------- fault model
     def _exclude(self, uri: str) -> None:
@@ -464,11 +491,48 @@ class DcnRunner:
             )
             return
 
+    # ----------------------------------------------------- stage DAG
+    def _try_stage_dag(self, plan):
+        """Fragment the plan into a general stage DAG (ANY shape, not
+        just the three special-cased cuts). Returns a StageDag or None
+        when the plan is not worth/safe to DAG-distribute."""
+        from presto_tpu.dist.fragmenter import fragment_dag
+
+        return fragment_dag(
+            self.runner.executor, plan, self.runner.catalogs,
+            **self.runner._session_dist_options(),
+        )
+
+    def _execute_dag(self, dag):
+        """Run a fragmented DAG through the general stage scheduler
+        (dist/scheduler.py): spooled exchanges, non-leaf replay,
+        straggler speculation, per-stage pool recomputation."""
+        import uuid as _uuid
+
+        from presto_tpu.dist.scheduler import StageScheduler
+
+        self.last_distribution = "stage-dag"
+        sched = StageScheduler(self, dag, _uuid.uuid4().hex[:12],
+                               stage_hook=self._stage_hook)
+        self.last_scheduler = sched
+        return sched.run()
+
     # ---------------------------------------------------------- execute
     def execute(self, sql: str):
         plan = self.runner.plan(sql)
         ex = self.runner.executor
         retry_attempts = self._retry_attempts()
+        # general stage-DAG scheduling (ISSUE 7): "true" forces the
+        # DAG scheduler for every distributable plan; "auto" keeps the
+        # tuned legacy shapes first and engages the DAG only where
+        # they would fall back to a single process (closing ROADMAP
+        # item 1's "everything else runs on one worker" gap)
+        stage_mode = self.runner.session.get("stage_scheduler")
+        if stage_mode == "true":
+            dag = self._try_stage_dag(plan)
+            if dag is not None:
+                self.runner.apply_session()
+                return self._execute_dag(dag)
         cut = find_partial_cut(plan)
         partial = coord_plan = partition_cols = split_table = None
         if cut is not None:
@@ -504,6 +568,21 @@ class DcnRunner:
             ucut = (find_union_cut(plan, split_table)
                     if split_table is not None else None)
             if ucut is None:
+                if stage_mode == "auto":
+                    # the legacy shapes don't apply — exactly the gap
+                    # the general stage-DAG scheduler exists to close.
+                    # Auto mode preserves the pre-DAG contract for a
+                    # dead pool: such queries used to run locally, so
+                    # with no ALIVE workers we still fall back local
+                    # instead of failing (forced mode fails loudly,
+                    # like any distributable shape with no workers)
+                    dag = self._try_stage_dag(plan)
+                    if dag is not None and (
+                        self._alive_for_submit()
+                        if retry_attempts > 0 else self.worker_uris
+                    ):
+                        self.runner.apply_session()
+                        return self._execute_dag(dag)
                 # nothing distributable: run locally rather than wrong
                 # (no pool computed — local queries never pay dead-node
                 # probe timeouts)
@@ -619,21 +698,7 @@ class DcnRunner:
             return rows
         finally:
             ex.remote_sources.pop(key, None)
-            # release worker-side page buffers (reference: task expiry).
-            # Scoped to transport errors ONLY — a programming error in
-            # the release path must surface, not vanish; dead-worker
-            # skips are counted, not swallowed silently.
+            # release worker-side page buffers (reference: task
+            # expiry) — shared with the stage-DAG scheduler's cleanup
             for st in tasks:
-                try:
-                    req = urllib.request.Request(
-                        f"{st.uri}/v1/task/{st.task_id}",
-                        method="DELETE"
-                    )
-                    urllib.request.urlopen(req, timeout=5).close()
-                except (urllib.error.URLError, OSError, TimeoutError):
-                    # dead worker: nothing to free. Counted, not
-                    # swallowed — on the executor's registry counter
-                    # (exec/counters.py), the one copy every surface
-                    # (EXPLAIN ANALYZE, /metrics, system.metrics,
-                    # analyze_rung, DcnRunner.release_skips) reads
-                    ex.release_skips += 1
+                self._release_task(st.uri, st.task_id)

@@ -1,0 +1,570 @@
+"""General stage-DAG scheduler: walk a fragmented plan DAG in
+dependency order and dispatch it task-by-task across the DCN worker
+pool, with every inter-stage exchange SPOOLED on the producing worker.
+
+Reference: presto-main execution/scheduler/SqlQueryScheduler.java
+(stage-by-stage scheduling over PlanFragment DAGs) crossed with
+Project Tardigrade's fault-tolerant execution ("A Decade of SQL
+Analytics at Meta", VLDB 2023): stages run to completion and publish
+their output into durable-enough exchange spools (PageStore host/disk
+tiers on each worker, server/worker._TaskSpool), so recovery is a
+SCHEDULER POLICY rather than a special case —
+
+  - a lost LEAF task re-generates its split share deterministically on
+    a survivor (the PR-5 model, unchanged);
+  - a lost NON-LEAF task replays on a survivor by re-reading its input
+    partitions from the surviving upstream spools (`nonleaf_replays`),
+    something the un-spooled PR-5 model could not express at all;
+  - a dead node additionally invalidates the spools it hosted: every
+    task it ran that is still NEEDED (its consumers or the coordinator
+    have not finished with it) replays in topological order, and
+    consumers long-poll the replacement spools — no barrier logic, the
+    token-indexed data plane provides the waiting;
+  - straggler SPECULATION races a re-dispatched copy of a stage's
+    slowest task on another worker and takes whichever placement
+    finishes first (`speculative_tasks_won/lost`); fragments are
+    deterministic, so both copies produce byte-identical spools and
+    the loser is simply cancelled — nothing has consumed either copy
+    before the stage barrier;
+  - the worker pool is recomputed per STAGE (`DcnRunner.
+    _alive_for_submit`), so an excluded node whose heartbeat recovers
+    MID-QUERY rejoins at the next stage boundary instead of waiting
+    for the next query.
+
+The coordinator itself executes the DAG's root fragment, consuming the
+final stages through the PR-5 token-dedupe + sha256-verified-prefix
+fetch (dist/dcn._fetch_pages), so a node death during the final drain
+recovers the same way.
+
+Session properties: `stage_scheduler` (auto/true/false — auto engages
+when the legacy special-cased shapes don't apply), `speculation_enabled`,
+`spool_exchange_bytes` (worker-side spool tiering), plus the PR-5 knobs
+(`task_retry_attempts`, `retry_backoff_ms`, `query_max_run_time`)
+which govern replay exactly as they govern leaf retry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from presto_tpu.dist import plan_serde
+from presto_tpu.dist.fragmenter import (
+    StageDag,
+    clip_for_shipping,
+    stage_key,
+)
+
+
+@dataclasses.dataclass
+class _Placement:
+    uri: str
+    task_id: str
+
+
+@dataclasses.dataclass
+class _SchedTask:
+    """One logical task of one stage and its current placement."""
+
+    fid: int
+    index: int
+    base_id: str                      # qid.f<fid>.t<index>
+    placement: Optional[_Placement] = None
+    done: bool = False
+    counted: bool = False  # spooled pages counted once per LOGICAL
+    # task — a replay re-publishes the identical spool, not new volume
+    retries: int = 0
+    dispatched_at: float = 0.0
+    wall: float = 0.0
+    spec: Optional[_Placement] = None  # speculation copy in flight
+    spec_count: int = 0
+
+
+class _NodeDown(RuntimeError):
+    pass
+
+
+class StageScheduler:
+    """Schedules one StageDag over a DcnRunner's worker pool."""
+
+    def __init__(self, coord, dag: StageDag, qid: str,
+                 stage_hook=None):
+        self.coord = coord
+        self.dag = dag
+        self.qid = qid
+        self.ex = coord.runner.executor
+        # test/chaos hook: called with the fragment id after each
+        # stage completes (deterministic mid-query fault injection)
+        self.stage_hook = stage_hook
+        # introspection: the pool each stage dispatched over — pins
+        # the mid-query re-admission contract in tests
+        self.stage_pools: List[List[str]] = []
+        # shipped blobs carry only the origin chains type resolution
+        # needs (clip_for_shipping) — payloads stay linear in plan
+        # size down arbitrarily deep stage chains
+        self._frag_blob: Dict[int, str] = {
+            f.fid: plan_serde.dumps(clip_for_shipping(f.root))
+            for f in dag.fragments
+        }
+        self.tasks: Dict[int, List[_SchedTask]] = {}
+        self._root_done = False
+        self._ntasks: Dict[int, int] = {}
+
+    # ------------------------------------------------------ plumbing
+    def _retry_attempts(self) -> int:
+        return self.coord._retry_attempts()
+
+    def _deadline(self) -> Optional[float]:
+        return self.ex.query_deadline
+
+    def _check_deadline(self) -> None:
+        self.coord._check_deadline(self._deadline())
+
+    def _pool(self) -> List[str]:
+        from presto_tpu.dist.dcn import DcnQueryFailed
+
+        # task_retry_attempts=0 pins the classic model end to end,
+        # same as the legacy path: all configured workers are picked
+        # (no heartbeat gate, no silent placement changes) and the
+        # first submit/fetch failure fails the QUERY cleanly
+        pool = (self.coord._alive_for_submit()
+                if self._retry_attempts() > 0
+                else list(self.coord.worker_uris))
+        if not pool:
+            raise DcnQueryFailed(
+                f"no ALIVE workers among {self.coord.worker_uris} "
+                f"(stage-DAG scheduler)"
+            )
+        return pool
+
+    def _consumer_tasks(self, fid: int) -> int:
+        """Spool partition count of a repartition edge = the consumer
+        stage's task count (consumer task t reads partition t)."""
+        for f in self.dag.fragments:
+            if fid in f.inputs:
+                return self._ntasks[f.fid]
+        return 1  # root consumer (always a gather) or unknown
+
+    def _payload_for(self, t: _SchedTask, task_id: str) -> Dict:
+        frag = self.dag.fragment(t.fid)
+        n = self._ntasks[t.fid]
+        payload: Dict = {
+            "taskId": task_id,
+            "fragment": self._frag_blob[t.fid],
+            "splitIndex": t.index,
+            "splitCount": n,
+            "session": self.coord.session_props,
+        }
+        if frag.split_table is not None:
+            payload["splitTable"] = frag.split_table
+        if frag.output_kind == "repartition":
+            payload["outputPartitions"] = self._consumer_tasks(t.fid)
+            payload["outputKeys"] = list(frag.output_keys)
+        else:
+            payload["outputPartitions"] = 1
+        if frag.inputs:
+            # sources rebuilt from CURRENT placements at every
+            # (re)dispatch — a replayed consumer reads the replacement
+            # spools, not the dead node's
+            payload["sources"] = {
+                stage_key(u): {
+                    "partition": (
+                        t.index
+                        if self.dag.fragment(u).output_kind
+                        == "repartition" else 0
+                    ),
+                    "tasks": [
+                        {"uri": ut.placement.uri,
+                         "taskId": ut.placement.task_id}
+                        for ut in self.tasks[u]
+                    ],
+                }
+                for u in frag.inputs
+            }
+        return payload
+
+    def _post(self, uri: str, payload: Dict) -> None:
+        if self.ex._plan_check_on():
+            from presto_tpu.exec import plan_check as PC
+
+            PC.check_task_payload(payload)
+        self.coord._post_task(uri, payload)
+
+    def _status(self, pl: _Placement) -> Dict:
+        last: Optional[BaseException] = None
+        for _ in range(2):
+            try:
+                with urllib.request.urlopen(
+                    f"{pl.uri}/v1/task/{pl.task_id}", timeout=5
+                ) as r:
+                    return json.loads(r.read().decode())
+            except (urllib.error.URLError, ConnectionError,
+                    OSError) as e:
+                last = e
+                time.sleep(0.05)
+        raise _NodeDown(f"{pl.uri}: {last}")
+
+    def _delete(self, pl: _Placement) -> None:
+        self.coord._release_task(pl.uri, pl.task_id)
+
+    # -------------------------------------------------- run the DAG
+    def run(self) -> list:
+        """Execute the DAG; returns the materialized row list."""
+        dag, ex = self.dag, self.ex
+        pool0 = self._pool()
+        self.coord.last_pool = list(pool0)
+        n = len(pool0)
+        for f in dag.fragments:
+            self._ntasks[f.fid] = n if f.sharded else 1
+            self.tasks[f.fid] = [
+                _SchedTask(fid=f.fid, index=i,
+                           base_id=f"{self.qid}.f{f.fid}.t{i}")
+                for i in range(self._ntasks[f.fid])
+            ]
+        try:
+            for f in dag.fragments:
+                self._run_stage(f.fid)
+                if self.stage_hook is not None:
+                    self.stage_hook(f.fid)
+            # coordinator-side root fragment over the final stages
+            for fid in dag.root_inputs:
+                ex.remote_sources[stage_key(fid)] = \
+                    self._root_supplier(fid)
+            _, rows = ex.execute(dag.root)
+            self._root_done = True
+            return rows
+        finally:
+            for fid in dag.root_inputs:
+                ex.remote_sources.pop(stage_key(fid), None)
+            # release worker-side spools (task expiry); skips on dead
+            # workers are counted, never swallowed
+            for ts in self.tasks.values():
+                for t in ts:
+                    if t.placement is not None:
+                        self._delete(t.placement)
+                    if t.spec is not None:
+                        self._delete(t.spec)
+
+    # ------------------------------------------------------- stages
+    def _run_stage(self, fid: int) -> None:
+        # pool recomputed per stage: an excluded node whose heartbeat
+        # recovered rejoins HERE, mid-query (re-admission probes are
+        # rate-limited inside _alive_for_submit)
+        pool = self._pool()
+        self.stage_pools.append(list(pool))
+        stage = self.tasks[fid]
+        for t in stage:
+            if pool[t.index % len(pool)] in self.coord._excluded:
+                # an earlier submit in THIS wave excluded a node:
+                # refresh the pool so the remaining tasks neither
+                # burn their retry budget nor pay connect timeouts
+                # against a known-dead target
+                pool = self._pool()
+                self.stage_pools[-1] = list(pool)
+            target = pool[t.index % len(pool)]
+            try:
+                self._post(target, self._payload_for(t, t.base_id))
+                t.placement = _Placement(target, t.base_id)
+                t.dispatched_at = time.monotonic()
+            except (urllib.error.URLError, OSError) as e:
+                # submit failure: recover through the shared path
+                # (exclude + re-dispatch to a survivor) — not a spool
+                # replay, the task never ran (replay=False)
+                self.coord._exclude(target)
+                t.placement = _Placement(target, t.base_id)
+                self._redispatch(t, cause=e, replay=False)
+        self.ex.stages_scheduled += 1
+        self._wait(stage)
+        if self._retry_attempts() <= 0:
+            # pinned classic mode: no replay will ever need these
+            # spools again once the consumer stage is done — ack
+            # (release) consumed input partitions eagerly
+            self._ack_inputs(fid)
+
+    def _wait(self, stage: List[_SchedTask]) -> None:
+        # status polls back off geometrically (20 ms -> 250 ms cap):
+        # short tasks resolve fast, long stages stop hammering the
+        # workers' HTTP threads (which also serve the spool data plane)
+        delay = 0.02
+        while True:
+            self._check_deadline()
+            # replayed earlier-stage tasks ride along in the poll set:
+            # their completion unblocks this stage's long-polling
+            # consumers, and a FAILED replay must surface
+            pending = [t for ts in self.tasks.values() for t in ts
+                       if t.placement is not None and not t.done]
+            if all(t.done for t in stage):
+                return
+            progressed = False
+            for t in pending:
+                self._poll_task(t)
+                progressed = progressed or t.done
+            self._maybe_speculate(stage)
+            delay = 0.02 if progressed else min(delay * 1.5, 0.25)
+            time.sleep(delay)
+
+    def _poll_task(self, t: _SchedTask) -> None:
+        # speculation copy first: a finished copy wins immediately
+        if t.spec is not None:
+            try:
+                st = self._status(t.spec)
+                if st["state"] == "FINISHED":
+                    self.ex.speculative_tasks_won += 1
+                    loser = t.placement
+                    t.placement, t.spec = t.spec, None
+                    self._complete(t, st)
+                    if loser is not None:
+                        self._delete(loser)
+                    return
+                if st["state"] == "FAILED":
+                    t.spec = None  # copy died; original keeps running
+            except _NodeDown:
+                t.spec = None
+        try:
+            st = self._status(t.placement)
+        except _NodeDown:
+            self._node_lost(t.placement.uri)
+            return
+        if st["state"] == "FINISHED":
+            if t.spec is not None:
+                self.ex.speculative_tasks_lost += 1
+                self._delete(t.spec)
+                t.spec = None
+            self._complete(t, st)
+        elif st["state"] == "FAILED":
+            msg = str(st.get("error") or "task failed")
+            if "[source-lost " in msg:
+                # the task died because an UPSTREAM spool vanished:
+                # replay the upstream placements on that node first,
+                # then re-dispatch this consumer with rebuilt sources
+                src_uri = msg.split("[source-lost ", 1)[1].split()[0]
+                if src_uri:
+                    self._node_lost(src_uri)
+            self._redispatch(t, cause=RuntimeError(msg))
+
+    def _complete(self, t: _SchedTask, st: Dict) -> None:
+        t.done = True
+        t.wall = time.monotonic() - t.dispatched_at
+        if not t.counted:
+            t.counted = True
+            self.ex.spooled_exchange_pages += int(
+                st.get("spooledPages") or 0)
+
+    # ----------------------------------------------------- recovery
+    def _stage_done(self, fid: int) -> bool:
+        return all(t.done for t in self.tasks[fid])
+
+    def _still_needed(self, fid: int) -> bool:
+        """Whether a stage's spools can still be consumed: by a
+        not-yet-finished consumer stage, or by the coordinator's root
+        fragment until the query completes."""
+        if fid in self.dag.root_inputs and not self._root_done:
+            return True
+        return any(not self._stage_done(c)
+                   for c in self.dag.consumers(fid))
+
+    def _node_lost(self, uri: str) -> None:
+        """A node died: exclude it and replay, in topological order,
+        every task it hosted whose output is still needed — leaf tasks
+        re-generate their split share, non-leaf tasks re-read the
+        surviving upstream spools. Consumers long-poll the replacement
+        spools, so no explicit stage barrier is re-run.
+
+        Neededness is evaluated with EVERY hosted task pessimistically
+        marked un-done first: a dead node's stage-k spool is needed
+        whenever its stage-k+1 consumer (possibly on the same node)
+        must replay, even if stage k+1 had finished — evaluating
+        against the pre-death done flags would skip the upstream spool
+        and doom the consumer's first replay to a [source-lost]
+        failure, burning a retry."""
+        self.coord._exclude(uri)
+        cand = [
+            t for ts in self.tasks.values() for t in ts
+            if t.placement is not None and t.placement.uri == uri
+        ]
+        was_done = [(t, t.done) for t in cand]
+        for t in cand:
+            t.done = False
+        lost = [t for t, done in was_done
+                if not done or self._still_needed(t.fid)]
+        for t, done in was_done:
+            if done and t not in lost:
+                t.done = True  # genuinely unneeded: nothing consumes it
+        for t in sorted(lost, key=lambda x: x.fid):
+            self._redispatch(t, cause=_NodeDown(uri))
+
+    def _redispatch(self, t: _SchedTask, cause: BaseException,
+                    replay: bool = True) -> None:
+        """Re-dispatch one task to a survivor. replay=False marks an
+        initial-submit failure (the task never ran; nothing is being
+        replayed from a spool) so the nonleaf_replays counter stays an
+        honest measure of the spooled-replay path."""
+        from presto_tpu import events as E
+        from presto_tpu.dist.dcn import DcnQueryFailed
+
+        retry_attempts = self._retry_attempts()
+        deadline = self._deadline()
+        while True:
+            if retry_attempts <= 0 or t.retries >= retry_attempts:
+                raise DcnQueryFailed(
+                    f"stage task {t.base_id}: {cause} (task retries "
+                    f"exhausted: task_retry_attempts={retry_attempts})"
+                ) from cause
+            t.retries += 1
+            self.coord._sleep_backoff(t.retries, deadline)
+            self._check_deadline()
+            pool = self._pool()
+            old_uri = t.placement.uri if t.placement else None
+            survivors = sorted(pool, key=lambda u: u == old_uri)
+            target = survivors[(t.retries - 1) % len(survivors)]
+            new_id = f"{t.base_id}.r{t.retries}"
+            from_uri = old_uri or "?"
+            try:
+                self._post(target, self._payload_for(t, new_id))
+            except (urllib.error.URLError, OSError) as e:
+                self.coord._exclude(target)
+                cause = e
+                continue
+            if t.spec is not None:
+                # cancel an in-flight speculation copy of the OLD
+                # placement — orphaning it would leak its spool on
+                # the worker until task expiry
+                self._delete(t.spec)
+            t.placement = _Placement(target, new_id)
+            t.done = False
+            t.spec = None
+            t.dispatched_at = time.monotonic()
+            self.ex.task_retries += 1
+            if replay and self.dag.fragment(t.fid).inputs:
+                # the recovery the spool tier exists for: a NON-LEAF
+                # task replaying from spooled upstream pages
+                self.ex.nonleaf_replays += 1
+            E.dispatch(
+                self.coord.listeners, "task_retried",
+                E.TaskRetryEvent(
+                    query_id=self.qid, task_id=new_id,
+                    from_uri=from_uri, to_uri=target,
+                    attempt=t.retries, cause=str(cause)[:400],
+                )
+            )
+            return
+
+    # -------------------------------------------------- speculation
+    def _maybe_speculate(self, stage: List[_SchedTask]) -> None:
+        if not bool(self.coord.runner.session.get(
+                "speculation_enabled")):
+            return
+        running = [t for t in stage if not t.done]
+        if len(running) != 1:
+            return
+        t = running[0]
+        if t.spec is not None or t.spec_count >= 2 or \
+                t.placement is None:
+            return
+        walls = sorted(x.wall for x in stage if x.done)
+        if not walls:
+            return
+        median = walls[len(walls) // 2]
+        if time.monotonic() - t.dispatched_at < max(0.25, 2 * median):
+            return
+        others = [u for u in self.coord._alive_for_submit()
+                  if u != t.placement.uri]
+        if not others:
+            return
+        t.spec_count += 1
+        sid = f"{t.base_id}.s{t.spec_count}"
+        try:
+            self._post(others[0], self._payload_for(t, sid))
+            t.spec = _Placement(others[0], sid)
+        except (urllib.error.URLError, OSError):
+            pass  # speculation is best-effort; the original runs on
+
+    # --------------------------------------------------------- acks
+    def _ack_inputs(self, fid: int) -> None:
+        from presto_tpu.dist import spool as SPOOL
+
+        frag = self.dag.fragment(fid)
+        for u in frag.inputs:
+            up = self.dag.fragment(u)
+            parts = (range(len(self.tasks[fid]))
+                     if up.output_kind == "repartition" else (0,))
+            for ut in self.tasks[u]:
+                if ut.placement is None:
+                    continue
+                for part in parts:
+                    SPOOL.ack_spool(ut.placement.uri,
+                                    ut.placement.task_id, part)
+
+    # --------------------------------------------- root-stage drain
+    def _root_supplier(self, fid: int):
+        from presto_tpu.dist.dcn import DcnQueryFailed, _TaskLost
+        from presto_tpu.dist.dcn import _TaskState
+
+        stage = self.tasks[fid]
+
+        def supplier():
+            deadline = self._deadline()
+            for t in stage:
+                # fresh state per supplier invocation: a coordinator
+                # boosted retry re-pulls from token 0 (spools retain
+                # the full partition); within ONE invocation a
+                # replayed task resumes at the consumed token after
+                # sha256 prefix verification
+                st = _TaskState(
+                    uri=t.placement.uri,
+                    task_id=t.placement.task_id,
+                    payload=self._payload_for(
+                        t, t.placement.task_id),
+                )
+                while True:
+                    try:
+                        yield from self.coord._fetch_pages(st, deadline)
+                        break
+                    except _TaskLost as e:
+                        if self._retry_attempts() <= 0:
+                            raise DcnQueryFailed(str(e)) from e
+                        self._recover_root_fetch(t, st, e)
+
+        return supplier
+
+    def _recover_root_fetch(self, t: _SchedTask, st, cause) -> None:
+        from presto_tpu.dist.dcn import DcnQueryFailed
+
+        if getattr(cause, "task_error", False):
+            # same [source-lost] handling as _poll_task: if the task
+            # failed because an UPSTREAM spool vanished, replay that
+            # node's placements first, or every re-dispatch of this
+            # task would rebuild sources naming the same dead node
+            msg = str(cause)
+            if "[source-lost " in msg:
+                src_uri = msg.split("[source-lost ", 1)[1].split()[0]
+                if src_uri:
+                    self._node_lost(src_uri)
+            self._redispatch(t, cause=cause)
+        else:
+            # node death during the final drain: the dead node's
+            # still-needed tasks (this one included) replay in topo
+            # order; consumers long-poll the replacements
+            self._node_lost(st.uri)
+            if t.placement.uri == st.uri:
+                # the lost task was already done and its stage had no
+                # unfinished consumers tracked — force its own replay
+                self._redispatch(t, cause=cause)
+        if st.next_token and not self.coord._prefix_matches(
+            t.placement.uri, t.placement.task_id, st,
+            self._deadline()
+        ):
+            raise DcnQueryFailed(
+                f"task {t.placement.task_id}: the replayed placement "
+                f"regenerated a DIFFERENT page sequence for the "
+                f"already-consumed prefix ({st.next_token} pages) — "
+                f"non-deterministic fragment output; failing loudly "
+                f"instead of silently skipping or duplicating rows"
+            ) from cause
+        st.uri = t.placement.uri
+        st.task_id = t.placement.task_id

@@ -1,0 +1,301 @@
+"""Spooled-exchange data plane helpers for the stage-DAG scheduler.
+
+Reference: presto-main operator/PartitionedOutputOperator.java (the
+producer half of a hash-repartition exchange: route each row to a
+partition buffer by hash(keys) % P) and operator/ExchangeClient.java
+(the consumer half: token-acked page fetch from every producer task).
+The Project-Tardigrade twist: partition buffers are SPOOLED — they
+outlive the producing task's execution on the worker (PageStore
+host/disk tiers, exec/pagestore.py), so a lost downstream task replays
+from its upstream spools instead of failing the query.
+
+Everything here is host-side numpy on already-device_get pages: the
+partition split happens at the serialization boundary where the page
+has left the device anyway, so the device never pays for the exchange
+(SURVEY §6.8: HTTP shapes survive only at the pod boundary).
+
+Client split (deliberate, not drift): `fetch_spool_blobs` below is the
+WORKER-side exchange client — plain token-dedupe fetch between stage
+tasks. The COORDINATOR's drain of final stages keeps using
+`dcn.DcnRunner._fetch_pages`, which layers the PR-5 resume machinery
+(rolling sha256 of consumed bytes + byte-identical prefix verification
+after a replay) that worker-to-worker ingest does not need — a
+re-dispatched consumer restarts its stream from token 0. Both speak
+the same `/v1/task/{id}/results/{token}?part=p` protocol.
+
+Hash discipline: partitioning needs only SELF-consistency across the
+two sides of one exchange (co-partitioned join sides / all producers
+of one aggregation exchange), not agreement with the device kernels'
+hash. Keys hash from VALUE encodings — int64 bit-views, IEEE-754
+bit-views with -0.0/NaN normalization, dictionary VALUES (not codes) —
+mixed with a splitmix64 finalizer and the reference's 31*h+x combiner,
+so equal SQL values land in the same partition regardless of which
+producer task emitted them. NULL keys hash to a fixed sentinel (every
+null row lands on a deterministic partition — inner join keys never
+match NULL, and NULL group keys co-locate).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu.exec import shapes as SH
+from presto_tpu.ops.hashing import xxhash64_host
+from presto_tpu.page import Block, Page
+
+_MIX1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = np.uint64(0x94D049BB133111EB)
+_NULL_SENTINEL = np.uint64(0x9E3779B185EBCA87)
+_NAN_KEY = np.uint64(0xFFFFFFFFFFFFFFFF)
+_C31 = np.uint64(31)
+
+
+def _mix64(h: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer (vectorized, natural uint64 wraparound)."""
+    with np.errstate(over="ignore"):
+        h = (h ^ (h >> np.uint64(30))) * _MIX1
+        h = (h ^ (h >> np.uint64(27))) * _MIX2
+        return h ^ (h >> np.uint64(31))
+
+
+@functools.lru_cache(maxsize=64)
+def _dict_value_hashes(dictionary) -> np.ndarray:
+    """Per-code value hashes of one Dictionary, memoized — dictionaries
+    are shared across every page of a scan, and Dictionary hashes by
+    CONTENT, so the Python-level hashing loop runs once per distinct
+    dictionary instead of once per page per key channel."""
+    return np.array(
+        [xxhash64_host(repr(v).encode()) for v in dictionary.values],
+        dtype=np.uint64,
+    )
+
+
+def _block_value_u64(blk: Block) -> np.ndarray:
+    """Per-row uint64 VALUE encoding of one key block (host numpy)."""
+    data = blk.data
+    if isinstance(data, tuple):
+        # long decimal (hi, lo): combine the two words
+        arrs = [np.asarray(d) for d in data]
+        if any(a.ndim != 1 for a in arrs):
+            raise TypeError(
+                "collect-state blocks cannot be exchange partition keys"
+            )
+        h = np.zeros(arrs[0].shape[0], dtype=np.uint64)
+        with np.errstate(over="ignore"):
+            for a in arrs:
+                h = h * _C31 + a.astype(np.int64).view(np.uint64)
+        return h
+    arr = np.asarray(data)
+    if blk.dictionary is not None:
+        # hash the dictionary VALUES, not the table-local codes —
+        # producer tasks with different dictionaries stay consistent
+        vh = _dict_value_hashes(blk.dictionary)
+        if len(vh) == 0:
+            return np.zeros(arr.shape[0], dtype=np.uint64)
+        codes = np.clip(arr.astype(np.int64), 0, len(vh) - 1)
+        return vh[codes]
+    if arr.dtype == np.bool_:
+        return arr.astype(np.uint64)
+    if np.issubdtype(arr.dtype, np.floating):
+        f = arr.astype(np.float64)
+        f = np.where(f == 0.0, 0.0, f)  # -0.0 == +0.0 (SQL equality)
+        bits = f.view(np.uint64)
+        return np.where(np.isnan(f), _NAN_KEY, bits)
+    return arr.astype(np.int64).view(np.uint64)
+
+
+def row_hash_u64(page: Page, keys: Sequence[int]) -> np.ndarray:
+    """Per-row partition hash over the key channels (31*h + mix(col),
+    the reference's CombineHashFunction shape over splitmix-dispersed
+    column encodings)."""
+    cap = np.asarray(page.valid).shape[0]
+    h = np.zeros(cap, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        for k in keys:
+            blk = page.block(k)
+            col = _mix64(_block_value_u64(blk))
+            if blk.nulls is not None:
+                col = np.where(np.asarray(blk.nulls), _NULL_SENTINEL,
+                               col)
+            h = h * _C31 + col
+    return _mix64(h)
+
+
+def take_rows_host(page: Page, idx: np.ndarray) -> Page:
+    """Compact the given row indices of a HOST page into a fresh page
+    whose capacity sits on the shapes.py bucket ladder (restreamed
+    exchange pages must not mint off-ladder program shapes
+    downstream)."""
+    n = len(idx)
+    cap = SH.bucket(max(n, 1))
+    pad = np.zeros(cap, dtype=np.int64)
+    pad[:n] = idx
+    blocks: List[Block] = []
+    for blk in page.blocks:
+        if isinstance(blk.data, tuple):
+            data = tuple(np.asarray(d)[pad] for d in blk.data)
+        else:
+            data = np.asarray(blk.data)[pad]
+        nulls = (np.asarray(blk.nulls)[pad]
+                 if blk.nulls is not None else None)
+        blocks.append(Block(data=data, type=blk.type, nulls=nulls,
+                            dictionary=blk.dictionary))
+    valid = np.zeros(cap, dtype=bool)
+    valid[:n] = True
+    return Page(blocks=tuple(blocks), valid=valid)
+
+
+def partition_host_page(
+    page: Page, keys: Sequence[int], nparts: int
+) -> List[Tuple[int, Page]]:
+    """Split one host page into per-partition compacted pages.
+    Partitions with zero rows are skipped (deterministically — replay
+    regenerates the same skips, so token sequences stay stable)."""
+    valid = np.asarray(page.valid)
+    if nparts <= 1:
+        return [(0, page)] if valid.any() else []
+    part = (row_hash_u64(page, keys) % np.uint64(nparts)).astype(
+        np.int64)
+    out: List[Tuple[int, Page]] = []
+    for p in range(nparts):
+        idx = np.nonzero(valid & (part == p))[0]
+        if len(idx):
+            out.append((p, take_rows_host(page, idx)))
+    return out
+
+
+# ------------------------------------------------------------ client
+class SourceTaskFailed(RuntimeError):
+    """The upstream task itself failed (X-Task-Error): deterministic,
+    re-dispatching the CONSUMER alone will not help."""
+
+
+class SourceLost(RuntimeError):
+    """An upstream task's spool is unreachable (node death): the
+    scheduler must replay the upstream task before the consumer can
+    make progress. The message carries the placement for diagnosis."""
+
+    def __init__(self, uri: str, task_id: str, cause: str):
+        super().__init__(
+            f"[source-lost {uri} {task_id}] {cause}")
+        self.uri = uri
+        self.task_id = task_id
+
+
+def fetch_spool_blobs(
+    uri: str,
+    task_id: str,
+    part: int,
+    *,
+    start_token: int = 0,
+    retries: int = 3,
+    backoff_s: float = 0.1,
+    timeout: float = 60.0,
+    deadline: Optional[float] = None,
+) -> Iterator[bytes]:
+    """Token-acked fetch of one spool partition (at-least-once +
+    dedupe-by-token, the HttpPageBufferClient protocol with the
+    partition dimension added). Raises SourceTaskFailed on
+    X-Task-Error, SourceLost after bounded transport retries."""
+    token = start_token
+    while True:
+        attempt = 0
+        while True:
+            if deadline is not None and time.monotonic() > deadline:
+                from presto_tpu.exec.executor import (
+                    QueryDeadlineExceeded,
+                )
+
+                raise QueryDeadlineExceeded(
+                    "query exceeded query_max_run_time in a spool "
+                    "fetch"
+                )
+            try:
+                req = urllib.request.Request(
+                    f"{uri}/v1/task/{task_id}/results/{token}"
+                    f"?part={part}"
+                )
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    if r.status == 204:
+                        if r.headers.get("X-Done") == "1":
+                            return
+                        break  # long-poll timeout; re-ask same token
+                    body = r.read()
+                    token = int(r.headers["X-Next-Token"])
+                    yield body
+                    break
+            except urllib.error.HTTPError as e:
+                if e.headers.get("X-Task-Error"):
+                    try:
+                        msg = json.loads(e.read().decode()).get(
+                            "error", "")
+                    except (ValueError, OSError):
+                        msg = str(e)
+                    raise SourceTaskFailed(
+                        f"upstream task {task_id} on {uri} FAILED: "
+                        f"{msg}"
+                    ) from e
+                if e.code == 410:
+                    # the partition was acked/released: deterministic
+                    # and permanent — retrying or replaying the
+                    # (healthy) producer node would not bring the
+                    # spool back
+                    raise SourceTaskFailed(
+                        f"spool partition {part} of task {task_id} on "
+                        f"{uri} was already released (acked) — the "
+                        f"scheduler consumed it before this fetch"
+                    ) from e
+                attempt += 1
+                if attempt > retries:
+                    raise SourceLost(uri, task_id, str(e)) from e
+                time.sleep(backoff_s * attempt)
+            except (urllib.error.URLError, ConnectionError,
+                    OSError) as e:
+                attempt += 1
+                if attempt > retries:
+                    raise SourceLost(uri, task_id, str(e)) from e
+                time.sleep(backoff_s * attempt)
+
+
+def iter_source_pages(
+    spec: dict,
+    *,
+    retries: int = 3,
+    backoff_s: float = 0.1,
+    deadline: Optional[float] = None,
+):
+    """Worker-side exchange ingest: yield deserialized pages of one
+    RemoteSource edge — partition `spec['partition']` of every
+    producer task, in payload order (deterministic, so a re-dispatched
+    consumer regenerates an identical stream from identical spools)."""
+    from presto_tpu.dist import serde
+
+    part = int(spec.get("partition", 0))
+    for t in spec["tasks"]:
+        for blob in fetch_spool_blobs(
+            t["uri"], t["taskId"], part, retries=retries,
+            backoff_s=backoff_s, deadline=deadline,
+        ):
+            yield serde.deserialize_page(blob)
+
+
+def ack_spool(uri: str, task_id: str, part: int,
+              timeout: float = 5.0) -> bool:
+    """Release one consumed spool partition on the producer (the ack
+    half of the fetch/ack protocol). Best-effort: a dead producer has
+    nothing left to free."""
+    try:
+        req = urllib.request.Request(
+            f"{uri}/v1/task/{task_id}/spool/{part}", method="DELETE"
+        )
+        urllib.request.urlopen(req, timeout=timeout).close()
+        return True
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return False

@@ -91,6 +91,25 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "counter", "worker page-buffer DELETE releases skipped because "
         "the worker was unreachable (dead-worker cleanup, counted not "
         "swallowed; mirrored from the DCN coordinator)"),
+    "stages_scheduled": (
+        "counter", "stage-DAG fragments dispatched as worker task "
+        "waves by the general scheduler (dist/scheduler.py; "
+        "coordinator lifetime)"),
+    "spooled_exchange_pages": (
+        "counter", "pages published into worker-side spooled-exchange "
+        "partitions (PageStore host/disk tiers on the producing "
+        "worker; coordinator lifetime)"),
+    "nonleaf_replays": (
+        "counter", "lost NON-LEAF stage-DAG tasks re-dispatched to "
+        "replay from spooled upstream pages instead of failing the "
+        "query (coordinator lifetime)"),
+    "speculative_tasks_won": (
+        "counter", "straggler speculation races where the "
+        "re-dispatched copy finished first and became the task's "
+        "placement"),
+    "speculative_tasks_lost": (
+        "counter", "straggler speculation races the original "
+        "placement won (the speculated copy was cancelled)"),
 }
 
 # stats-dict entries that are COMPUTED in execute_with_stats rather

@@ -444,6 +444,22 @@ class Executor:
         # analyze_rung — reads one registry off one object;
         # exec/counters.py)
         self.release_skips = 0
+        # Stage-DAG scheduling (ISSUE 7, dist/scheduler.py): the
+        # general fragment-DAG coordinator maintains these on ITS
+        # executor, lifetime-cumulative like the task-retry counters.
+        # stages_scheduled = DAG stages dispatched;
+        # spooled_exchange_pages = pages published into worker-side
+        # spooled-exchange partitions (summed from task status);
+        # nonleaf_replays = lost NON-LEAF tasks re-dispatched to
+        # replay from upstream spools (the Tardigrade recovery the
+        # PR-5 model could not express); speculative_tasks_won/lost =
+        # straggler races where the speculated copy beat / lost to
+        # the original placement.
+        self.stages_scheduled = 0
+        self.spooled_exchange_pages = 0
+        self.nonleaf_replays = 0
+        self.speculative_tasks_won = 0
+        self.speculative_tasks_lost = 0
         # plan_check (exec/plan_check.py): pre-compile verification of
         # the physical plan — schema-consistent edges, ladder/fault-line
         # capacities, canonical jit-key material, split determinism.
@@ -1509,14 +1525,19 @@ class Executor:
         return bool(flag)
 
     def stream_fragment(self, node: P.PhysicalNode, emit,
-                        cancelled=lambda: False) -> List:
+                        cancelled=lambda: False,
+                        on_attempt=None) -> List:
         """Stream a plan fragment's pages through ``emit`` under the
         SAME query-scope overflow ladder as execute() — for drivers
         that ship results incrementally (server/worker.py's task
         runtime) instead of materializing rows. Returns the emit()
         results of the last (overflow-free) attempt; a truncated page
         set can never escape because results publish only per
-        completed attempt. Raises after 6 boosted retries."""
+        completed attempt. ``on_attempt`` (optional) is called at the
+        start of EVERY attempt — drivers whose emit writes to
+        external, tiered storage (the spooled-exchange buffers) reset
+        it there so a boosted retry never double-publishes. Raises
+        after 6 boosted retries."""
         self._capacity_boost = 1
         self.device_oom_retries = 0
         self._oom_divisor = 1
@@ -1529,6 +1550,8 @@ class Executor:
             attempts = 0
             while attempts < 6:
                 self._begin_attempt()
+                if on_attempt is not None:
+                    on_attempt()
                 try:
                     self._maybe_inject_oom()
                     out: List = []
@@ -2279,6 +2302,15 @@ class Executor:
         if isinstance(node, P.Unnest):
             # expansion factor unknown statically; modest heuristic
             return self.estimate_rows(node.source) * 4
+        if isinstance(node, P.RemoteSource):
+            # fragment edge: estimate from the producer's root when it
+            # rides along (origin) — a conservative over-estimate (the
+            # FULL producer output; a repartition consumer sees ~1/N),
+            # which sizes non-leaf join builds sensibly instead of
+            # starting every stage-DAG buffer at the 1-row floor
+            if node.origin is not None:
+                return self.estimate_rows(node.origin)
+            return 1
         kids = node.children()
         return self.estimate_rows(kids[0]) if kids else 1
 

@@ -147,6 +147,34 @@ class PageStore:
         else:
             self._pages.append(page)
 
+    # ---------------------------------------------------- byte plane
+    # The spooled-exchange tier (dist/scheduler.py) stores SERIALIZED
+    # pages — the worker's wire blobs — through the same host/disk
+    # tiers and spill-dir lifecycle as page pytrees: host tier keeps
+    # the bytes resident, disk tier writes one file per blob into the
+    # pid-tagged spill dir (swept on close/exit like every spill file).
+    # A store holds pages OR blobs, never both.
+
+    def put_bytes(self, blob: bytes) -> None:
+        self.bytes += len(blob)
+        self.page_count += 1
+        if self.tier == "disk":
+            path = os.path.join(self._dir, f"b{self.page_count}.bin")
+            with open(path, "wb") as f:
+                f.write(blob)
+            self._pages.append(path)
+        else:  # device/host: resident bytes (there is no device blob)
+            self._pages.append(blob)
+
+    def blob_at(self, i: int) -> bytes:
+        """Random access for token-indexed spool fetch (the consumer's
+        at-least-once protocol re-reads arbitrary tokens)."""
+        entry = self._pages[i]
+        if isinstance(entry, str):
+            with open(entry, "rb") as f:
+                return f.read()
+        return entry
+
     def stream(self) -> Iterator[Page]:
         if self.tier == "host":
             for p in self._pages:

@@ -573,7 +573,10 @@ def check_task_payload(payload: dict) -> None:
     """Verify a DCN task payload carries the deterministic split
     assignment the PR-5 retry path depends on: a re-dispatched task
     re-generates EXACTLY splitIndex/splitCount's share at the scan, so
-    these fields (not worker identity) must define the split set."""
+    these fields (not worker identity) must define the split set.
+    Stage-DAG payloads may instead (or additionally) carry `sources`
+    — spooled-exchange input edges a replayed task re-reads — each
+    naming concrete producer placements."""
     bad: List[str] = []
     for k in _PAYLOAD_REQUIRED:
         if payload.get(k) is None:
@@ -585,6 +588,20 @@ def check_task_payload(payload: dict) -> None:
         if not (0 <= idx < cnt):
             bad.append(f"splitIndex {idx} outside [0, splitCount="
                        f"{cnt}) — the split share is undefined")
+    sources = payload.get("sources") or {}
+    for key, spec in sources.items():
+        tasks = (spec or {}).get("tasks")
+        if not tasks or not all(
+            isinstance(t, dict) and t.get("uri") and t.get("taskId")
+            for t in tasks
+        ):
+            bad.append(f"source {key!r} lacks concrete producer "
+                       f"placements (uri + taskId per task) — a "
+                       f"replayed consumer could not re-read its "
+                       f"spooled inputs")
+        if int((spec or {}).get("partition", 0)) < 0:
+            bad.append(f"source {key!r} names a negative spool "
+                       f"partition")
     if payload.get("splitMode") == "hash":
         cols = payload.get("partitionColumns")
         if not cols or not isinstance(cols, dict) or not all(
@@ -594,11 +611,135 @@ def check_task_payload(payload: dict) -> None:
             bad.append("hash splitMode without a catalog.table -> "
                        "column partitionColumns map — co-partitioned "
                        "scans cannot agree on the hash symbol")
-    elif not payload.get("splitTable"):
+    elif not payload.get("splitTable") and not sources:
         bad.append("round-robin task payload missing splitTable — "
-                   "workers cannot derive disjoint split shares")
+                   "workers cannot derive disjoint split shares "
+                   "(non-leaf stage-DAG tasks must carry sources "
+                   "instead)")
     if payload.get("fragment") is None and not payload.get("sql"):
         bad.append("task payload carries neither a serialized "
                    "fragment nor legacy sql")
+    if payload.get("outputPartitions") is not None:
+        p = int(payload["outputPartitions"])
+        if p < 1:
+            bad.append(f"outputPartitions {p} < 1 — the spool would "
+                       f"have no buffers")
+        if p > 1 and not payload.get("outputKeys"):
+            bad.append("repartitioned output (outputPartitions > 1) "
+                       "without outputKeys — producers cannot agree "
+                       "on the hash symbol")
     if bad:
         raise PlanCheckError(bad)
+
+
+# ----------------------------------------------------- stage DAGs
+def verify_dag(ex, dag, strict: bool = False) -> None:
+    """Verify a fragmented stage DAG (dist/fragmenter.fragment_dag):
+    every fragment root passes the full single-plan verifier (its
+    RemoteSource leaves carry producer origins, so schema agreement is
+    checked across EVERY exchange hop), plus the DAG-level invariants
+    no single tree can express:
+
+      - every RemoteSource edge resolves to a producer fragment whose
+        declared output types it matches;
+      - repartition output keys index real producer channels and are
+        hash-partitionable across tasks (no dictionary-coded keys —
+        codes are producer-local);
+      - a join whose BOTH children arrive via repartition edges must
+        be co-partitioned on exactly its join keys, or matching rows
+        land in different partitions (the fragment-edge analog of the
+        in-plan exchange-partitioning check).
+    """
+    from presto_tpu.dist.fragmenter import stage_key
+
+    violations: List[str] = []
+    by_key = {stage_key(f.fid): f for f in dag.fragments}
+    for frag in dag.fragments:
+        try:
+            verify(ex, frag.root, strict=strict)
+        except PlanCheckError as e:
+            violations.extend(
+                f"stage {frag.fid}: {v}" for v in e.violations
+            )
+            continue
+        if frag.output_kind == "repartition":
+            try:
+                out = ex.output_types(frag.root)
+            except Exception:  # noqa: BLE001 - verified above
+                out = None
+            if out is not None:
+                for k in frag.output_keys:
+                    if not (0 <= k < len(out)):
+                        violations.append(
+                            f"stage {frag.fid}: repartition key "
+                            f"#{k} out of range for the fragment's "
+                            f"{len(out)}-channel output")
+                from presto_tpu.dist.fragmenter import (
+                    _keys_repartitionable,
+                )
+
+                if all(0 <= k < len(out)
+                       for k in frag.output_keys) and \
+                        not _keys_repartitionable(out,
+                                                  frag.output_keys):
+                    violations.append(
+                        f"stage {frag.fid}: repartition keys "
+                        f"{tuple(frag.output_keys)} include a "
+                        f"dictionary-coded channel — codes are "
+                        f"producer-local, rows would not co-locate")
+
+    def check_edges(plan, where):
+        def walk(n):
+            if isinstance(n, P.RemoteSource) and \
+                    n.key.startswith("stage"):
+                frag = by_key.get(n.key)
+                if frag is None:
+                    violations.append(
+                        f"{where}: RemoteSource {n.key!r} names no "
+                        f"fragment in this DAG")
+                else:
+                    try:
+                        ot = tuple(ex.output_types(frag.root))
+                    except Exception:  # noqa: BLE001 - above
+                        ot = None
+                    # family agreement per channel is the single-plan
+                    # verifier's job (via origin); the DAG edge check
+                    # pins the arity against the LIVE fragment table
+                    if ot is not None and len(n.types) != len(ot):
+                        violations.append(
+                            f"{where}: RemoteSource {n.key!r} "
+                            f"declares {len(n.types)} channels but "
+                            f"stage {frag.fid} emits {len(ot)}")
+                return
+            if isinstance(n, P.HashJoin):
+                lsrc = n.left if isinstance(
+                    n.left, P.RemoteSource) else None
+                rsrc = n.right if isinstance(
+                    n.right, P.RemoteSource) else None
+                lf = by_key.get(lsrc.key) if lsrc is not None else None
+                rf = by_key.get(rsrc.key) if rsrc is not None else None
+                if lf is not None and rf is not None and \
+                        lf.output_kind == "repartition" and \
+                        rf.output_kind == "repartition":
+                    if tuple(lf.output_keys) != tuple(n.left_keys) or \
+                            tuple(rf.output_keys) != tuple(
+                                n.right_keys):
+                        violations.append(
+                            f"{where}: join consumes repartitioned "
+                            f"stages {lf.fid}/{rf.fid} but their "
+                            f"partition keys "
+                            f"{tuple(lf.output_keys)}/"
+                            f"{tuple(rf.output_keys)} disagree with "
+                            f"the join keys {tuple(n.left_keys)}/"
+                            f"{tuple(n.right_keys)} — co-partitioned "
+                            f"rows would not co-locate")
+            for c in n.children():
+                walk(c)
+
+        walk(plan)
+
+    for frag in dag.fragments:
+        check_edges(frag.root, f"stage {frag.fid}")
+    check_edges(dag.root, "coordinator fragment")
+    if violations:
+        raise PlanCheckError(violations)

@@ -445,8 +445,36 @@ class _Handler(BaseHTTPRequestHandler):
             properties=props,
         )
 
+    def _maybe_task_plane(self, method: str) -> bool:
+        """Serve /v1/task* and /v1/fault from the embedded task
+        runtime (coordinator+worker single process). Returns True when
+        the request was handled."""
+        rt = self.app.task_runtime
+        if rt is None:
+            return False
+        split = urlparse(self.path)
+        if not (split.path.startswith("/v1/task")
+                or split.path.startswith("/v1/fault")):
+            return False
+        from presto_tpu.server import worker as W
+
+        if method == "POST":
+            n = int(self.headers.get("Content-Length", "0"))
+            resp = W.route_task_post(rt, split.path,
+                                     self.rfile.read(n) or b"{}")
+        elif method == "GET":
+            resp = W.route_task_get(rt, split.path, split.query)
+        else:
+            resp = W.route_task_delete(rt, split.path)
+        if resp is None:
+            return False
+        W.write_task_response(self, resp)
+        return True
+
     def do_POST(self):
         path = urlparse(self.path).path
+        if self._maybe_task_plane("POST"):
+            return
         if path != "/v1/statement":
             self._send_json({"error": "not found"}, 404)
             return
@@ -484,6 +512,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = urlparse(self.path).path
+        if self._maybe_task_plane("GET"):
+            return
         parts = [p for p in path.split("/") if p]
         if parts[:2] == ["v1", "statement"] and len(parts) == 4:
             q = self.app.manager.get(parts[2])
@@ -538,6 +568,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json({"error": "not found"}, 404)
 
     def do_DELETE(self):
+        if self._maybe_task_plane("DELETE"):
+            return
         parts = [p for p in urlparse(self.path).path.split("/") if p]
         if parts[:2] == ["v1", "statement"] and len(parts) >= 3:
             ok = self.app.manager.cancel(parts[2])
@@ -594,6 +626,7 @@ class PrestoTpuServer:
         resource_groups=None,
         memory_budget_bytes: Optional[int] = None,
         session_defaults=None,
+        worker_tasks: bool = False,
     ):
         from presto_tpu.runner import LocalRunner
 
@@ -684,6 +717,20 @@ class PrestoTpuServer:
                                     listeners=event_listeners,
                                     resource_groups=resource_groups,
                                     memory_arbiter=memory_arbiter)
+        # coordinator+worker single process (reference: a node that is
+        # both coordinator and worker): an embedded task runtime makes
+        # this server a full DCN peer — it serves the /v1/task control
+        # plane and the spooled-exchange fetch/ack data plane
+        # (server/worker.route_task_*), so a DcnRunner or stage-DAG
+        # scheduler can pool it like any worker
+        self.task_runtime = None
+        if worker_tasks:
+            from presto_tpu.server.worker import TaskRuntime
+
+            self.task_runtime = TaskRuntime(
+                self.catalogs, node_id="coordinator-worker",
+                default_catalog=default_catalog, page_rows=page_rows,
+            )
         self._install_runtime_tables()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None

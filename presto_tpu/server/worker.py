@@ -14,6 +14,28 @@ the subtree the coordinator planned, restricted to its split share
 pages (dist/serde.py) for token-indexed fetch. Legacy peers may still
 send (sql, role) for worker-side replay.
 
+Stage-DAG tasks (dist/scheduler.py) extend the same surface with a
+SPOOLED-EXCHANGE plane (reference: Project Tardigrade's spooled
+shuffle, PartitionedOutputOperator + ExchangeClient):
+
+  - a task whose payload carries ``outputPartitions``/``outputKeys``
+    hash-partitions every result page host-side (dist/spool.py) and
+    publishes the serialized partitions into PageStore host/disk tiers
+    (_TaskSpool) — partition buffers OUTLIVE execution, so a lost
+    downstream task replays from its upstream spools;
+  - a task whose payload carries ``sources`` registers RemoteSource
+    suppliers that fetch its input partitions from upstream tasks'
+    spools over HTTP (worker-to-worker exchange — the coordinator
+    never relays inter-stage pages);
+  - ``GET /v1/task/{id}/results/{token}?part=p`` fetches one spool
+    partition token-indexed; ``DELETE /v1/task/{id}/spool/{p}`` acks
+    (releases) a consumed partition.
+
+Route handling is factored into module-level ``route_task_*``
+functions so the coordinator HTTP server can serve the same task +
+spool data plane in-process (a coordinator+worker single-process
+deployment, server/http_server.py).
+
 Fault-injection hooks (SURVEY §6.3: inject at the host page proxy —
 ICI collectives cannot be faulted): FAULT_DELAY_MS delays every
 results fetch; FAULT_DROP_EVERY=n returns HTTP 500 on every nth fetch;
@@ -21,7 +43,10 @@ FAULT_KILL_AFTER_FETCHES=n hard-exits the worker PROCESS once n result
 fetches have been served (worker death mid-query — the coordinator's
 task-retry path re-dispatches the fragment to a survivor);
 FAULT_SUBMIT_DROP_EVERY=n returns HTTP 500 on every nth task submit
-(exercises the coordinator's submit retry). Each knob reads the
+(exercises the coordinator's submit retry);
+FAULT_TASK_EXEC_DELAY_MS stalls task EXECUTION (a deterministic
+straggler for the stage scheduler's speculation policy). Each knob
+reads the
 runtime `fault_config` posted via POST /v1/fault as an OVERLAY on the
 environment: posted keys win (an explicit 0 disables an env-seeded
 fault), absent keys fall back to the environment, and `{}` restores
@@ -47,14 +72,129 @@ from presto_tpu.exec import plan as P
 from presto_tpu.session import Session
 
 
+class _PartitionSpool:
+    """One partition's spooled blobs: host-tier PageStore while the
+    task's resident budget lasts, disk-tier PageStore past it (the
+    FileSingleStreamSpiller analog for exchange pages)."""
+
+    def __init__(self, spill_dir: Optional[str] = None):
+        from presto_tpu.exec.pagestore import PageStore
+
+        self._host = PageStore(tier="host")
+        self._disk: Optional[PageStore] = None
+        self._spill_dir = spill_dir
+        self._entries: List = []  # (store, index) per token
+        self.released = False
+
+    def put(self, blob: bytes, to_disk: bool) -> None:
+        from presto_tpu.exec.pagestore import PageStore
+
+        if to_disk:
+            if self._disk is None:
+                self._disk = PageStore(tier="disk",
+                                       spill_dir=self._spill_dir)
+            store = self._disk
+        else:
+            store = self._host
+        store.put_bytes(blob)
+        self._entries.append((store, store.page_count - 1))
+
+    def blob(self, token: int) -> bytes:
+        store, i = self._entries[token]
+        return store.blob_at(i)
+
+    @property
+    def count(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes(self) -> int:
+        return self._host.bytes + (self._disk.bytes if self._disk
+                                   else 0)
+
+    def close(self) -> None:
+        self._host.close()
+        if self._disk is not None:
+            self._disk.close()
+        self._entries = []
+        self.released = True
+
+
+class _TaskSpool:
+    """A task's partitioned output spool: P token-indexed partition
+    buffers sharing one resident-byte budget (the spool_exchange_bytes
+    session property) — blobs past it go to the disk tier."""
+
+    def __init__(self, nparts: int, host_budget: int,
+                 spill_dir: Optional[str] = None):
+        self.parts = [_PartitionSpool(spill_dir)
+                      for _ in range(max(nparts, 1))]
+        self.host_budget = host_budget
+        self.host_bytes = 0
+
+    def put(self, p: int, blob: bytes) -> None:
+        to_disk = (self.host_budget > 0
+                   and self.host_bytes + len(blob) > self.host_budget)
+        if not to_disk:
+            self.host_bytes += len(blob)
+        self.parts[p].put(blob, to_disk)
+
+    @property
+    def page_count(self) -> int:
+        return sum(p.count for p in self.parts)
+
+    @property
+    def byte_count(self) -> int:
+        return sum(p.bytes for p in self.parts)
+
+    def release(self, p: int) -> bool:
+        if 0 <= p < len(self.parts):
+            self.parts[p].close()
+            return True
+        return False
+
+    def close(self) -> None:
+        for p in self.parts:
+            p.close()
+
+
 class _Task:
     def __init__(self, task_id: str):
         self.task_id = task_id
         self.pages: List[bytes] = []
+        self.spool: Optional[_TaskSpool] = None
         self.done = False
         self.error: Optional[str] = None
         self.cancelled = False
         self.lock = threading.Lock()
+
+    # --------- unified read surface (legacy byte list OR spool tiers)
+    def part_count(self, part: int) -> int:
+        if self.spool is not None:
+            if part >= len(self.spool.parts):
+                return 0
+            return self.spool.parts[part].count
+        return len(self.pages) if part == 0 else 0
+
+    def part_blob(self, part: int, token: int) -> bytes:
+        if self.spool is not None:
+            return self.spool.parts[part].blob(token)
+        return self.pages[token]
+
+    def part_released(self, part: int) -> bool:
+        return (self.spool is not None
+                and 0 <= part < len(self.spool.parts)
+                and self.spool.parts[part].released)
+
+    def total_pages(self) -> int:
+        if self.spool is not None:
+            return self.spool.page_count
+        return len(self.pages)
+
+    def free(self) -> None:
+        self.pages.clear()
+        if self.spool is not None:
+            self.spool.close()
 
 
 def find_partial_cut(plan: P.PhysicalNode) -> Optional[P.Aggregation]:
@@ -261,6 +401,161 @@ def largest_table(node: P.PhysicalNode, catalogs) -> Optional[str]:
     )[1]
 
 
+# ---------------------------------------------------------------------
+# Task-plane routing, shared between the worker's own HTTP server and
+# the coordinator server (http_server.py delegates /v1/task* and
+# /v1/fault here when constructed with a task runtime). A response is
+# (status, headers_list, content_type, body_bytes); None means "not a
+# task-plane path".
+
+_JSON_CT = "application/json"
+_PAGES_CT = "application/x-presto-pages"
+
+
+def _jresp(obj, status=200, headers=()):
+    return (status, list(headers), _JSON_CT, json.dumps(obj).encode())
+
+
+def write_task_response(handler, resp) -> None:
+    """Render a (status, headers, content_type, body) route result on
+    a BaseHTTPRequestHandler — ONE renderer for both the worker's own
+    handler and the coordinator's delegating handler, so the task
+    plane cannot drift between the two servers."""
+    status, headers, ctype, body = resp
+    handler.send_response(status)
+    handler.send_header("Content-Type", ctype)
+    if status != 204:
+        handler.send_header("Content-Length", str(len(body)))
+    for k, v in headers:
+        handler.send_header(k, v)
+    handler.end_headers()
+    if status != 204 and body:
+        handler.wfile.write(body)
+
+
+def route_task_post(app, path: str, body: bytes):
+    if path.startswith("/v1/fault"):
+        # runtime fault reconfiguration (chaos harness): the posted
+        # overlay replaces the previous one; {} clears every RUNTIME
+        # fault and restores env-ruled mode
+        app.set_fault_config({
+            k: int(v) for k, v in json.loads(body or b"{}").items()
+        })
+        return _jresp({"ok": True, "fault": app.fault_config})
+    if not path.startswith("/v1/task"):
+        return None
+    if app.maybe_inject_submit_fault():
+        return _jresp({"error": "injected submit fault"}, 500)
+    req = json.loads(body)
+    task = app.create_task(req)
+    return _jresp({"taskId": task.task_id, "state": "RUNNING"})
+
+
+def route_task_get(app, path: str, query: str):
+    from urllib.parse import parse_qs
+
+    parts = [p for p in path.split("/") if p]
+    # /v1/task/{id}/results/{token}[?part=p]
+    if len(parts) == 5 and parts[:2] == ["v1", "task"] \
+            and parts[3] == "results":
+        task = app.tasks.get(parts[2])
+        if task is None:
+            return _jresp({"error": "no such task"}, 404)
+        token = int(parts[4])
+        part = int(parse_qs(query or "").get("part", ["0"])[0])
+        if app.maybe_inject_fault():
+            return _jresp({"error": "injected fault"}, 500)
+        # bounded long-poll until the page at `token` exists or the
+        # task finishes (reference: HttpPageBufferClient long-poll)
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            entry = blob = None
+            with task.lock:
+                if task.error:
+                    # X-Task-Error marks a DETERMINISTIC task failure
+                    # (the fragment itself failed, not the transport):
+                    # consumers surface the real message instead of
+                    # spinning fetch retries against a dead task
+                    return _jresp({"error": task.error}, 500,
+                                  headers=(("X-Task-Error", "1"),))
+                if task.part_released(part):
+                    return _jresp(
+                        {"error": f"spool partition {part} released "
+                                  f"(already acked)"}, 410)
+                if token < task.part_count(part):
+                    if task.spool is not None:
+                        # resolve under the lock, READ outside it: a
+                        # disk-tier blob read must not serialize the
+                        # other partitions' consumers and the status
+                        # polls behind one file read
+                        entry = (task.spool.parts[part]
+                                 ._entries[token])
+                    else:
+                        blob = task.pages[token]
+                elif task.done:
+                    return (204, [("X-Done", "1")], _JSON_CT, b"")
+            if entry is not None:
+                store, i = entry
+                try:
+                    blob = store.blob_at(i)
+                except (OSError, IndexError):
+                    # raced a concurrent ack/release of this partition
+                    return _jresp(
+                        {"error": f"spool partition {part} released "
+                                  f"(already acked)"}, 410)
+            if blob is not None:
+                return (200, [("X-Next-Token", str(token + 1)),
+                              ("X-Done", "0")], _PAGES_CT, blob)
+            time.sleep(0.02)
+        return (204, [("X-Done", "0")], _JSON_CT, b"")
+    if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+        task = app.tasks.get(parts[2])
+        if task is None:
+            return _jresp({"error": "no such task"}, 404)
+        with task.lock:
+            spool = task.spool
+            return _jresp({
+                "taskId": task.task_id,
+                "state": ("FAILED" if task.error else
+                          "FINISHED" if task.done else "RUNNING"),
+                "pages": task.total_pages(),
+                "spooledPages": spool.page_count if spool else 0,
+                "spooledBytes": spool.byte_count if spool else 0,
+                "partitions": len(spool.parts) if spool else 1,
+                "error": task.error,
+            })
+    return None
+
+
+def route_task_delete(app, path: str):
+    parts = [p for p in path.split("/") if p]
+    # /v1/task/{id}/spool/{part}: ack (release) one consumed spool
+    # partition — partition-granular buffer release so long queries
+    # can return exchange memory before the whole task expires
+    if len(parts) == 5 and parts[:2] == ["v1", "task"] \
+            and parts[3] == "spool":
+        task = app.tasks.get(parts[2])
+        if task is None:
+            return _jresp({"error": "no such task"}, 404)
+        with task.lock:
+            ok = (task.spool is not None
+                  and task.spool.release(int(parts[4])))
+        if ok:
+            return _jresp({"taskId": task.task_id,
+                           "partition": int(parts[4]),
+                           "state": "RELEASED"})
+        return _jresp({"error": "no such spool partition"}, 404)
+    if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+        task = app.tasks.pop(parts[2], None)
+        if task is not None:
+            task.cancelled = True
+            with task.lock:
+                task.free()  # page buffers + spool tiers
+            return _jresp({"taskId": task.task_id,
+                           "state": "CANCELED"})
+    return None
+
+
 class _WorkerHandler(BaseHTTPRequestHandler):
     server_version = "presto-tpu-worker/0.3"
 
@@ -271,129 +566,46 @@ class _WorkerHandler(BaseHTTPRequestHandler):
     def app(self) -> "WorkerServer":
         return self.server.app  # type: ignore[attr-defined]
 
-    def _json(self, obj, status=200, headers=()):
-        body = json.dumps(obj).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in headers:
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
+    def _write(self, resp) -> None:
+        write_task_response(self, resp)
 
     def do_POST(self):
         n = int(self.headers.get("Content-Length", "0"))
         body = self.rfile.read(n) or b"{}"
-        if self.path.startswith("/v1/fault"):
-            # runtime fault reconfiguration (chaos harness): the posted
-            # overlay replaces the previous one; {} clears every
-            # RUNTIME fault and restores env-ruled mode
-            self.app.set_fault_config({
-                k: int(v) for k, v in json.loads(body).items()
-            })
-            self._json({"ok": True, "fault": self.app.fault_config})
-            return
-        if not self.path.startswith("/v1/task"):
-            self._json({"error": "not found"}, 404)
-            return
-        if self.app.maybe_inject_submit_fault():
-            self._json({"error": "injected submit fault"}, 500)
-            return
-        req = json.loads(body)
-        task = self.app.create_task(req)
-        self._json({"taskId": task.task_id, "state": "RUNNING"})
+        resp = route_task_post(self.app, self.path, body)
+        self._write(resp if resp is not None
+                    else _jresp({"error": "not found"}, 404))
 
     def do_GET(self):
-        parts = self.path.strip("/").split("/")
-        if self.path.startswith("/v1/info"):
-            self._json({
+        from urllib.parse import urlsplit
+
+        split = urlsplit(self.path)
+        if split.path.startswith("/v1/info"):
+            self._write(_jresp({
                 "nodeId": self.app.node_id,
                 "state": "ACTIVE",
                 "uptime_s": round(time.time() - self.app.started, 1),
                 "tasks": len(self.app.tasks),
-            })
+            }))
             return
-        # /v1/task/{id}/results/{token}
-        if len(parts) == 5 and parts[:2] == ["v1", "task"] \
-                and parts[3] == "results":
-            task = self.app.tasks.get(parts[2])
-            if task is None:
-                self._json({"error": "no such task"}, 404)
-                return
-            token = int(parts[4])
-            if self.app.maybe_inject_fault():
-                self._json({"error": "injected fault"}, 500)
-                return
-            # bounded long-poll until the page at `token` exists or the
-            # task finishes (reference: HttpPageBufferClient long-poll)
-            deadline = time.time() + 10.0
-            while time.time() < deadline:
-                with task.lock:
-                    if task.error:
-                        # X-Task-Error marks a DETERMINISTIC task
-                        # failure (the fragment itself failed, not the
-                        # transport): the coordinator surfaces the real
-                        # message instead of spinning fetch retries
-                        # against a dead task
-                        self._json({"error": task.error}, 500,
-                                   headers=(("X-Task-Error", "1"),))
-                        return
-                    if token < len(task.pages):
-                        body = task.pages[token]
-                        self.send_response(200)
-                        self.send_header(
-                            "Content-Type",
-                            "application/x-presto-pages")
-                        self.send_header("Content-Length",
-                                         str(len(body)))
-                        self.send_header("X-Next-Token", str(token + 1))
-                        self.send_header("X-Done", "0")
-                        self.end_headers()
-                        self.wfile.write(body)
-                        return
-                    if task.done:
-                        self.send_response(204)
-                        self.send_header("X-Done", "1")
-                        self.end_headers()
-                        return
-                time.sleep(0.02)
-            self.send_response(204)
-            self.send_header("X-Done", "0")
-            self.end_headers()
-            return
-        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-            task = self.app.tasks.get(parts[2])
-            if task is None:
-                self._json({"error": "no such task"}, 404)
-                return
-            self._json({
-                "taskId": task.task_id,
-                "state": ("FAILED" if task.error else
-                          "FINISHED" if task.done else "RUNNING"),
-                "pages": len(task.pages),
-                "error": task.error,
-            })
-            return
-        self._json({"error": "not found"}, 404)
+        resp = route_task_get(self.app, split.path, split.query)
+        self._write(resp if resp is not None
+                    else _jresp({"error": "not found"}, 404))
 
     def do_DELETE(self):
-        parts = self.path.strip("/").split("/")
-        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-            task = self.app.tasks.pop(parts[2], None)
-            if task is not None:
-                task.cancelled = True
-                with task.lock:
-                    task.pages.clear()  # free the page buffer
-                self._json({"taskId": task.task_id,
-                            "state": "CANCELED"})
-                return
-        self._json({"error": "not found"}, 404)
+        resp = route_task_delete(self.app, self.path)
+        self._write(resp if resp is not None
+                    else _jresp({"error": "not found"}, 404))
 
 
-class WorkerServer:
-    """One worker process's task runtime (SqlTaskManager analog)."""
+class TaskRuntime:
+    """A process's task runtime (SqlTaskManager analog): task registry,
+    fragment execution, spooled output buffers, fault injection — no
+    HTTP server of its own. WorkerServer wraps it with one; the
+    coordinator server (http_server.py) embeds one directly so a
+    single process can serve both roles."""
 
-    def __init__(self, catalogs, *, port: int = 0, node_id: str = "w0",
+    def __init__(self, catalogs, *, node_id: str = "w0",
                  default_catalog: Optional[str] = None,
                  page_rows: int = 1 << 16):
         self.catalogs = catalogs
@@ -402,11 +614,6 @@ class WorkerServer:
         self.page_rows = page_rows
         self.tasks: Dict[str, _Task] = {}
         self.started = time.time()
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
-                                          _WorkerHandler)
-        self._httpd.app = self  # type: ignore[attr-defined]
-        self.port = self._httpd.server_address[1]
-        self._thread: Optional[threading.Thread] = None
         self._fault_lock = threading.Lock()
         self._results_calls = 0
         self._submit_calls = 0
@@ -466,29 +673,29 @@ class WorkerServer:
                     return True
         return False
 
-    # -------------------------------------------------------- lifecycle
-    def start(self) -> int:
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
-        self._thread.start()
-        return self.port
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-
     # ------------------------------------------------------------ tasks
     MAX_RETAINED_TASKS = 32
+    # spooled tasks expire far later: their partitions are REPLAY
+    # inputs for downstream stage-DAG tasks (the scheduler releases
+    # them explicitly via DELETE/ack at query end) — evicting one
+    # mid-query would turn a healthy worker into a [source-lost] node
+    MAX_RETAINED_SPOOLED = 256
 
     def create_task(self, req: Dict) -> _Task:
         # expire oldest finished tasks (reference: SqlTaskManager task
         # expiry) so a long-lived worker's page buffers are bounded
-        done = [tid for tid, t in self.tasks.items() if t.done]
-        while len(done) > self.MAX_RETAINED_TASKS:
-            old = self.tasks.pop(done.pop(0), None)
-            if old is not None:
-                with old.lock:
-                    old.pages.clear()
+        for pool, cap in (
+            ([tid for tid, t in self.tasks.items()
+              if t.done and t.spool is None], self.MAX_RETAINED_TASKS),
+            ([tid for tid, t in self.tasks.items()
+              if t.done and t.spool is not None],
+             self.MAX_RETAINED_SPOOLED),
+        ):
+            while len(pool) > cap:
+                old = self.tasks.pop(pool.pop(0), None)
+                if old is not None:
+                    with old.lock:
+                        old.free()
         task = _Task(req.get("taskId") or f"t{len(self.tasks)}")
         self.tasks[task.task_id] = task
         t = threading.Thread(target=self._run_task, args=(task, req),
@@ -498,6 +705,13 @@ class WorkerServer:
 
     def _run_task(self, task: _Task, req: Dict) -> None:
         try:
+            # FAULT_TASK_EXEC_DELAY_MS: stall task EXECUTION (not the
+            # fetch path) — makes this worker a deterministic
+            # straggler so the scheduler's speculation policy can be
+            # exercised without wall-clock races
+            exec_delay = self._fault("FAULT_TASK_EXEC_DELAY_MS")
+            if exec_delay:
+                time.sleep(exec_delay / 1000.0)
             from presto_tpu.connectors.split_filter import (
                 HashSplitConnector,
             )
@@ -520,13 +734,27 @@ class WorkerServer:
                     )
                     for name, conn in self.catalogs.items()
                 }
-            else:
+            elif req.get("splitTable"):
                 split_table = req["splitTable"]
                 catalogs = {
                     name: SplitFilterConnector(conn, split_table,
                                                index, count)
                     for name, conn in self.catalogs.items()
                 }
+            elif req.get("sources"):
+                # non-leaf stage-DAG fragment: no scans to split —
+                # inputs arrive through the spooled-exchange sources
+                catalogs = dict(self.catalogs)
+            else:
+                # a leaf payload with neither a split assignment nor
+                # sources must fail LOUDLY: executing it over unsplit
+                # catalogs would have every worker scan the full table
+                # and the coordinator concatenate N identical copies
+                raise ValueError(
+                    "task payload carries neither a split assignment "
+                    "(splitTable/splitMode) nor spooled-exchange "
+                    "sources — refusing to run the fragment unsplit"
+                )
             session = Session(catalog=self.default_catalog or
                               next(iter(catalogs)))
             for k, v in (req.get("session") or {}).items():
@@ -554,6 +782,30 @@ class WorkerServer:
             runner.apply_session()
             import jax
 
+            sources = req.get("sources") or {}
+            nparts = int(req.get("outputPartitions") or 0)
+            out_keys = tuple(req.get("outputKeys") or ())
+            spooled = bool(sources) or nparts > 0
+            if sources:
+                # stage-DAG ingest: RemoteSource suppliers fetching
+                # this task's input partitions from upstream tasks'
+                # spools (worker-to-worker exchange; dist/spool.py).
+                # A persistently unreachable source fails the task
+                # with a [source-lost ...] marker the scheduler uses
+                # to replay the upstream task instead of just this one
+                from presto_tpu.dist import spool as SPOOL
+
+                backoff = (
+                    int(session.get("retry_backoff_ms")) / 1000.0
+                )
+                for key, spec in sources.items():
+                    ex.remote_sources[key] = (
+                        lambda spec=spec: SPOOL.iter_source_pages(
+                            spec, retries=3, backoff_s=backoff,
+                            deadline=ex.query_deadline,
+                        )
+                    )
+
             # Worker-side overflow discipline: the executor's shared
             # query-scope retry ladder (Executor.stream_fragment) —
             # pages buffer locally and publish only after the
@@ -563,15 +815,58 @@ class WorkerServer:
             # capacities (the coordinator's long-poll tolerates the
             # delay); persistent overflow fails the task loudly via
             # task.error.
-            def emit(page) -> bytes:
-                return serde.serialize_page(jax.device_get(page))
+            if spooled:
+                from presto_tpu.dist import spool as SPOOL
 
-            blobs: List[bytes] = ex.stream_fragment(
-                partial, emit, cancelled=lambda: task.cancelled
-            )
-            with task.lock:
-                task.pages.extend(blobs)
-                task.done = True
+                # spooled-exchange emit: partition each host page by
+                # hash(outputKeys) % P (P=1 collapses to a single
+                # gather/broadcast partition), serialize per
+                # partition, and stream STRAIGHT into the tiered
+                # spool — blobs past the resident budget go to the
+                # disk tier DURING execution, so spool_exchange_bytes
+                # bounds peak worker memory for large exchanges. The
+                # spool stays unpublished (task.spool None ⇒
+                # consumers long-poll) until the attempt completes
+                # overflow-free, and on_attempt resets it so a
+                # boosted retry never double-spools.
+                state = {"spool": None}
+
+                def on_attempt() -> None:
+                    if state["spool"] is not None:
+                        state["spool"].close()
+                    state["spool"] = _TaskSpool(
+                        max(nparts, 1),
+                        int(session.get("spool_exchange_bytes")),
+                        spill_dir=session.get("spill_path") or None,
+                    )
+
+                def emit(page) -> int:
+                    host = jax.device_get(page)
+                    n = 0
+                    for p, part_page in SPOOL.partition_host_page(
+                            host, out_keys, max(nparts, 1)):
+                        state["spool"].put(
+                            p, serde.serialize_page(part_page))
+                        n += 1
+                    return n
+
+                ex.stream_fragment(
+                    partial, emit, cancelled=lambda: task.cancelled,
+                    on_attempt=on_attempt,
+                )
+                with task.lock:
+                    task.spool = state["spool"]
+                    task.done = True
+            else:
+                def emit(page) -> bytes:
+                    return serde.serialize_page(jax.device_get(page))
+
+                blobs: List = ex.stream_fragment(
+                    partial, emit, cancelled=lambda: task.cancelled
+                )
+                with task.lock:
+                    task.pages.extend(blobs)
+                    task.done = True
         except Exception as e:  # noqa: BLE001 - task failures surface
             # to the coordinator via the X-Task-Error results header
             # (real error text, no fetch-retry spinning), never as a
@@ -579,6 +874,33 @@ class WorkerServer:
             with task.lock:
                 task.error = repr(e)[:400]
                 task.done = True
+
+
+class WorkerServer(TaskRuntime):
+    """One worker process's task runtime behind its own HTTP server."""
+
+    def __init__(self, catalogs, *, port: int = 0, node_id: str = "w0",
+                 default_catalog: Optional[str] = None,
+                 page_rows: int = 1 << 16):
+        super().__init__(catalogs, node_id=node_id,
+                         default_catalog=default_catalog,
+                         page_rows=page_rows)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                          _WorkerHandler)
+        self._httpd.app = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------------- lifecycle
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
 
 
 def main() -> int:  # pragma: no cover - subprocess entry

@@ -291,6 +291,43 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             validate=lambda v: v in ("auto", "true", "false"),
         ),
         PropertyMetadata(
+            "stage_scheduler",
+            "general fragment-DAG scheduling for DCN queries "
+            "(dist/scheduler.py): cut ANY plan into a stage DAG with "
+            "gather/broadcast/hash-repartition exchanges and dispatch "
+            "it task-by-task across the worker pool, every inter-stage "
+            "exchange spooled through PageStore tiers on the producing "
+            "worker so lost non-leaf tasks replay instead of failing "
+            "the query. auto = engage when the special-cased shapes "
+            "(agg-cut / union-cut / hash-fanout) do not apply; true "
+            "forces DAG scheduling first; false disables it. "
+            "Observability: stages_scheduled / spooled_exchange_pages "
+            "/ nonleaf_replays counters in EXPLAIN ANALYZE",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
+        ),
+        PropertyMetadata(
+            "speculation_enabled",
+            "straggler speculation as a stage-scheduler policy "
+            "(reference: Project Tardigrade speculative execution): "
+            "race a re-dispatched copy of a stage's slowest running "
+            "task on another worker and take whichever placement "
+            "finishes first (deterministic fragments make the outputs "
+            "byte-identical, so the loser is simply cancelled). "
+            "Counters: speculative_tasks_won / speculative_tasks_lost",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "spool_exchange_bytes",
+            "per-task resident-byte budget for spooled-exchange "
+            "partitions on a worker: serialized exchange pages beyond "
+            "it spill to disk-tier PageStore files instead of host "
+            "RAM (0 = never spill to disk; the spooled shuffle tier "
+            "that makes non-leaf task replay and mid-query rejoin "
+            "scheduler policies)",
+            int, 1 << 30,
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

@@ -31,3 +31,24 @@ def test_chaos_harness_exits_zero():
         f"chaos harness failed:\n{proc.stdout}\n{proc.stderr}"
     )
     assert "failures" in proc.stdout
+
+
+@pytest.mark.slow
+def test_chaos_kill_nonleaf_recovers_via_spool_replay():
+    """ISSUE 7: the kill-during-non-leaf-stage schedule — a worker
+    killed while serving spooled-exchange fetches mid-DAG — must
+    recover with single-process-identical rows via spooled NON-LEAF
+    replay (the harness exits nonzero on zero nonleaf_replays)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--iterations", "2", "--seed", "1", "--scale", "0.005",
+         "--mode", "kill-nonleaf"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"kill-nonleaf chaos failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "nonleaf_replays=" in proc.stdout

@@ -1,0 +1,591 @@
+"""ISSUE 7: the general fragment-DAG scheduler with spooled exchanges.
+
+Covers the whole subsystem ring by ring:
+  - fragment_dag cuts arbitrary plans into verified stage DAGs
+    (structure of a 3-stage TPC-H-Q13-shaped plan the legacy cuts
+    cannot distribute; refusal of bare scans and DAG-unsafe shapes;
+    string-key repartition degradation);
+  - the spool fetch/ack data plane (partitioned PageStore-backed
+    buffers, token-dedupe, partition release);
+  - end-to-end parity across 2 workers through dist/scheduler.py,
+    including forced-DAG mode over repartitioned joins;
+  - straggler speculation dedupe and mid-query worker re-admission;
+  - payload/DAG static checks (exec/plan_check.py);
+  - (slow) a real-subprocess mid-query kill of a NON-LEAF stage
+    recovering via spooled replay — the acceptance gate.
+"""
+
+import collections
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.dist.dcn import DcnRunner
+from presto_tpu.dist.fragmenter import fragment_dag, stage_key
+from presto_tpu.exec import plan_check as PC
+from presto_tpu.runner import LocalRunner
+from presto_tpu.server.worker import WorkerServer
+from tests.tpch_queries import QUERIES
+
+SF = 0.01
+PAGE_ROWS = 1 << 13
+
+# 3-stage shape the OLD fragmenter could NOT distribute: a left join
+# feeding a hash aggregation feeding a join feeding another
+# aggregation (the TPC-H Q13 family). find_partial_cut lands on the
+# OUTER agg whose subtree is not row-local, and the union cut dies on
+# the left join — legacy distribution falls back to a single process.
+DAG_QUERY = (
+    "select n_name, count(*), sum(top.c_count) from nation join ("
+    "  select c_nationkey nk, c_custkey ck, count(o_orderkey) c_count"
+    "  from customer left join orders on c_custkey = o_custkey"
+    "  group by c_nationkey, c_custkey) top on n_nationkey = top.nk "
+    "group by n_name order by n_name"
+)
+
+
+@pytest.fixture(scope="module")
+def single():
+    return LocalRunner({"tpch": TpchConnector(SF)}, page_rows=PAGE_ROWS)
+
+
+@pytest.fixture(scope="module")
+def workers():
+    w1 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w1",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    w2 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w2",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    uris = [f"http://127.0.0.1:{w1.start()}",
+            f"http://127.0.0.1:{w2.start()}"]
+    yield uris
+    w1.stop()
+    w2.stop()
+
+
+def rows_equal(a, b):
+    return collections.Counter(map(repr, a)) == collections.Counter(
+        map(repr, b)
+    )
+
+
+def _post_fault(uri, **cfg):
+    req = urllib.request.Request(
+        f"{uri}/v1/fault", data=json.dumps(cfg).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=5).close()
+
+
+def _make_coord(workers, **props):
+    defaults = {"retry_backoff_ms": 20}
+    defaults.update(props)
+    return DcnRunner({"tpch": TpchConnector(SF)}, workers,
+                     default_catalog="tpch", page_rows=PAGE_ROWS,
+                     session_props=defaults)
+
+
+# ------------------------------------------------------ fragmentation
+def test_fragment_dag_three_stage_shape(single):
+    plan = single.plan(DAG_QUERY)
+    dag = fragment_dag(single.executor, plan, single.catalogs,
+                       gather_capacity=64)
+    assert dag is not None
+    assert len(dag.fragments) >= 3
+    kinds = [f.output_kind for f in dag.fragments]
+    # the inner group-by (int keys, capacity forced past the gather
+    # cap) repartitions; the build sides broadcast; the final edge to
+    # the coordinator gathers
+    assert "repartition" in kinds
+    assert "broadcast" in kinds
+    assert "gather" in kinds
+    repart = [f for f in dag.fragments
+              if f.output_kind == "repartition"]
+    assert all(f.output_keys for f in repart)
+    # non-leaf fragments exist (inputs from upstream stages) — the
+    # shapes whose loss PR-5 could not recover
+    assert any(f.inputs for f in dag.fragments)
+    # leaf fragments carry a deterministic split table
+    leaves = [f for f in dag.fragments if not f.inputs]
+    assert all(f.split_table for f in leaves if f.sharded)
+    # the whole DAG passes the static verifier (RemoteSource types vs
+    # origin-fragment output across every exchange hop)
+    PC.verify_dag(single.executor, dag)
+    # ... and every fragment root ships through plan serde verbatim
+    from presto_tpu.dist import plan_serde
+
+    for f in dag.fragments:
+        assert plan_serde.dumps(plan_serde.loads(
+            plan_serde.dumps(f.root))) == plan_serde.dumps(f.root)
+
+
+def test_fragment_dag_refuses_bare_scan(single):
+    plan = single.plan("select r_name from region")
+    assert fragment_dag(single.executor, plan,
+                        single.catalogs) is None
+
+
+def test_fragment_dag_refuses_sharded_unique_id(single):
+    from presto_tpu.exec import plan as P
+    from presto_tpu.expr import ir as E
+
+    scan = single.plan("select o_orderkey from orders")
+    while not isinstance(scan, P.TableScan):
+        scan = scan.children()[0]
+    t = single.executor.output_types(scan)[0]
+    plan = P.Output(
+        source=P.UniqueId(source=P.Filter(
+            source=scan,
+            predicate=E.call("lt", E.input_ref(0, t),
+                             E.const(100, t)))),
+        names=("k", "uid"))
+    # per-task unique-id counters would collide across tasks
+    assert fragment_dag(single.executor, plan,
+                        single.catalogs) is None
+
+
+def test_string_repartition_degrades_to_gather(single):
+    # group keys are dictionary-coded strings: codes are producer-
+    # local, so the exchange must degrade to a gather instead of
+    # hash-repartitioning on codes
+    q = ("select o_orderpriority, l_shipmode, count(*) "
+         "from orders join lineitem on o_orderkey = l_orderkey "
+         "group by o_orderpriority, l_shipmode")
+    plan = single.plan(q)
+    dag = fragment_dag(single.executor, plan, single.catalogs,
+                       gather_capacity=1)
+    assert dag is not None
+    for f in dag.fragments:
+        assert f.output_kind != "repartition", (
+            f"stage {f.fid} repartitions on string keys")
+
+
+# ------------------------------------------------- spool fetch / ack
+def test_spool_fetch_and_ack_endpoints(single, workers):
+    """The spooled-exchange data plane directly: a task with
+    outputPartitions=2 hash-partitions its pages into PageStore-backed
+    buffers; partitions fetch token-indexed and disjoint, re-fetch is
+    byte-identical (dedupe), and ack releases the partition."""
+    from presto_tpu.dist import plan_serde, serde
+    from presto_tpu.exec import plan as P
+
+    plan = single.plan("select o_orderkey from orders")
+    scan = plan
+    while not isinstance(scan, P.TableScan):
+        scan = scan.children()[0]
+    payload = {
+        "taskId": "spool-test.0",
+        "fragment": plan_serde.dumps(scan),
+        "splitTable": "orders",
+        "splitIndex": 0,
+        "splitCount": 1,
+        "outputPartitions": 2,
+        "outputKeys": [0],
+        "session": {},
+    }
+    req = urllib.request.Request(
+        f"{workers[0]}/v1/task", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=30).close()
+
+    def fetch_part(part):
+        rows, blobs, token = [], [], 0
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            r = urllib.request.urlopen(
+                f"{workers[0]}/v1/task/spool-test.0/results/{token}"
+                f"?part={part}", timeout=30)
+            if r.status == 204:
+                if r.headers.get("X-Done") == "1":
+                    return rows, blobs
+                continue
+            body = r.read()
+            token = int(r.headers["X-Next-Token"])
+            blobs.append(body)
+            rows.extend(serde.deserialize_page(body).to_pylist())
+        raise AssertionError("spool fetch timed out")
+
+    rows0, blobs0 = fetch_part(0)
+    rows1, _ = fetch_part(1)
+    want = single.execute("select o_orderkey from orders").rows
+    # disjoint union across partitions = the full result
+    assert rows_equal(rows0 + rows1, want)
+    keys0 = {r[0] for r in rows0}
+    keys1 = {r[0] for r in rows1}
+    assert not (keys0 & keys1)
+    assert rows0 and rows1  # both partitions non-trivial
+    # token re-fetch is byte-identical (at-least-once + dedupe)
+    r = urllib.request.urlopen(
+        f"{workers[0]}/v1/task/spool-test.0/results/0?part=0",
+        timeout=30)
+    assert r.read() == blobs0[0]
+    # ack releases partition 0; further fetch answers 410 GONE
+    req = urllib.request.Request(
+        f"{workers[0]}/v1/task/spool-test.0/spool/0", method="DELETE")
+    urllib.request.urlopen(req, timeout=5).close()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"{workers[0]}/v1/task/spool-test.0/results/0?part=0",
+            timeout=5)
+    assert ei.value.code == 410
+    # partition 1 is untouched by partition 0's ack
+    rows1b, _ = fetch_part(1)
+    assert rows_equal(rows1b, rows1)
+    req = urllib.request.Request(
+        f"{workers[0]}/v1/task/spool-test.0", method="DELETE")
+    urllib.request.urlopen(req, timeout=5).close()
+
+
+# --------------------------------------------------------- end to end
+def test_dag_distributes_shape_legacy_could_not(single, workers):
+    """The acceptance shape: legacy cuts fall back LOCAL on the
+    3-stage plan; the stage scheduler runs it across 2 workers with
+    identical rows and spooled exchanges."""
+    legacy = _make_coord(workers, stage_scheduler="false")
+    dag_coord = _make_coord(workers, agg_gather_capacity=64)
+    try:
+        want = single.execute(DAG_QUERY).rows
+        got_legacy = legacy.execute(DAG_QUERY)
+        assert legacy.last_distribution == "local"
+        assert rows_equal(got_legacy, want)
+
+        ex = dag_coord.runner.executor
+        stages0 = ex.stages_scheduled
+        got = dag_coord.execute(DAG_QUERY)
+        assert dag_coord.last_distribution == "stage-dag"
+        assert rows_equal(got, want), "stage-DAG rows diverged"
+        sched = dag_coord.last_scheduler
+        assert ex.stages_scheduled - stages0 >= 3
+        assert ex.spooled_exchange_pages > 0
+        # both workers actually ran tasks
+        used = {t.placement.uri for ts in sched.tasks.values()
+                for t in ts}
+        assert used == set(workers)
+        # the new counters ride the registry into every surface
+        from presto_tpu.exec.counters import QUERY_COUNTERS, snapshot
+
+        snap = snapshot(ex)
+        for name in ("stages_scheduled", "spooled_exchange_pages",
+                     "nonleaf_replays", "speculative_tasks_won",
+                     "speculative_tasks_lost"):
+            assert name in QUERY_COUNTERS and name in snap
+    finally:
+        legacy.close()
+        dag_coord.close()
+
+
+def test_dag_forced_mode_partitioned_join_parity(single, workers):
+    """stage_scheduler=true forces DAG-first even for legacy-capable
+    shapes; join_distribution_type=partitioned exercises the
+    hash-repartition spool partitions on both join sides."""
+    coord = _make_coord(workers, stage_scheduler="true",
+                        join_distribution_type="partitioned")
+    try:
+        want = single.execute(QUERIES[3]).rows
+        got = coord.execute(QUERIES[3])
+        assert coord.last_distribution == "stage-dag"
+        assert rows_equal(got, want)
+        # a repartition edge was actually scheduled
+        dag = coord.last_scheduler.dag
+        assert any(f.output_kind == "repartition"
+                   for f in dag.fragments)
+    finally:
+        coord.close()
+
+
+def test_dag_auto_falls_back_local_with_dead_pool(single):
+    """Auto mode preserves the pre-DAG contract: a DAG-distributable
+    query with NO alive workers still runs locally instead of failing
+    (forced mode and legacy-distributable shapes keep failing loudly,
+    as before)."""
+    dead = ["http://127.0.0.1:1", "http://127.0.0.1:2"]
+    coord = DcnRunner({"tpch": TpchConnector(SF)}, dead,
+                      default_catalog="tpch", page_rows=PAGE_ROWS,
+                      session_props={"agg_gather_capacity": 64})
+    try:
+        for _ in range(3):  # fail_after=3 consecutive misses
+            coord.heartbeat.check_once()
+        got = coord.execute(DAG_QUERY)
+        assert coord.last_distribution == "local"
+        assert rows_equal(got, single.execute(DAG_QUERY).rows)
+    finally:
+        coord.close()
+
+
+def test_dag_auto_keeps_legacy_shapes_on_legacy_path(single, workers):
+    coord = _make_coord(workers)
+    try:
+        q = ("select l_returnflag, count(*), sum(l_quantity) "
+             "from lineitem group by l_returnflag")
+        got = coord.execute(q)
+        assert coord.last_distribution in ("hash", "roundrobin")
+        assert rows_equal(got, single.execute(q).rows)
+    finally:
+        coord.close()
+
+
+# ------------------------------------------------ scheduler policies
+def test_speculation_dedupe(single, workers):
+    """A deterministic straggler (FAULT_TASK_EXEC_DELAY_MS) is raced
+    by a re-dispatched copy on the other worker; the copy wins, the
+    loser is cancelled, and rows stay exactly-once."""
+    coord = _make_coord(workers, stage_scheduler="true",
+                        speculation_enabled=True,
+                        agg_gather_capacity=64)
+    _post_fault(workers[1], FAULT_TASK_EXEC_DELAY_MS=15000)
+    try:
+        ex = coord.runner.executor
+        won0 = ex.speculative_tasks_won
+        want = single.execute(DAG_QUERY).rows
+        t0 = time.monotonic()
+        got = coord.execute(DAG_QUERY)
+        wall = time.monotonic() - t0
+        assert rows_equal(got, want), "speculation duplicated rows"
+        assert ex.speculative_tasks_won > won0
+        # the race genuinely beat the 15s straggler sleep per stage
+        assert wall < 60
+    finally:
+        _post_fault(workers[1])
+        coord.close()
+
+
+def test_midquery_worker_readmission(single, workers):
+    """An excluded worker whose heartbeat recovers rejoins the pool at
+    the NEXT STAGE of the same query (before ISSUE 7, _excluded nodes
+    only rejoined between queries)."""
+    coord = _make_coord(workers, stage_scheduler="true",
+                        agg_gather_capacity=64)
+    excluded_at = {}
+
+    def hook(fid):
+        if not excluded_at:
+            # simulate a mid-query exclusion of a HEALTHY worker
+            # after the first stage completes
+            coord._excluded.add(workers[1])
+            excluded_at["fid"] = fid
+
+    coord._stage_hook = hook
+    try:
+        want = single.execute(DAG_QUERY).rows
+        got = coord.execute(DAG_QUERY)
+        assert rows_equal(got, want)
+        pools = coord.last_scheduler.stage_pools
+        assert len(pools) >= 3
+        # the stage right after the exclusion re-probed the live
+        # worker and re-admitted it mid-query
+        assert workers[1] in pools[-1]
+        assert workers[1] not in coord._excluded
+    finally:
+        coord._stage_hook = None
+        coord.close()
+
+
+# ------------------------------------------------------ static checks
+def test_check_task_payload_sources():
+    base = {
+        "taskId": "q.f1.t0", "splitIndex": 0, "splitCount": 2,
+        "fragment": "{}", "outputPartitions": 1,
+        "sources": {"stage0": {
+            "partition": 0,
+            "tasks": [{"uri": "http://h:1", "taskId": "q.f0.t0"}],
+        }},
+    }
+    PC.check_task_payload(base)  # non-leaf payload: sources suffice
+    bad = dict(base, sources={"stage0": {"partition": 0, "tasks": []}})
+    with pytest.raises(PC.PlanCheckError, match="producer placements"):
+        PC.check_task_payload(bad)
+    bad = dict(base, sources={"stage0": {
+        "partition": -1,
+        "tasks": [{"uri": "http://h:1", "taskId": "t"}]}})
+    with pytest.raises(PC.PlanCheckError, match="negative spool"):
+        PC.check_task_payload(bad)
+    bad = dict(base, outputPartitions=4)
+    with pytest.raises(PC.PlanCheckError, match="outputKeys"):
+        PC.check_task_payload(bad)
+    bad = {k: v for k, v in base.items() if k != "sources"}
+    with pytest.raises(PC.PlanCheckError, match="splitTable"):
+        PC.check_task_payload(bad)
+
+
+def test_verify_dag_catches_bad_repartition_keys(single):
+    import dataclasses
+
+    plan = single.plan(DAG_QUERY)
+    dag = fragment_dag(single.executor, plan, single.catalogs,
+                       gather_capacity=64)
+    PC.verify_dag(single.executor, dag)  # clean as fragmented
+    idx = next(i for i, f in enumerate(dag.fragments)
+               if f.output_kind == "repartition")
+    dag.fragments[idx] = dataclasses.replace(
+        dag.fragments[idx], output_keys=(99,))
+    with pytest.raises(PC.PlanCheckError, match="out of range"):
+        PC.verify_dag(single.executor, dag)
+
+
+def test_verify_dag_catches_unknown_edge(single):
+    plan = single.plan(DAG_QUERY)
+    dag = fragment_dag(single.executor, plan, single.catalogs,
+                       gather_capacity=64)
+    dag.fragments.pop(0)  # stage0 vanishes; its consumers still
+    with pytest.raises(PC.PlanCheckError,
+                       match="names no fragment"):
+        PC.verify_dag(single.executor, dag)
+
+
+def test_clip_for_shipping_bounds_payloads(single):
+    """Shipped fragment blobs keep only the origin chains type
+    resolution needs: a final agg's partial origin survives, other
+    RemoteSource origins drop — payloads stay linear in plan size."""
+    from presto_tpu.dist import plan_serde
+    from presto_tpu.dist.fragmenter import clip_for_shipping
+    from presto_tpu.exec import plan as P
+
+    plan = single.plan(DAG_QUERY)
+    dag = fragment_dag(single.executor, plan, single.catalogs,
+                       gather_capacity=64)
+    ex = single.executor
+    for f in dag.fragments:
+        clipped = clip_for_shipping(f.root)
+        # type resolution still works on the clipped tree (the worker
+        # runs plan_check + output_types on exactly this)
+        assert [t.display() for t in ex.output_types(clipped)] == \
+            [t.display() for t in ex.output_types(f.root)]
+        assert len(plan_serde.dumps(clipped)) <= \
+            len(plan_serde.dumps(f.root))
+
+        def walk(n, under_final_source=False):
+            if isinstance(n, P.RemoteSource):
+                if not under_final_source:
+                    assert n.origin is None, \
+                        "non-type-recovery origin survived clipping"
+                return
+            if isinstance(n, P.Aggregation) and n.step == "final":
+                walk(n.source, under_final_source=True)
+                return
+            for c in n.children():
+                walk(c)
+
+        walk(clipped)
+
+
+def test_stage_key_is_canonical():
+    assert stage_key(3) == "stage3"  # stable across queries: jit-key
+    # material derived from RemoteSource.key must not vary per query
+
+
+def test_coordinator_serves_worker_task_plane(single):
+    """PrestoTpuServer(worker_tasks=True) is a full DCN peer: the
+    coordinator HTTP server serves the /v1/task control plane and the
+    spool fetch data plane through the shared route functions — a
+    coordinator+worker single-process deployment."""
+    from presto_tpu.dist import plan_serde, serde
+    from presto_tpu.exec import plan as P
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    srv = PrestoTpuServer({"tpch": TpchConnector(SF)}, port=0,
+                          default_catalog="tpch",
+                          page_rows=PAGE_ROWS, worker_tasks=True)
+    srv.start()
+    uri = f"http://127.0.0.1:{srv.port}"
+    try:
+        plan = single.plan("select n_nationkey from nation")
+        scan = plan
+        while not isinstance(scan, P.TableScan):
+            scan = scan.children()[0]
+        payload = {
+            "taskId": "coord-task.0",
+            "fragment": plan_serde.dumps(scan),
+            "splitTable": "nation", "splitIndex": 0, "splitCount": 1,
+            "outputPartitions": 1, "session": {},
+        }
+        req = urllib.request.Request(
+            f"{uri}/v1/task", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=30).close()
+        rows, token = [], 0
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            r = urllib.request.urlopen(
+                f"{uri}/v1/task/coord-task.0/results/{token}?part=0",
+                timeout=30)
+            if r.status == 204:
+                if r.headers.get("X-Done") == "1":
+                    break
+                continue
+            token = int(r.headers["X-Next-Token"])
+            rows.extend(serde.deserialize_page(r.read()).to_pylist())
+        want = single.execute("select n_nationkey from nation").rows
+        assert rows_equal(rows, want)
+        # ... while the statement surface still answers on the same port
+        with urllib.request.urlopen(f"{uri}/v1/info", timeout=5) as r:
+            assert json.loads(r.read())["coordinator"] is True
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- the acceptance gate
+def _boot_subprocess_worker(extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in ("FAULT_DELAY_MS", "FAULT_DROP_EVERY",
+              "FAULT_KILL_AFTER_FETCHES", "FAULT_SUBMIT_DROP_EVERY",
+              "FAULT_TASK_EXEC_DELAY_MS"):
+        env.pop(k, None)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.server.worker",
+         "--port", "0", "--suite", "tpch", "--scale", str(SF),
+         "--page-rows", str(PAGE_ROWS)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        text=True,
+    )
+    info = json.loads(proc.stdout.readline())
+    return proc, f"http://127.0.0.1:{info['port']}"
+
+
+@pytest.mark.slow
+def test_nonleaf_kill_recovers_via_spool_replay(single):
+    """ISSUE 7 acceptance: a worker hard-killed MID-QUERY while the
+    DAG's non-leaf stages run (it hosts spools AND a non-leaf task) is
+    recovered by spooled replay — the query completes with
+    single-process-identical rows and nonleaf_replays >= 1 reaches
+    EXPLAIN ANALYZE through the counter registry."""
+    p1, u1 = _boot_subprocess_worker()
+    p2, u2 = _boot_subprocess_worker(
+        {"FAULT_KILL_AFTER_FETCHES": "2"})
+    coord = None
+    try:
+        coord = DcnRunner(
+            {"tpch": TpchConnector(SF)}, [u1, u2],
+            default_catalog="tpch", page_rows=PAGE_ROWS,
+            fetch_retries=2,
+            session_props={"agg_gather_capacity": 64,
+                           "retry_backoff_ms": 20})
+        want = single.execute(DAG_QUERY).rows
+        got = coord.execute(DAG_QUERY)
+        assert coord.last_distribution == "stage-dag"
+        assert rows_equal(got, want), \
+            "DAG with a mid-query non-leaf kill diverged"
+        ex = coord.runner.executor
+        assert ex.nonleaf_replays >= 1, \
+            "recovery did not replay a non-leaf task from spools"
+        assert ex.workers_excluded >= 1
+        p2.wait(timeout=10)
+        assert p2.poll() is not None  # the kill was real
+        from presto_tpu.exec.counters import snapshot
+
+        assert snapshot(ex)["nonleaf_replays"] >= 1
+    finally:
+        if coord is not None:
+            coord.close()
+        for p in (p1, p2):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

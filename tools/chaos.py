@@ -1,6 +1,8 @@
 """Chaos harness: a small TPC-H query matrix under randomized fault
 schedules (delay / drop / kill / submit-drop, seeded RNG) against the
-fault-tolerant DCN slice (dist/dcn.py task retry + query deadlines).
+fault-tolerant DCN slice (dist/dcn.py task retry + query deadlines)
+AND the general stage-DAG scheduler (dist/scheduler.py spooled
+exchanges + non-leaf replay).
 
 Every iteration picks a query and a fault mode, applies the fault to a
 random worker via the runtime POST /v1/fault surface, executes through
@@ -11,8 +13,16 @@ set re-admits them on a fresh ping — the node-rejoin model). Exits
 nonzero on ANY wrong result, unexpected error, or hang past the query
 deadline.
 
+The "dag" query is a 3-stage shape the legacy cuts cannot distribute
+(left join under an aggregation under a join) and runs through the
+stage scheduler; the kill-nonleaf mode pins the ISSUE-7 recovery
+contract — a worker killed while serving spool fetches mid-DAG must
+recover via spooled NON-LEAF replay (`--mode kill-nonleaf` exits
+nonzero if no nonleaf_replays were recorded across the run).
+
 Usage: chaos.py [--iterations 20] [--seed 0] [--scale 0.01]
                 [--workers 2] [--deadline-ms 180000]
+                [--mode kill-nonleaf]
 """
 
 import argparse
@@ -33,8 +43,21 @@ PAGE_ROWS = 1 << 13
 FAULT_KEYS = (
     "FAULT_DELAY_MS", "FAULT_DROP_EVERY", "FAULT_KILL_AFTER_FETCHES",
     "FAULT_SUBMIT_DROP_EVERY", "FAULT_DEVICE_OOM",
+    "FAULT_TASK_EXEC_DELAY_MS",
 )
-FAULT_MODES = ("none", "delay", "drop", "kill", "submit-drop")
+FAULT_MODES = ("none", "delay", "drop", "kill", "submit-drop",
+               "kill-nonleaf")
+
+# the 3-stage DAG shape (left join -> hash agg -> join -> agg) the
+# legacy agg/union cuts fall back local on; the stage scheduler
+# distributes it and spools every exchange
+DAG_QUERY = (
+    "select n_name, count(*), sum(top.c_count) from nation join ("
+    "  select c_nationkey nk, c_custkey ck, count(o_orderkey) c_count"
+    "  from customer left join orders on c_custkey = o_custkey"
+    "  group by c_nationkey, c_custkey) top on n_nationkey = top.nk "
+    "group by n_name order by n_name"
+)
 
 
 def query_matrix():
@@ -48,6 +71,7 @@ def query_matrix():
             "select o_orderpriority, approx_distinct(o_custkey), "
             "sum(o_totalprice) from orders group by o_orderpriority"
         ),
+        "dag": DAG_QUERY,
     }
 
 
@@ -124,6 +148,10 @@ def main() -> int:
     ap.add_argument("--scale", type=float, default=0.01)
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--deadline-ms", type=int, default=180_000)
+    ap.add_argument("--mode", choices=FAULT_MODES, default=None,
+                    help="pin every iteration to one fault mode "
+                    "(kill-nonleaf additionally requires at least "
+                    "one nonleaf_replay across the run)")
     args = ap.parse_args()
 
     from presto_tpu.connectors.tpch import TpchConnector
@@ -150,6 +178,9 @@ def main() -> int:
             "task_retry_attempts": 2,
             "retry_backoff_ms": 50,
             "query_max_run_time": args.deadline_ms,
+            # the dag query engages the stage scheduler via the auto
+            # gate (the legacy cuts cannot distribute its shape)
+            "agg_gather_capacity": 64,
         },
     )
     ex = coord.runner.executor
@@ -157,8 +188,12 @@ def main() -> int:
     failures = 0
     try:
         for i in range(args.iterations):
-            qname = rng.choice(sorted(matrix))
-            mode = rng.choice(FAULT_MODES)
+            mode = args.mode or rng.choice(FAULT_MODES)
+            # kill-during-non-leaf-stage schedule: the victim dies
+            # while serving spool fetches mid-DAG — recovery must come
+            # from spooled replay, not leaf re-generation alone
+            qname = ("dag" if mode == "kill-nonleaf"
+                     else rng.choice(sorted(matrix)))
             for w in workers:
                 w.ensure()
             victim = rng.choice(workers)
@@ -169,10 +204,13 @@ def main() -> int:
                 "kill": {"FAULT_KILL_AFTER_FETCHES":
                          rng.choice((1, 2))},
                 "submit-drop": {"FAULT_SUBMIT_DROP_EVERY": 2},
+                "kill-nonleaf": {"FAULT_KILL_AFTER_FETCHES":
+                                 rng.choice((1, 2))},
             }[mode]
             for w in workers:
                 w.set_fault(config if w is victim else {})
             retries0, excl0 = ex.task_retries, ex.workers_excluded
+            nonleaf0 = ex.nonleaf_replays
             t0 = time.monotonic()
             status = "ok"
             try:
@@ -187,18 +225,24 @@ def main() -> int:
             if wall * 1000 > args.deadline_ms:
                 status += " + HANG past deadline"
                 failures += 1
-            print(f"iter {i:02d} q={qname:<6} fault={mode:<11} "
+            print(f"iter {i:02d} q={qname:<6} fault={mode:<12} "
                   f"wall={wall:6.2f}s task_retries="
                   f"+{ex.task_retries - retries0} excluded="
-                  f"+{ex.workers_excluded - excl0} dist="
+                  f"+{ex.workers_excluded - excl0} nonleaf="
+                  f"+{ex.nonleaf_replays - nonleaf0} dist="
                   f"{coord.last_distribution}: {status}", flush=True)
     finally:
         coord.close()
         for w in workers:
             w.kill()
+    if args.mode == "kill-nonleaf" and ex.nonleaf_replays == 0:
+        print("# chaos: kill-nonleaf run recorded ZERO nonleaf_replays"
+              " — the spooled-replay path was never exercised")
+        failures += 1
     print(f"# chaos: {args.iterations} iterations, {failures} failures,"
           f" task_retries={ex.task_retries} "
           f"workers_excluded={ex.workers_excluded} "
+          f"nonleaf_replays={ex.nonleaf_replays} "
           f"release_skips={coord.release_skips}")
     return 1 if failures else 0
 

@@ -29,7 +29,9 @@ sys.path.insert(
 from tools._common import make_runner, queries  # noqa: E402
 
 
-def _audit_one(runner, label: str, sql: str, failures: list) -> None:
+def _audit_one(runner, label: str, sql: str, failures: list,
+               dag_stats: list) -> None:
+    from presto_tpu.dist.fragmenter import fragment_dag
     from presto_tpu.exec import plan_check as PC
 
     try:
@@ -46,8 +48,36 @@ def _audit_one(runner, label: str, sql: str, failures: list) -> None:
               file=sys.stderr)
         for v in e.violations:
             print(f"#   - {v}", file=sys.stderr)
+        return
+    # ISSUE 7: fragment the SAME plan through the general stage-DAG
+    # cutter and verify the resulting multi-stage DAG (RemoteSource
+    # types vs origin-fragment output across every exchange hop,
+    # repartition-key sanity, co-partitioned join agreement). Pure
+    # host planning — no trace/compile — so the sweep stays cheap.
+    try:
+        dag = fragment_dag(runner.executor, plan, runner.catalogs)
+    except Exception as e:  # noqa: BLE001 - a cut failure is a verdict
+        failures.append((label, [f"fragment_dag failed: {e!r}"]))
+        print(f"# {label}: FRAGMENTATION FAILED {e!r}",
+              file=sys.stderr)
+        return
+    if dag is not None:
+        try:
+            PC.verify_dag(runner.executor, dag)
+        except PC.PlanCheckError as e:
+            failures.append((label, [f"[stage-dag] {v}"
+                                     for v in e.violations]))
+            print(f"# {label}: {len(e.violations)} DAG violation(s)",
+                  file=sys.stderr)
+            for v in e.violations:
+                print(f"#   - {v}", file=sys.stderr)
+            return
+        dag_stats.append(len(dag.fragments))
+        print(f"# {label}: ok ({len(dag.fragments)}-stage dag)",
+              file=sys.stderr)
     else:
-        print(f"# {label}: ok", file=sys.stderr)
+        print(f"# {label}: ok (not dag-distributable)",
+              file=sys.stderr)
 
 
 def main() -> int:
@@ -67,6 +97,7 @@ def main() -> int:
 
     t0 = time.time()
     failures: list = []
+    dag_stats: list = []
     n = 0
     if do_rungs:
         from bench import RUNGS
@@ -77,23 +108,27 @@ def main() -> int:
             # prewarm path verifies the same plans before compiling
             runner = make_runner(suite, sf, props)
             _audit_one(runner, f"rung {name}",
-                       queries(suite)[qid], failures)
+                       queries(suite)[qid], failures, dag_stats)
             n += 1
     for suite in corpora:
         runner = make_runner(suite, args.sf)
         for qid, sql in sorted(queries(suite).items()):
-            _audit_one(runner, f"{suite} q{qid}", sql, failures)
+            _audit_one(runner, f"{suite} q{qid}", sql, failures,
+                       dag_stats)
             n += 1
     wall = time.time() - t0
+    multi = sum(1 for s in dag_stats if s >= 2)
     print(f"# plan_audit: {n} plans, {len(failures)} with violations, "
-          f"{wall:.1f}s", file=sys.stderr)
+          f"{len(dag_stats)} dag-distributable "
+          f"({multi} multi-stage), {wall:.1f}s", file=sys.stderr)
     if failures:
         print("PLAN AUDIT FAILED:")
         for label, violations in failures:
             for v in violations:
                 print(f"  {label}: {v}")
         return 1
-    print(f"plan audit clean: {n} plans verified in {wall:.1f}s")
+    print(f"plan audit clean: {n} plans verified "
+          f"({len(dag_stats)} stage DAGs) in {wall:.1f}s")
     return 0
 
 
