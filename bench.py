@@ -575,12 +575,22 @@ def group_child(only_names) -> int:
                 break
         steady = statistics.median(times)
         if profile_dir and name == HEADLINE:
+            # device-level (XLA/TPU) trace for the headline rung —
+            # the jax.profiler hook complementing the engine-level
+            # Chrome trace below (BENCH_PROFILE=1 enables)
             with jax.profiler.trace(profile_dir):
                 run_device()
+            r["device_profile_dir"] = profile_dir
         r.update({
             "steady_s": round(steady, 5),
             "times_s": [round(t, 5) for t in times],
             "slots_per_s": round(slots_in / steady),
+            # rep-latency spread (ISSUE 9): with <=3 reps p99 is the
+            # max — honest for the artifact, and the field names match
+            # what the concurrent-load benchmark (ROADMAP item 1) will
+            # report at real sample counts
+            "p50_s": round(statistics.median(times), 5),
+            "p99_s": round(max(times), 5),
         })
         r.pop("time_error", None)  # a retried group child succeeded
         print(f"# {name}: steady {steady*1e3:.1f} ms "
@@ -636,6 +646,26 @@ def group_child(only_names) -> int:
               f"decode {decode_s:.2f}s overflow={overflow} "
               f"boost={ex._capacity_boost}", file=sys.stderr)
         del pages, rows
+
+        # ---- lifecycle trace export (ISSUE 9): one extra traced run
+        # per rung when BENCH_TRACE_DIR is set — off the timed path
+        # and after path_counters() snapshotted the timed run, so the
+        # trace run's counter resets cannot contaminate the artifact.
+        # The Chrome JSON loads in Perfetto; BENCH_DETAILS records the
+        # path so the driver's artifact links timing to its timeline.
+        trace_dir = os.environ.get("BENCH_TRACE_DIR")
+        if trace_dir:
+            from presto_tpu import obs as OBS
+
+            tr = OBS.QueryTrace(name)
+            OBS.attach(ex, tr)
+            try:
+                ex.execute(plan)
+            finally:
+                OBS.finalize(ex, tr, trace_dir)
+            r["trace_path"] = os.path.join(
+                trace_dir, f"{name}.trace.json")
+            _write_details(details)
 
         # ---- generation-only attribution
         cols = QUERY_COLS.get((suite, qid))

@@ -157,6 +157,9 @@ ETC_SESSION_KEYS: Dict[str, str] = {
     "stage-scheduler": "stage_scheduler",
     "speculation.enabled": "speculation_enabled",
     "spool-exchange.bytes": "spool_exchange_bytes",
+    "query-trace.enabled": "query_trace_enabled",
+    "query-trace.dir": "query_trace_dir",
+    "stats-profile.dir": "stats_profile_dir",
 }
 
 # consumed structurally by server_from_etc (constructor args /

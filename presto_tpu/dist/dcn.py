@@ -114,6 +114,7 @@ class _TaskState:
     payload: Dict
     next_token: int = 0
     retries_used: int = 0
+    trace_t0: float = 0.0  # dispatch instant on the trace clock
     hasher: "hashlib._Hash" = dataclasses.field(
         default_factory=lambda: hashlib.sha256())
 
@@ -221,6 +222,20 @@ class DcnRunner:
         return int(self.runner.session.get("retry_backoff_ms"))
 
     # --------------------------------------------------------- protocol
+    def _task_spans(self, st: _TaskState) -> List[Dict]:
+        """One best-effort status poll for a task's worker-side spans
+        (queue/run/attempt, shipped on the status plane). Transport
+        errors return [] — the timeline loses the worker detail, the
+        query loses nothing."""
+        try:
+            with urllib.request.urlopen(
+                f"{st.uri}/v1/task/{st.task_id}", timeout=5
+            ) as r:
+                return json.loads(r.read().decode()).get("spans") or []
+        except (urllib.error.URLError, ConnectionError, OSError,
+                ValueError):
+            return []
+
     def _post_task(self, uri: str, payload: Dict) -> Dict:
         req = urllib.request.Request(
             f"{uri}/v1/task",
@@ -482,12 +497,20 @@ class DcnRunner:
                 ) from cause
             st.uri, st.task_id, st.payload = target, new_id, payload
             self.runner.executor.task_retries += 1
+            tr = self.runner.executor.trace
+            if tr is not None:
+                # recovery annotation on the query timeline
+                tr.complete("retry", new_id, tr.now(), tr.now(),
+                            to=target, attempt=st.retries_used,
+                            cause=str(cause)[:120])
+                self.runner.executor.trace_spans += 1
             E.dispatch(
                 self.listeners, "task_retried", E.TaskRetryEvent(
                     query_id=base_id.split(".", 1)[0],
                     task_id=new_id, from_uri=from_uri, to_uri=target,
                     attempt=st.retries_used, cause=str(cause)[:400],
-                )
+                ),
+                on_error=self.runner.executor.count_listener_error,
             )
             return
 
@@ -509,13 +532,28 @@ class DcnRunner:
         straggler speculation, per-stage pool recomputation."""
         import uuid as _uuid
 
+        from presto_tpu import obs as OBS
         from presto_tpu.dist.scheduler import StageScheduler
 
         self.last_distribution = "stage-dag"
-        sched = StageScheduler(self, dag, _uuid.uuid4().hex[:12],
+        qid = _uuid.uuid4().hex[:12]
+        # lifecycle tracing: attach BEFORE constructing the scheduler
+        # (it snapshots ex.trace); the coordinator's root-fragment
+        # execute() records its attempt/operator spans into the same
+        # trace, so one timeline covers stages + final drain
+        trace = OBS.maybe_trace(self.runner.session, query_id=qid)
+        if trace is not None:
+            OBS.attach(self.runner.executor, trace)
+        sched = StageScheduler(self, dag, qid,
                                stage_hook=self._stage_hook)
         self.last_scheduler = sched
-        return sched.run()
+        try:
+            return sched.run()
+        finally:
+            if trace is not None:
+                OBS.finalize(self.runner.executor, trace,
+                             self.runner.session.get("query_trace_dir"))
+            self.runner.last_trace = trace
 
     # ---------------------------------------------------------- execute
     def execute(self, sql: str):
@@ -618,82 +656,113 @@ class DcnRunner:
         # launch one task per pooled worker; the task body carries the
         # SERIALIZED fragment (plan shipping — reference:
         # TaskUpdateRequest.fragment), not SQL to replay
+        from presto_tpu import obs as OBS
+
         fragment = plan_serde.dumps(partial)
         qid = uuid.uuid4().hex[:12]
+        # lifecycle tracing for the legacy cuts: one trace covering
+        # dispatch, the token-acked fetches, recovery annotations, and
+        # the coordinator-side final stage's attempt/operator spans
+        trace = OBS.maybe_trace(self.runner.session, query_id=qid,
+                                sql=sql)
+        if trace is not None:
+            OBS.attach(ex, trace)
         tasks: List[_TaskState] = []
-        check_payloads = ex._plan_check_on()
-        for w, uri in enumerate(pool):
-            payload = {
-                "taskId": f"{qid}.{w}",
-                "fragment": fragment,
-                "splitTable": split_table,
-                "splitIndex": w,
-                "splitCount": len(pool),
-                "session": self.session_props,
-            }
-            if partition_cols is not None:
-                payload["splitMode"] = "hash"
-                payload["partitionColumns"] = partition_cols
-            if check_payloads:
-                # deterministic-split invariant (exec/plan_check.py):
-                # the PR-5 retry path re-generates EXACTLY this
-                # (splitIndex, splitCount) share on a survivor — a
-                # payload without it could not be re-dispatched.
-                # Same auto gate as the executor's plan verifier.
-                from presto_tpu.exec import plan_check as PC
-
-                PC.check_task_payload(payload)
-            st = _TaskState(uri=uri, task_id=payload["taskId"],
-                            payload=payload)
-            try:
-                self._post_task(uri, payload)
-            except (urllib.error.URLError, OSError) as e:
-                if retry_attempts <= 0:
-                    raise DcnQueryFailed(
-                        f"worker {uri}: task submit failed: {e}"
-                    ) from e
-                # submit retry: re-dispatch this split share to a
-                # different ALIVE worker (it runs two tasks)
-                self._recover_task(st, pool, retry_attempts,
-                                   deadline, e)
-            tasks.append(st)
-
-        # coordinator-side plan: shipped subtree -> RemoteSource
-        state_types = tuple(ex.output_types(partial))
         key = f"dcn-{qid}"
-        remote = P.RemoteSource(types=state_types, key=key,
-                                origin=partial)
-        if partial is cut:  # union cut: consume the union as-is
-            coord_plan = _replace_node(plan, cut, remote)
-        else:  # aggregation cut: FINAL step over the state pages
-            final = dataclasses.replace(cut, step="final",
-                                        source=remote)
-            coord_plan = _replace_node(plan, cut, final)
-
-        def supplier():
-            for st in tasks:
-                # a fresh supplier invocation (coordinator boosted
-                # retry re-pulling the remote source) refetches from
-                # token 0 — workers buffer the full page list; within
-                # ONE invocation next_token advances so a re-dispatched
-                # task resumes at the consumed token (dedupe)
-                st.next_token = 0
-                st.hasher = hashlib.sha256()
-                while True:
-                    try:
-                        yield from self._fetch_pages(st, deadline)
-                        break
-                    except _TaskLost as e:
-                        if retry_attempts <= 0:
-                            raise DcnQueryFailed(str(e)) from e
-                        # worker death mid-query: exclude the node and
-                        # re-run ONLY the lost fragment on a survivor,
-                        # resuming the fetch at the consumed token
-                        self._recover_task(st, pool, retry_attempts,
-                                           deadline, e)
-
-        ex.remote_sources[key] = supplier
+        check_payloads = ex._plan_check_on()
         try:
+            for w, uri in enumerate(pool):
+                payload = {
+                    "taskId": f"{qid}.{w}",
+                    "fragment": fragment,
+                    "splitTable": split_table,
+                    "splitIndex": w,
+                    "splitCount": len(pool),
+                    "session": self.session_props,
+                }
+                if trace is not None:
+                    payload["trace"] = True
+                if partition_cols is not None:
+                    payload["splitMode"] = "hash"
+                    payload["partitionColumns"] = partition_cols
+                if check_payloads:
+                    # deterministic-split invariant (exec/plan_check.py):
+                    # the PR-5 retry path re-generates EXACTLY this
+                    # (splitIndex, splitCount) share on a survivor — a
+                    # payload without it could not be re-dispatched.
+                    # Same auto gate as the executor's plan verifier.
+                    from presto_tpu.exec import plan_check as PC
+
+                    PC.check_task_payload(payload)
+                st = _TaskState(uri=uri, task_id=payload["taskId"],
+                                payload=payload)
+                d0 = trace.now() if trace is not None else 0.0
+                try:
+                    self._post_task(uri, payload)
+                except (urllib.error.URLError, OSError) as e:
+                    if retry_attempts <= 0:
+                        raise DcnQueryFailed(
+                            f"worker {uri}: task submit failed: {e}"
+                        ) from e
+                    # submit retry: re-dispatch this split share to a
+                    # different ALIVE worker (it runs two tasks)
+                    self._recover_task(st, pool, retry_attempts,
+                                       deadline, e)
+                if trace is not None:
+                    st.trace_t0 = d0
+                    trace.complete("dispatch", st.task_id, d0,
+                                   trace.now(), uri=st.uri)
+                    ex.trace_spans += 1
+                tasks.append(st)
+
+            # coordinator-side plan: shipped subtree -> RemoteSource
+            state_types = tuple(ex.output_types(partial))
+            remote = P.RemoteSource(types=state_types, key=key,
+                                    origin=partial)
+            if partial is cut:  # union cut: consume the union as-is
+                coord_plan = _replace_node(plan, cut, remote)
+            else:  # aggregation cut: FINAL step over the state pages
+                final = dataclasses.replace(cut, step="final",
+                                            source=remote)
+                coord_plan = _replace_node(plan, cut, final)
+
+            def supplier():
+                for st in tasks:
+                    # a fresh supplier invocation (coordinator boosted
+                    # retry re-pulling the remote source) refetches from
+                    # token 0 — workers buffer the full page list; within
+                    # ONE invocation next_token advances so a re-dispatched
+                    # task resumes at the consumed token (dedupe)
+                    st.next_token = 0
+                    st.hasher = hashlib.sha256()
+                    f0 = trace.now() if trace is not None else 0.0
+                    while True:
+                        try:
+                            yield from self._fetch_pages(st, deadline)
+                            break
+                        except _TaskLost as e:
+                            if retry_attempts <= 0:
+                                raise DcnQueryFailed(str(e)) from e
+                            # worker death mid-query: exclude the node and
+                            # re-run ONLY the lost fragment on a survivor,
+                            # resuming the fetch at the consumed token
+                            self._recover_task(st, pool, retry_attempts,
+                                               deadline, e)
+                    if trace is not None:
+                        trace.complete("fetch", st.task_id, f0,
+                                       trace.now(), uri=st.uri,
+                                       pages=st.next_token)
+                        ex.trace_spans += 1
+                        # one status poll per drained task ingests the
+                        # worker's queue/run/attempt spans into the
+                        # timeline (the stage-DAG path gets these from
+                        # its completion polls; the legacy path must
+                        # ask once, or workers record for no reader)
+                        ex.trace_spans += trace.ingest(
+                            self._task_spans(st), trace.root,
+                            st.trace_t0, trace.now())
+
+            ex.remote_sources[key] = supplier
             _, rows = ex.execute(coord_plan)
             return rows
         finally:
@@ -702,3 +771,7 @@ class DcnRunner:
             # expiry) — shared with the stage-DAG scheduler's cleanup
             for st in tasks:
                 self._release_task(st.uri, st.task_id)
+            if trace is not None:
+                OBS.finalize(ex, trace,
+                             self.runner.session.get("query_trace_dir"))
+            self.runner.last_trace = trace

@@ -53,6 +53,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
+from presto_tpu import events as E_events
 from presto_tpu.dist import plan_serde
 from presto_tpu.dist.fragmenter import (
     StageDag,
@@ -83,6 +84,7 @@ class _SchedTask:
     wall: float = 0.0
     spec: Optional[_Placement] = None  # speculation copy in flight
     spec_count: int = 0
+    span: object = None  # obs trace span for this LOGICAL task
 
 
 class _NodeDown(RuntimeError):
@@ -98,6 +100,11 @@ class StageScheduler:
         self.dag = dag
         self.qid = qid
         self.ex = coord.runner.executor
+        # query-lifecycle tracing (obs/trace.py): the DcnRunner
+        # attaches the trace to the coordinator executor BEFORE
+        # constructing the scheduler; None = tracing off and every
+        # recording site below is one attr check
+        self.trace = self.ex.trace
         # test/chaos hook: called with the fragment id after each
         # stage completes (deterministic mid-query fault injection)
         self.stage_hook = stage_hook
@@ -162,6 +169,10 @@ class StageScheduler:
         }
         if frag.split_table is not None:
             payload["splitTable"] = frag.split_table
+        if self.trace is not None:
+            # workers record queue/run/attempt spans and ship them on
+            # the status plane for the cross-node timeline
+            payload["trace"] = True
         if frag.output_kind == "repartition":
             payload["outputPartitions"] = self._consumer_tasks(t.fid)
             payload["outputKeys"] = list(frag.output_keys)
@@ -258,6 +269,14 @@ class StageScheduler:
         pool = self._pool()
         self.stage_pools.append(list(pool))
         stage = self.tasks[fid]
+        tr = self.trace
+        sspan = None
+        s_start = time.monotonic()
+        spooled0 = self.ex.spooled_exchange_pages
+        if tr is not None:
+            sspan = tr.begin("stage", f"stage{fid}",
+                             tasks=len(stage), pool=len(pool))
+            self.ex.trace_spans += 1
         for t in stage:
             if pool[t.index % len(pool)] in self.coord._excluded:
                 # an earlier submit in THIS wave excluded a node:
@@ -267,10 +286,19 @@ class StageScheduler:
                 pool = self._pool()
                 self.stage_pools[-1] = list(pool)
             target = pool[t.index % len(pool)]
+            if tr is not None:
+                t.span = tr.begin("task", t.base_id, parent=sspan,
+                                  uri=target)
+                self.ex.trace_spans += 1
             try:
+                d0 = tr.now() if tr is not None else 0.0
                 self._post(target, self._payload_for(t, t.base_id))
                 t.placement = _Placement(target, t.base_id)
                 t.dispatched_at = time.monotonic()
+                if tr is not None:
+                    tr.complete("dispatch", t.base_id, d0, tr.now(),
+                                parent=t.span, uri=target)
+                    self.ex.trace_spans += 1
             except (urllib.error.URLError, OSError) as e:
                 # submit failure: recover through the shared path
                 # (exclude + re-dispatch to a survivor) — not a spool
@@ -280,6 +308,26 @@ class StageScheduler:
                 self._redispatch(t, cause=e, replay=False)
         self.ex.stages_scheduled += 1
         self._wait(stage)
+        if tr is not None:
+            tr.end(sspan)
+        # the EventListener SPI fires traced or not (span stats ride
+        # along when tracing is on; walls come from monotonic either
+        # way — the timing-source rule)
+        E_events.dispatch(
+            self.coord.listeners, "stage_completed",
+            E_events.StageCompletedEvent(
+                query_id=self.qid, stage_id=f"stage{fid}",
+                task_count=len(stage),
+                wall_ms=int((time.monotonic() - s_start) * 1000),
+                retries=sum(t.retries for t in stage),
+                # per-STAGE delta, not the query-cumulative counter
+                # (the counter is coordinator-lifetime; a listener
+                # summing stage events must see each page once)
+                spooled_pages=(self.ex.spooled_exchange_pages
+                               - spooled0),
+            ),
+            on_error=self.ex.count_listener_error,
+        )
         if self._retry_attempts() <= 0:
             # pinned classic mode: no replay will ever need these
             # spools again once the consumer stage is done — ack
@@ -354,6 +402,41 @@ class StageScheduler:
             t.counted = True
             self.ex.spooled_exchange_pages += int(
                 st.get("spooledPages") or 0)
+        # cross-node timeline assembly: the worker's queue/run/attempt
+        # spans (offsets from ITS task creation) nest into this task's
+        # coordinator-side window, clamped so clock/queue skew can
+        # never produce a negative interval (obs/trace.ingest)
+        tr = self.trace
+        queue_ms = run_ms = 0
+        remote = st.get("spans") or []
+        for d in remote:
+            try:
+                ms = int((float(d["t1"]) - float(d["t0"])) * 1000)
+            except (KeyError, TypeError, ValueError):
+                continue
+            if d.get("kind") == "queue":
+                queue_ms += max(ms, 0)
+            elif d.get("kind") == "run":
+                run_ms += max(ms, 0)
+        if tr is not None and t.span is not None:
+            if remote:
+                self.ex.trace_spans += tr.ingest(
+                    remote, t.span, t.span.t0, tr.now())
+            tr.end(t.span, pages=int(st.get("pages") or 0),
+                   spooled=int(st.get("spooledPages") or 0),
+                   retries=t.retries, uri=t.placement.uri)
+        E_events.dispatch(
+            self.coord.listeners, "task_completed",
+            E_events.TaskCompletedEvent(
+                query_id=self.qid, task_id=t.placement.task_id,
+                stage_id=f"stage{t.fid}", uri=t.placement.uri,
+                state="FINISHED", wall_ms=int(t.wall * 1000),
+                queue_ms=queue_ms, run_ms=run_ms,
+                pages=int(st.get("pages") or 0), retries=t.retries,
+                speculative=t.spec_count > 0,
+            ),
+            on_error=self.ex.count_listener_error,
+        )
 
     # ----------------------------------------------------- recovery
     def _stage_done(self, fid: int) -> bool:
@@ -435,6 +518,15 @@ class StageScheduler:
                 # placement — orphaning it would leak its spool on
                 # the worker until task expiry
                 self._delete(t.spec)
+            if self.trace is not None and t.span is not None:
+                # trace annotation: the fault-tolerance path is part
+                # of the timeline (replay=True marks a spooled replay)
+                self.trace.complete(
+                    "retry", new_id, self.trace.now(),
+                    self.trace.now(), parent=t.span,
+                    attempt=t.retries, to=target,
+                    cause=str(cause)[:120], replay=bool(replay))
+                self.ex.trace_spans += 1
             t.placement = _Placement(target, new_id)
             t.done = False
             t.spec = None
@@ -450,7 +542,8 @@ class StageScheduler:
                     query_id=self.qid, task_id=new_id,
                     from_uri=from_uri, to_uri=target,
                     attempt=t.retries, cause=str(cause)[:400],
-                )
+                ),
+                on_error=self.ex.count_listener_error,
             )
             return
 
@@ -481,6 +574,11 @@ class StageScheduler:
         try:
             self._post(others[0], self._payload_for(t, sid))
             t.spec = _Placement(others[0], sid)
+            if self.trace is not None and t.span is not None:
+                self.trace.complete(
+                    "speculate", sid, self.trace.now(),
+                    self.trace.now(), parent=t.span, uri=others[0])
+                self.ex.trace_spans += 1
         except (urllib.error.URLError, OSError):
             pass  # speculation is best-effort; the original runs on
 
@@ -509,6 +607,7 @@ class StageScheduler:
 
         def supplier():
             deadline = self._deadline()
+            tr = self.trace
             for t in stage:
                 # fresh state per supplier invocation: a coordinator
                 # boosted retry re-pulls from token 0 (spools retain
@@ -521,6 +620,7 @@ class StageScheduler:
                     payload=self._payload_for(
                         t, t.placement.task_id),
                 )
+                f0 = tr.now() if tr is not None else 0.0
                 while True:
                     try:
                         yield from self.coord._fetch_pages(st, deadline)
@@ -529,6 +629,14 @@ class StageScheduler:
                         if self._retry_attempts() <= 0:
                             raise DcnQueryFailed(str(e)) from e
                         self._recover_root_fetch(t, st, e)
+                if tr is not None:
+                    # root-parented: the drain happens AFTER the task
+                    # span closed (task completion ≠ consumption) — a
+                    # fetch child would escape its parent's interval
+                    tr.complete("fetch", t.placement.task_id, f0,
+                                tr.now(), pages=st.next_token,
+                                uri=t.placement.uri)
+                    self.ex.trace_spans += 1
 
         return supplier
 

@@ -35,6 +35,44 @@ class QueryCompletedEvent:
     row_count: int
     error_name: Optional[str] = None
     error_message: Optional[str] = None
+    # the full QueryInfo/StageInfo/TaskInfo tree (obs/trace.to_info)
+    # when the query was traced — the reference QueryCompletedEvent
+    # carries QueryStats/StageStats the same way; None when tracing
+    # was off for this query
+    query_info: Optional[dict] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class StageCompletedEvent:
+    """One stage-DAG wave finished (dist/scheduler.py). wall_ms spans
+    first dispatch to last task completion on the coordinator's
+    monotonic clock (obs/trace.py timing rules)."""
+
+    query_id: str
+    stage_id: str
+    task_count: int
+    wall_ms: int
+    retries: int
+    spooled_pages: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskCompletedEvent:
+    """One logical task of a stage completed on its final placement.
+    queue/run walls come from the worker's shipped spans (zero when
+    the worker did not trace)."""
+
+    query_id: str
+    task_id: str
+    stage_id: str
+    uri: str
+    state: str  # FINISHED | FAILED
+    wall_ms: int
+    queue_ms: int
+    run_ms: int
+    pages: int
+    retries: int
+    speculative: bool
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,12 +105,25 @@ class EventListener:
     def task_retried(self, event: TaskRetryEvent) -> None:
         pass
 
+    def stage_completed(self, event: StageCompletedEvent) -> None:
+        pass
 
-def dispatch(listeners, method: str, event) -> None:
+    def task_completed(self, event: TaskCompletedEvent) -> None:
+        pass
+
+
+def dispatch(listeners, method: str, event, on_error=None) -> None:
     """Deliver an event to every listener, swallowing listener errors
-    (a misbehaving listener must never fail the query)."""
+    (a misbehaving listener must never fail the query) — but COUNTING
+    them: callers pass the owning executor's count_listener_error so
+    every swallowed exception lands on the `listener_errors` registry
+    counter (exec/counters.py) instead of vanishing."""
     for lst in listeners:
         try:
             getattr(lst, method)(event)
-        except Exception:  # noqa: BLE001 - reference behavior
-            pass
+        except Exception:  # noqa: BLE001 - reference behavior, counted
+            if on_error is not None:
+                try:
+                    on_error()
+                except Exception:  # noqa: BLE001 - the counter sink
+                    pass           # must never fail the query either

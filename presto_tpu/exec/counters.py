@@ -110,6 +110,21 @@ QUERY_COUNTERS: Dict[str, tuple] = {
     "speculative_tasks_lost": (
         "counter", "straggler speculation races the original "
         "placement won (the speculated copy was cancelled)"),
+    "capacity_boost_retries": (
+        "gauge", "overflow-ladder boosted re-entries this query "
+        "(0 on a profile-seeded repeat run — the observed-stats "
+        "profile contract, obs/profile.py)"),
+    "profile_store_hits": (
+        "gauge", "runs whose starting capacity bucket was seeded "
+        "from a persisted observed-stats profile (obs/profile.py; "
+        "per query)"),
+    "trace_spans": (
+        "gauge", "spans recorded into this query's lifecycle trace "
+        "(obs/trace.py; pinned 0 when tracing is off)"),
+    "listener_errors": (
+        "counter", "EventListener exceptions swallowed by the "
+        "events.dispatch choke point — counted here instead of lost "
+        "silently (executor lifetime)"),
 }
 
 # stats-dict entries that are COMPUTED in execute_with_stats rather

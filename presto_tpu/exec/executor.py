@@ -466,8 +466,99 @@ class Executor:
         # "auto" = on under pytest and bench --prewarm (the build/test
         # surface), off on the hot serving path; True/False force.
         self.plan_check = "auto"
+        # ---- query-lifecycle tracing (ISSUE 9, presto_tpu/obs/).
+        # trace: the active obs.QueryTrace, attached per query by the
+        # driver (LocalRunner / DcnRunner / worker task runtime) via
+        # obs.attach; None = tracing off, and every recording site
+        # below guards on that one check — spans record at page/
+        # attempt boundaries ONLY, never inside traced code, so jit
+        # keys and compiled programs carry no trace state.
+        self.trace = None
+        # trace_spans: spans this executor recorded into the active
+        # trace (per query; the tracing-off test pins it at 0, and
+        # obs.finalize settles it to the trace's full span count)
+        self.trace_spans = 0
+        # listener_errors: EventListener exceptions swallowed by
+        # events.dispatch — counted through count_listener_error so a
+        # misbehaving listener is visible on every counter surface
+        # instead of vanishing (executor lifetime)
+        self.listener_errors = 0
+        # ---- observed-stats profiles (obs/profile.py): when a
+        # ProfileStore is wired (stats_profile_dir session property),
+        # execute()/stream_fragment() seed their starting
+        # _capacity_boost from the persisted settled bucket of the
+        # same (plan fingerprint, connector snapshot) and record the
+        # settled bucket + observed cardinalities on success.
+        # capacity_boost_retries counts boosted re-entries this query
+        # (the number ROADMAP item 4 drives to zero on repeats);
+        # profile_store_hits counts seeded starts.
+        self.profile_store = None
+        self.capacity_boost_retries = 0
+        self.profile_store_hits = 0
 
     # ------------------------------------------------------------ plumbing
+    def count_listener_error(self) -> None:
+        """THE sink events.dispatch reports swallowed listener
+        exceptions to — a registry counter (exec/counters.py), so a
+        misbehaving EventListener shows on /metrics, system.metrics,
+        and EXPLAIN ANALYZE instead of disappearing."""
+        self.listener_errors += 1
+
+    def _trace_operators(self, tr, att_span) -> None:
+        """Emit per-plan-node operator spans from the successful
+        attempt's EXPLAIN ANALYZE accounting (pages() wall/rows/pages),
+        anchored at the attempt start — operator walls are per-node
+        totals, so the spans overlap rather than partition the attempt.
+        Called once per successful attempt, AFTER the run (the row-
+        count sync here is the same one-sync-at-the-end discipline
+        execute_with_stats uses)."""
+        stats = self._collect_stats
+        if not stats:
+            return
+        for st in stats.values():
+            if not isinstance(st, NodeStats):
+                continue
+            tr.complete("operator", st.label, att_span.t0,
+                        att_span.t0 + st.wall_s, parent=att_span,
+                        rows=st.rows, pages=st.pages)
+            self.trace_spans += 1
+
+    def _seed_profile(self, node) -> Optional[str]:
+        """Observed-stats profile seeding (obs/profile.py): start the
+        overflow ladder at the SETTLED capacity bucket a previous run
+        of this (plan fingerprint, connector snapshot) recorded — the
+        repeated query skips the boost climb (`capacity_boost_retries`
+        stays 0). Returns the profile key for recording, or None when
+        no store is wired."""
+        if self.profile_store is None:
+            return None
+        key = self.profile_store.key(node, self.catalogs)
+        prof = self.profile_store.lookup(key)
+        if prof and int(prof.get("capacity_boost", 1)) > 1:
+            self._capacity_boost = int(prof["capacity_boost"])
+            self.profile_store_hits += 1
+        return key
+
+    def _record_profile(self, key: str, rows_out: Optional[int],
+                        pages_out: Optional[int] = None) -> None:
+        """Persist this run's observed stats: the settled capacity
+        bucket plus per-operator output cardinalities when the stats
+        accounting ran (tracing or EXPLAIN ANALYZE) — ROADMAP item 4's
+        replanning input."""
+        prof: Dict = {"capacity_boost": self._capacity_boost}
+        if rows_out is not None:
+            prof["rows_out"] = int(rows_out)
+        if pages_out is not None:
+            prof["pages_out"] = int(pages_out)
+        stats = self._collect_stats
+        if stats:
+            ops: Dict[str, int] = {}
+            for st in stats.values():
+                if isinstance(st, NodeStats):
+                    ops[st.label] = ops.get(st.label, 0) + st.rows
+            prof["operator_rows"] = ops
+        self.profile_store.record(key, prof)
+
     def _plan_check_on(self) -> bool:
         pc = self.plan_check
         if pc in (True, "true", "on"):
@@ -1429,6 +1520,14 @@ class Executor:
             list(node.names) if isinstance(node, P.Output) else None
         )
         self._capacity_boost = 1  # per-query; grows only across retries
+        self.capacity_boost_retries = 0
+        self.profile_store_hits = 0
+        if self.trace is None:
+            # untraced queries pin the span counter at 0; traced ones
+            # reset at obs.attach (the DCN coordinator's stage spans
+            # precede this root-fragment execute and must survive it)
+            self.trace_spans = 0
+        prof_key = self._seed_profile(node)
         self.peak_memory_bytes = 0
         self.spill_partitions_used = 0
         self.host_spill_pages = 0
@@ -1450,6 +1549,25 @@ class Executor:
         # jit-key material — auto-on under pytest and bench --prewarm,
         # off on the hot serving path (plan_check session property)
         self._verify_plan(node)
+        # lifecycle tracing (obs/trace.py): spans record at attempt/
+        # page boundaries on the driver thread only — one `is None`
+        # check is the entire cost with tracing off. Tracing borrows
+        # the EXPLAIN ANALYZE per-node accounting for operator spans;
+        # per-page cost is two perf_counter calls plus retaining one
+        # deferred row-count scalar per (node, page) — no device sync
+        # until after the run (the reference always collects
+        # OperatorStats; execute() retains every output page anyway,
+        # so the handles are marginal). query_trace_enabled=false
+        # drops all of it for latency-critical serving.
+        tr = self.trace
+        own_stats = False
+        if tr is not None and self._collect_stats is None:
+            self._collect_stats = {}
+            own_stats = True
+        exec_span = None
+        if tr is not None:
+            exec_span = tr.begin("execute", type(node).__name__)
+            self.trace_spans += 1
         try:
             attempts = 0
             while attempts < 6:
@@ -1457,6 +1575,12 @@ class Executor:
                 if self._collect_stats is not None:
                     # drop failed-attempt stats
                     self._collect_stats.clear()
+                att_span = None
+                if tr is not None:
+                    att_span = tr.begin(
+                        "attempt", f"a{attempts}", parent=exec_span,
+                        boost=self._capacity_boost)
+                    self.trace_spans += 1
                 try:
                     self._maybe_inject_oom()
                     out_pages = []
@@ -1469,6 +1593,8 @@ class Executor:
                         for page in out_pages:
                             rows.extend(_decode_result_page(page))
                 except QueryDeadlineExceeded:
+                    if tr is not None:
+                        tr.end(att_span, outcome="deadline")
                     raise
                 except Exception as e:  # noqa: BLE001 - ladder gate
                     # device-OOM degradation: a RESOURCE_EXHAUSTED /
@@ -1476,6 +1602,8 @@ class Executor:
                     # — an HBM-model miss becomes a slow correct query
                     # instead of a crashed one. Anything else (and an
                     # exhausted OOM budget) raises through.
+                    if tr is not None:
+                        tr.end(att_span, outcome="device-fault")
                     oom_left = self._absorb_device_fault(e, oom_left)
                     continue
                 if overflow:
@@ -1483,10 +1611,18 @@ class Executor:
                     # (shapes.py): boosted sizes coincide with a larger
                     # query's first-attempt shapes, so the retry reuses
                     # cached programs instead of minting fresh ones
+                    if tr is not None:
+                        tr.end(att_span, outcome="overflow")
                     self._capacity_boost = SH.next_boost(
                         self._capacity_boost)
+                    self.capacity_boost_retries += 1
                     attempts += 1
                     continue
+                if tr is not None:
+                    self._trace_operators(tr, att_span)
+                    tr.end(att_span, outcome="ok", rows=len(rows))
+                if prof_key is not None:
+                    self._record_profile(prof_key, len(rows))
                 return names, rows
             raise RuntimeError(
                 "capacity overflow persisted after 6 boosted retries"
@@ -1496,6 +1632,10 @@ class Executor:
             # moment the query is done
             self._release_stream_cache()
             self._snap_compile_counters(cc_base)
+            if tr is not None:
+                tr.end(exec_span, boost=self._capacity_boost)
+            if own_stats:
+                self._collect_stats = None
 
     def _begin_attempt(self) -> None:
         """Per-attempt reset shared by every overflow-ladder driver
@@ -1539,6 +1679,13 @@ class Executor:
         it there so a boosted retry never double-publishes. Raises
         after 6 boosted retries."""
         self._capacity_boost = 1
+        self.capacity_boost_retries = 0
+        self.profile_store_hits = 0
+        if self.trace is None:
+            self.trace_spans = 0
+        # profile seeding mirrors execute(): a repeated fragment shape
+        # starts at its settled capacity bucket on the worker too
+        prof_key = self._seed_profile(node)
         self.device_oom_retries = 0
         self._oom_divisor = 1
         cc_base = CC.snapshot()
@@ -1546,21 +1693,31 @@ class Executor:
         # same pre-compile verification as execute(): a shipped
         # fragment is a plan tree too (worker-side task runtime)
         self._verify_plan(node)
+        tr = self.trace
         try:
             attempts = 0
             while attempts < 6:
                 self._begin_attempt()
                 if on_attempt is not None:
                     on_attempt()
+                att_span = None
+                if tr is not None:
+                    att_span = tr.begin("attempt", f"a{attempts}",
+                                        boost=self._capacity_boost)
+                    self.trace_spans += 1
                 try:
                     self._maybe_inject_oom()
                     out: List = []
                     for page in self.pages(node):
                         if cancelled():
+                            if tr is not None:
+                                tr.end(att_span, outcome="cancelled")
                             return out
                         self._check_deadline()
                         out.append(emit(page))
                 except QueryDeadlineExceeded:
+                    if tr is not None:
+                        tr.end(att_span, outcome="deadline")
                     raise
                 except Exception as e:  # noqa: BLE001 - ladder gate
                     # same device-OOM degradation as execute(): retry
@@ -1568,13 +1725,23 @@ class Executor:
                     # degrades to chunked execution instead of failing
                     # the task (the coordinator's long-poll tolerates
                     # the delay)
+                    if tr is not None:
+                        tr.end(att_span, outcome="device-fault")
                     oom_left = self._absorb_device_fault(e, oom_left)
                     continue
                 if not self._overflow_flagged():
+                    if tr is not None:
+                        tr.end(att_span, outcome="ok", pages=len(out))
+                    if prof_key is not None:
+                        self._record_profile(prof_key, None,
+                                             pages_out=len(out))
                     return out
                 # same shared-ladder re-entry as execute(): fragment
                 # retries land on rungs the cache already paid for
+                if tr is not None:
+                    tr.end(att_span, outcome="overflow")
                 self._capacity_boost = SH.next_boost(self._capacity_boost)
+                self.capacity_boost_retries += 1
                 attempts += 1
             raise RuntimeError(
                 "fragment capacity overflow persisted after 6 boosted "

@@ -1,0 +1,110 @@
+"""THE query-lifecycle observability package (ISSUE 9).
+
+Reference: presto-main's stats plane — the QueryInfo/StageInfo/TaskInfo
+trees served by /v1/query, OperatorStats feeding them, QueryMonitor
+building EventListener payloads, and the airlift TimeStat/Distribution
+histograms behind JMX. Ours is one package with three surfaces:
+
+  trace.py    the span recorder: query -> stage -> task -> attempt ->
+              operator spans on ONE monotonic clock with ONE wall
+              anchor per query, exported as a live QueryInfo tree
+              (/v1/query/{id}, system.runtime_tasks), a Chrome-trace
+              (Perfetto-loadable) JSON file, and a critical-path
+              summary (tools/analyze_rung.py).
+  histo.py    log-bucketed latency histograms with Prometheus
+              exposition — the p50/p95/p99 surface the concurrent-load
+              benchmark (ROADMAP item 1) reads from /metrics.
+  profile.py  the persisted observed-stats profile store keyed by
+              (canonical plan fingerprint, connector snapshot):
+              settled capacity bucket + observed cardinalities, the
+              input adaptive execution (ROADMAP item 4) replans from.
+
+SPAN_KINDS below is the span analog of exec/counters.QUERY_COUNTERS:
+every span kind emitted anywhere in the engine is declared here, and
+tools/lint's `spans` rule fails the build when an emission site uses
+an undeclared kind (or a declared kind has no emission site) — so the
+trace vocabulary cannot drift between the recorder, the QueryInfo
+tree, and the tools that read them.
+
+Tracing is strictly off the jit path: spans are recorded at page /
+attempt / stage boundaries by driver code only (never inside traced
+functions), canonical jit keys carry no trace state, and with tracing
+off the only cost is one `is None` check per driver loop
+(`trace_spans` counter pins that at zero).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from presto_tpu.obs.trace import QueryTrace, critical_path  # noqa: F401
+
+# span kind -> help text (rendered nowhere yet; the declaration is the
+# contract the lint enforces, exactly like QUERY_COUNTERS' help column)
+SPAN_KINDS: Dict[str, str] = {
+    "query": "the whole query: wall anchor + every child span",
+    "execute": "one local executor run of a plan (the overflow-ladder "
+               "driver; the coordinator's root fragment and every "
+               "LocalRunner query get one)",
+    "attempt": "one overflow-ladder attempt (attrs: capacity boost; "
+               "a query with N-1 boosted retries has N of these)",
+    "operator": "per-plan-node wall/rows/pages from the EXPLAIN "
+                "ANALYZE accounting, anchored at its attempt's start",
+    "stage": "one stage-DAG wave dispatched by dist/scheduler.py",
+    "task": "one logical task of a stage (coordinator view; attrs: "
+            "uri, retries, pages; worker-side spans nest inside)",
+    "dispatch": "one task-submit POST to a worker",
+    "queue": "worker-side: task created -> fragment execution started",
+    "run": "worker-side: fragment execution (attrs: pages, spooled)",
+    "fetch": "coordinator-side page drain of one task's results",
+    "retry": "one task re-dispatch (attrs: from/to uri, cause) — the "
+             "fault-tolerance paths' trace annotation",
+    "speculate": "one straggler-speculation copy dispatched (attrs: "
+                 "uri); win/loss lands on the task span",
+}
+
+
+def maybe_trace(session, query_id: Optional[str] = None,
+                sql: Optional[str] = None) -> Optional[QueryTrace]:
+    """A QueryTrace when the session enables tracing, else None (the
+    near-zero-cost off switch: every recording site guards on the
+    executor's `trace is None`)."""
+    if not (bool(session.get("query_trace_enabled"))
+            or session.get("query_trace_dir")):
+        return None
+    if query_id is None:
+        import uuid
+
+        query_id = f"q-{uuid.uuid4().hex[:12]}"
+    return QueryTrace(query_id, sql=sql)
+
+
+def attach(executor, trace: QueryTrace) -> None:
+    """Hand a trace to an executor for the next query; resets the
+    per-query `trace_spans` counter the tracing-off test pins."""
+    executor.trace = trace
+    executor.trace_spans = 0
+
+
+def finalize(executor, trace: QueryTrace,
+             trace_dir: Optional[str] = None) -> None:
+    """End the root span, write the Chrome-trace file when a directory
+    is configured (session prop `query_trace_dir` / etc key
+    `query-trace.dir`), detach, and settle the span-count counter.
+    The file write degrades gracefully (same discipline as
+    profile.ProfileStore.record): finalize runs inside callers'
+    finally blocks, so an unwritable trace dir must neither fail a
+    successful query nor mask an in-flight error."""
+    trace.finish()
+    executor.trace = None
+    executor.trace_spans = trace.span_count
+    if trace_dir:
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            trace.write_chrome(
+                os.path.join(trace_dir,
+                             f"{trace.query_id}.trace.json")
+            )
+        except OSError:
+            pass  # observability must never fail the query

@@ -1,0 +1,126 @@
+"""Persisted observed-stats profiles: what a query's execution actually
+measured, keyed so the NEXT run of the same shape can start there.
+
+Reference: the history-based optimization loop presto/Tardigrade sketch
+(and PAPER.md's adaptive-execution direction): per-(canonical plan,
+connector snapshot) records of observed cardinalities and the settled
+capacity bucket. ROADMAP item 4 replans from these; the first consumer
+(ISSUE 9) is capacity seeding — a repeated query starts at its settled
+`capacity_boost` instead of climbing the overflow-retry ladder again
+(`capacity_boost_retries` -> 0 on the second run, counter-pinned).
+
+Keying: a structural fingerprint of the physical plan (dataclass walk,
+no object identities — the same SQL over the same catalogs hashes
+identically across processes) combined with a connector-snapshot token
+(per-scanned-table row counts — a rewritten memory-connector table or
+a different scale factor changes the key, so stale profiles are never
+applied to different data). Stored as one small JSON file per key
+under the `stats_profile_dir` session property (etc key
+`stats-profile.dir`); writes are atomic (tmp + rename) so concurrent
+queries can share a directory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+
+def plan_fingerprint(plan, catalogs) -> str:
+    """Stable structural hash of a physical plan + the snapshot token
+    of every table it scans. Deliberately identity-free: dataclasses
+    encode as (classname, field values), scans append their current
+    row_count, anything exotic degrades to its type name."""
+    from presto_tpu.exec import plan as P
+
+    def enc(x):
+        if isinstance(x, P.TableScan):
+            try:
+                rc = catalogs[x.catalog].row_count(x.table)
+            except Exception:  # noqa: BLE001 - a connector without
+                rc = -1  # counts still fingerprints structurally
+            return ("TableScan", x.catalog, x.table,
+                    tuple(x.columns), rc,
+                    tuple(sorted((f.name, enc(getattr(x, f.name)))
+                                 for f in dataclasses.fields(x)
+                                 if f.name not in ("catalog", "table",
+                                                   "columns"))))
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            return (type(x).__name__,
+                    tuple((f.name, enc(getattr(x, f.name)))
+                          for f in dataclasses.fields(x)))
+        if isinstance(x, (tuple, list)):
+            return tuple(enc(v) for v in x)
+        if isinstance(x, dict):
+            return tuple(sorted((str(k), enc(v)) for k, v in x.items()))
+        if isinstance(x, (str, int, float, bool)) or x is None:
+            return x
+        return type(x).__name__  # callables/arrays: structure only
+    blob = repr(enc(plan)).encode()
+    return hashlib.sha256(blob).hexdigest()[:24]
+
+
+class ProfileStore:
+    """Directory-backed profile store with a small in-memory cache.
+    `ProfileStore.at(dir)` shares one instance per directory per
+    process so concurrent per-query runners reuse the cache."""
+
+    _instances: Dict[str, "ProfileStore"] = {}
+    _instances_lock = threading.Lock()
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._cache: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def at(cls, directory: str) -> "ProfileStore":
+        directory = os.path.abspath(directory)
+        with cls._instances_lock:
+            store = cls._instances.get(directory)
+            if store is None:
+                store = cls(directory)
+                cls._instances[directory] = store
+            return store
+
+    def key(self, plan, catalogs) -> str:
+        return plan_fingerprint(plan, catalogs)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"profile_{key}.json")
+
+    def lookup(self, key: str) -> Optional[dict]:
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        try:
+            with open(self._path(key)) as f:
+                prof = json.load(f)
+        except (OSError, ValueError):
+            return None
+        with self._lock:
+            self._cache[key] = prof
+        return prof
+
+    def record(self, key: str, profile: dict) -> None:
+        """Atomic write (tmp + rename): concurrent recorders of the
+        same key race benignly — last writer wins with a complete
+        file, never a torn one."""
+        with self._lock:
+            self._cache[key] = dict(profile)
+        tmp = self._path(key) + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(profile, f, sort_keys=True)
+            os.replace(tmp, self._path(key))
+        except OSError:
+            # a read-only/absent dir degrades to in-memory profiles
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

@@ -85,6 +85,9 @@ class LocalRunner:
         # Session; user is the stable key a stateless HTTP session
         # carries across requests)
         self.prepared: Dict[str, Dict[str, str]] = {}
+        # the last query's lifecycle trace (obs.QueryTrace), None when
+        # tracing was off — tools and the HTTP server read it here
+        self.last_trace = None
         self._ctor_page_rows = page_rows
         if mesh is None:
             self.executor = Executor(catalogs, page_rows=page_rows)
@@ -275,6 +278,16 @@ class LocalRunner:
             from presto_tpu import compilecache
 
             compilecache.enable_persistent_cache(cache_dir)
+        # observed-stats profile store (obs/profile.py): repeated
+        # queries seed their starting capacity bucket from persisted
+        # profiles instead of climbing the overflow-retry ladder
+        profile_dir = self.session.get("stats_profile_dir")
+        if profile_dir:
+            from presto_tpu.obs.profile import ProfileStore
+
+            ex.profile_store = ProfileStore.at(profile_dir)
+        else:
+            ex.profile_store = None
 
     def prewarm(self, sql: str) -> Dict:
         """Compile a query's program set ahead of timing: plan + execute
@@ -345,11 +358,35 @@ class LocalRunner:
         self.access_control.check_can_execute_query(
             self.session.user, sql
         )
+        # query-lifecycle tracing (ISSUE 9, presto_tpu/obs/): one
+        # trace per query when enabled — the executor records attempt/
+        # operator spans into it, /v1/query serves it live, and
+        # query_trace_dir exports a Chrome-trace file at the end.
+        # last_trace keeps the finished trace reachable for tools and
+        # the HTTP server's QueryInfo snapshot.
+        from presto_tpu import obs as OBS
+
+        trace = OBS.maybe_trace(self.session, sql=sql)
+        if trace is not None:
+            OBS.attach(self.executor, trace)
         token = _ACTIVE_SESSION.set(self.session)
         try:
             return self._execute_stmt(stmt)
         finally:
             _ACTIVE_SESSION.reset(token)
+            if trace is not None:
+                if trace.span_count > 1:
+                    OBS.finalize(self.executor, trace,
+                                 self.session.get("query_trace_dir"))
+                    self.last_trace = trace
+                else:
+                    # control statements (SET SESSION, PREPARE, ...)
+                    # never reached the executor: discard the empty
+                    # trace — no junk file, and last_trace keeps the
+                    # previous REAL query's timeline
+                    self.executor.trace = None
+            else:
+                self.last_trace = None  # this query was not traced
 
     def _execute_stmt(self, stmt: N.Node) -> QueryResult:
         if isinstance(stmt, N.CreateView):

@@ -43,18 +43,36 @@ class _Query:
         self.error: Optional[Dict] = None
         self.update_type: Optional[str] = None
         self.set_session: Dict[str, str] = {}
+        # ONE wall anchor (display/correlation); every elapsed-time
+        # computation runs on monotonic so an NTP step mid-query can
+        # neither stretch nor collapse it (ISSUE 9 timing-source rule)
         self.created = time.time()
+        self.created_mono = time.monotonic()
         self.finished_at: Optional[float] = None
+        self.finished_mono: Optional[float] = None
         self.cancelled = False
         self.done = threading.Event()
+        # lifecycle trace (obs.QueryTrace), captured from the runner
+        # when the query completes; while RUNNING the live trace is
+        # read off the runner's executor (see QueryManager.query_info)
+        self.trace = None
+        self.runner = None
+
+    def _finish_clock(self) -> None:
+        if self.finished_at is None:
+            self.finished_at = time.time()
+            self.finished_mono = time.monotonic()
 
     def info(self) -> Dict:
+        end_mono = (self.finished_mono if self.finished_mono
+                    is not None else time.monotonic())
         return {
             "queryId": self.id,
             "state": self.state,
             "query": self.sql,
+            "createTime": self.created,
             "elapsedTimeMillis": int(
-                ((self.finished_at or time.time()) - self.created) * 1000
+                (end_mono - self.created_mono) * 1000
             ),
             "error": self.error,
             "rowCount": len(self.rows),
@@ -104,7 +122,10 @@ class QueryManager:
                      "rows_returned_total", "query_wall_ms_total")
 
     def __init__(self, runner_factory, listeners=(),
-                 resource_groups=None, memory_arbiter=None):
+                 resource_groups=None, memory_arbiter=None,
+                 listener_error_counter=None):
+        from presto_tpu.obs.histo import Histogram
+
         self._runner_factory = runner_factory
         self._queries: Dict[str, _Query] = {}
         self._seq = 0
@@ -113,6 +134,9 @@ class QueryManager:
         self._exec_lock = threading.Lock()
         self.memory = memory_arbiter
         self.listeners = list(listeners)
+        # swallowed-listener-exception sink (events.dispatch on_error
+        # -> the executor's listener_errors registry counter)
+        self._listener_error = listener_error_counter
         # admission control (reference: resourceGroups/*; None = admit
         # everything, the pre-RG behavior)
         self.resource_groups = resource_groups
@@ -121,6 +145,12 @@ class QueryManager:
         self.completed_by_state: Dict[str, int] = {}
         self.rows_returned_total = 0
         self.query_wall_ms_total = 0
+        # latency histograms (obs/histo.py): bucketed query wall and
+        # per-stage wall for p50/p95/p99 — internally locked, written
+        # via observe() from completion paths, scraped by /metrics
+        # (the surface ROADMAP item 1's load benchmark reads)
+        self.latency_histo = Histogram()
+        self.stage_histo = Histogram()
 
     def submit(self, sql: str, session: Session) -> _Query:
         from presto_tpu import events as E
@@ -140,7 +170,7 @@ class QueryManager:
         E.dispatch(self.listeners, "query_created", E.QueryCreatedEvent(
             query_id=q.id, sql=sql, user=session.user,
             create_time=q.created,
-        ))
+        ), on_error=self._listener_error)
         threading.Thread(
             target=self._run, args=(q,), daemon=True
         ).start()
@@ -156,9 +186,32 @@ class QueryManager:
         q.cancelled = True
         if not q.done.is_set():
             q.state = "CANCELED"
-            q.finished_at = time.time()
+            q._finish_clock()
             q.done.set()
         return True
+
+    def query_info(self, qid: str) -> Optional[Dict]:
+        """The QueryInfo/StageInfo/TaskInfo tree for one query
+        (reference: /v1/query/{id}). Served LIVE: a RUNNING query's
+        tree comes straight off its runner's active trace, so a
+        mid-query poll sees the stages/tasks recorded so far."""
+        q = self._queries.get(qid)
+        if q is None:
+            return None
+        info = q.info()
+        tr = q.trace
+        if tr is None and not q.done.is_set():
+            r = q.runner
+            tr = getattr(r.executor, "trace", None) if r is not None \
+                else None
+        if tr is not None:
+            tree = tr.to_info()
+            info["stages"] = tree["stages"]
+            info["spanCount"] = tree["spanCount"]
+        else:
+            info["stages"] = []
+            info["spanCount"] = 0
+        return info
 
     def _run(self, q: _Query) -> None:
         group = getattr(q, "resource_group", None)
@@ -220,9 +273,12 @@ class QueryManager:
                 self._record_completion(q)
                 return
             q.state = "RUNNING"
+            prev_trace = None
             try:
                 if runner is None:
                     runner = self._runner_factory(q.session)
+                q.runner = runner  # live-trace handle for query_info
+                prev_trace = getattr(runner, "last_trace", None)
                 result = runner.execute(q.sql)
                 types = result.column_types or [
                     "unknown" for _ in result.column_names
@@ -255,30 +311,47 @@ class QueryManager:
                     }
                     q.state = "FAILED"
             finally:
-                if q.finished_at is None:
-                    q.finished_at = time.time()
+                q._finish_clock()
+                if runner is not None:
+                    # snapshot the finished trace before the serial
+                    # runner moves on to its next query; a control
+                    # statement keeps the runner's previous trace —
+                    # only a NEW trace belongs to this query
+                    lt = getattr(runner, "last_trace", None)
+                    q.trace = lt if lt is not prev_trace else None
                 q.done.set()
                 self._record_completion(q)
 
     def _record_completion(self, q: _Query) -> None:
         from presto_tpu import events as E
 
+        wall_ms = q.info()["elapsedTimeMillis"]
         with self._lock:
             self.completed_by_state[q.state] = (
                 self.completed_by_state.get(q.state, 0) + 1
             )
             self.rows_returned_total += len(q.rows)
-            self.query_wall_ms_total += q.info()["elapsedTimeMillis"]
+            self.query_wall_ms_total += wall_ms
+        # histogram observations (internally locked): query latency
+        # always; per-stage wall when the query was traced
+        self.latency_histo.observe(wall_ms / 1000.0)
+        query_info = None
+        if q.trace is not None:
+            query_info = q.trace.to_info()
+            for stage in query_info["stages"]:
+                self.stage_histo.observe(stage["wallMs"] / 1000.0)
         E.dispatch(
             self.listeners, "query_completed", E.QueryCompletedEvent(
                 query_id=q.id, sql=q.sql, user=q.session.user,
                 state=q.state, create_time=q.created,
                 end_time=q.finished_at or time.time(),
-                wall_ms=q.info()["elapsedTimeMillis"],
+                wall_ms=wall_ms,
                 row_count=len(q.rows),
                 error_name=(q.error or {}).get("errorName"),
                 error_message=(q.error or {}).get("message"),
-            )
+                query_info=query_info,
+            ),
+            on_error=self._listener_error,
         )
 
     def metrics_text(self, uptime: float, executor=None) -> str:
@@ -307,6 +380,13 @@ class QueryManager:
                 f"presto_tpu_query_wall_ms_total "
                 f"{self.query_wall_ms_total}",
             ]
+        # latency histograms (obs/histo.py): bucketed for p50/p95/p99
+        # — Prometheus-native histogram exposition, the surface the
+        # concurrent-load benchmark (ROADMAP item 1) scrapes
+        lines += self.latency_histo.prom_lines(
+            "presto_tpu_query_latency_seconds")
+        lines += self.stage_histo.prom_lines(
+            "presto_tpu_stage_wall_seconds")
         if executor is not None:
             # device-memory governor (exec/membudget.py): resolved
             # budget plus the last attempt's peak
@@ -528,12 +608,24 @@ class _Handler(BaseHTTPRequestHandler):
                 headers["X-Presto-Set-Session"] = f"{k}={v}"
             self._send_json(self._results(q, token), headers=headers)
             return
+        if parts == ["v1", "query"]:
+            # reference: /v1/query lists every tracked query's
+            # BasicQueryInfo (live + finished)
+            mgr = self.app.manager
+            with mgr._lock:
+                qs = list(mgr._queries.values())
+            self._send_json([
+                q.info() for q in sorted(qs, key=lambda x: x.id)
+            ])
+            return
         if parts[:2] == ["v1", "query"] and len(parts) == 3:
-            q = self.app.manager.get(parts[2])
-            if q is None:
+            # the full QueryInfo/StageInfo/TaskInfo tree, served LIVE
+            # mid-query from the active trace (obs/trace.to_info)
+            info = self.app.manager.query_info(parts[2])
+            if info is None:
                 self._send_json({"error": "no such query"}, 404)
                 return
-            self._send_json(q.info())
+            self._send_json(info)
             return
         if parts == ["v1", "info"] or parts == ["v1", "status"]:
             self._send_json({
@@ -692,6 +784,12 @@ class PrestoTpuServer:
             for k, v in (session_defaults or {}).items():
                 if not session.is_set(k):
                     session.set(k, v)
+            # the server traces queries by default (ISSUE 9): the
+            # /v1/query/{id} tree, system.runtime_tasks, and the
+            # stage-wall histogram all read the lifecycle trace. An
+            # explicit client/deployment off always wins.
+            if not session.is_set("query_trace_enabled"):
+                session.set("query_trace_enabled", True)
             if memory_arbiter is None:
                 # serial path: one engine, re-sessioned per query
                 self._runner.session = session
@@ -713,10 +811,16 @@ class PrestoTpuServer:
             r.access_control = self._runner.access_control
             return r
 
-        self.manager = QueryManager(runner_factory,
-                                    listeners=event_listeners,
-                                    resource_groups=resource_groups,
-                                    memory_arbiter=memory_arbiter)
+        self.manager = QueryManager(
+            runner_factory,
+            listeners=event_listeners,
+            resource_groups=resource_groups,
+            memory_arbiter=memory_arbiter,
+            # swallowed listener exceptions land on the bootstrap
+            # executor's listener_errors registry counter
+            listener_error_counter=(
+                self._runner.executor.count_listener_error),
+        )
         # coordinator+worker single process (reference: a node that is
         # both coordinator and worker): an embedded task runtime makes
         # this server a full DCN peer — it serves the /v1/task control
@@ -797,11 +901,41 @@ class PrestoTpuServer:
             out.extend(sorted(CTRS.snapshot(ex).items()))
             return out
 
+        def runtime_tasks():
+            # the task-level runtime table (reference:
+            # system.runtime.tasks): one row per stage task from the
+            # SAME QueryInfo tree /v1/query/{id} serves, so the two
+            # surfaces cannot disagree
+            with mgr._lock:
+                qids = list(mgr._queries)
+            out = []
+            for qid in qids:
+                info = mgr.query_info(qid)
+                if not info:
+                    continue
+                for stage in info.get("stages", ()):
+                    for t in stage["tasks"]:
+                        out.append((
+                            qid, str(stage["stageId"]), t["taskId"],
+                            t["state"], t.get("uri") or "",
+                            int(t["wallMs"]),
+                            int(t.get("rows") or 0),
+                            int(t.get("retries") or 0),
+                        ))
+            return sorted(out)
+
         sys_conn.register(
             "runtime_queries",
             [("query_id", V), ("state", V), ("user", V), ("query", V),
              ("elapsed_ms", B), ("result_rows", B)],
             runtime_queries,
+        )
+        sys_conn.register(
+            "runtime_tasks",
+            [("query_id", V), ("stage_id", V), ("task_id", V),
+             ("state", V), ("uri", V), ("wall_ms", B), ("rows", B),
+             ("retries", B)],
+            runtime_tasks,
         )
         sys_conn.register(
             "nodes",

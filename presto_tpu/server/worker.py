@@ -167,6 +167,15 @@ class _Task:
         self.error: Optional[str] = None
         self.cancelled = False
         self.lock = threading.Lock()
+        # lifecycle tracing (ISSUE 9): interval math on monotonic,
+        # ONE wall anchor for cross-node correlation — the span
+        # timing-source rule (obs/trace.py docstring)
+        self.created_mono = time.monotonic()
+        self.created_wall = time.time()
+        # worker-side spans (queue/run/attempt), exported as offsets
+        # from created_mono and shipped to the coordinator on the
+        # status plane so it can assemble one cross-node timeline
+        self.spans: Optional[List[Dict]] = None
 
     # --------- unified read surface (legacy byte list OR spool tiers)
     def part_count(self, part: int) -> int:
@@ -466,9 +475,11 @@ def route_task_get(app, path: str, query: str):
         if app.maybe_inject_fault():
             return _jresp({"error": "injected fault"}, 500)
         # bounded long-poll until the page at `token` exists or the
-        # task finishes (reference: HttpPageBufferClient long-poll)
-        deadline = time.time() + 10.0
-        while time.time() < deadline:
+        # task finishes (reference: HttpPageBufferClient long-poll).
+        # Monotonic, not wall: an NTP step mid-poll must not stretch
+        # or collapse the window (ISSUE 9 timing-source audit)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
             entry = blob = None
             with task.lock:
                 if task.error:
@@ -514,7 +525,7 @@ def route_task_get(app, path: str, query: str):
             return _jresp({"error": "no such task"}, 404)
         with task.lock:
             spool = task.spool
-            return _jresp({
+            body = {
                 "taskId": task.task_id,
                 "state": ("FAILED" if task.error else
                           "FINISHED" if task.done else "RUNNING"),
@@ -523,7 +534,14 @@ def route_task_get(app, path: str, query: str):
                 "spooledBytes": spool.byte_count if spool else 0,
                 "partitions": len(spool.parts) if spool else 1,
                 "error": task.error,
-            })
+            }
+            if task.spans is not None:
+                # worker-side spans for the coordinator's cross-node
+                # timeline: offsets from this task's creation, plus
+                # the worker's wall anchor for correlation only
+                body["spans"] = task.spans
+                body["wallAnchor"] = task.created_wall
+            return _jresp(body)
     return None
 
 
@@ -584,7 +602,8 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             self._write(_jresp({
                 "nodeId": self.app.node_id,
                 "state": "ACTIVE",
-                "uptime_s": round(time.time() - self.app.started, 1),
+                "uptime_s": round(
+                    time.monotonic() - self.app.started_mono, 1),
                 "tasks": len(self.app.tasks),
             }))
             return
@@ -614,6 +633,9 @@ class TaskRuntime:
         self.page_rows = page_rows
         self.tasks: Dict[str, _Task] = {}
         self.started = time.time()
+        # uptime arithmetic runs on monotonic (the wall `started` is
+        # display/correlation only — timing-source audit, ISSUE 9)
+        self.started_mono = time.monotonic()
         self._fault_lock = threading.Lock()
         self._results_calls = 0
         self._submit_calls = 0
@@ -704,6 +726,19 @@ class TaskRuntime:
         return task
 
     def _run_task(self, task: _Task, req: Dict) -> None:
+        # worker-side lifecycle tracing (ISSUE 9): when the coordinator
+        # traces the query, the payload carries trace=true and this
+        # task records queue/run (+ the executor's attempt) spans,
+        # anchored at task creation, shipped back on the status plane
+        wtr = None
+        if req.get("trace"):
+            from presto_tpu import obs as OBS
+
+            wtr = OBS.QueryTrace(task.task_id,
+                                 anchor_mono=task.created_mono,
+                                 anchor_wall=task.created_wall)
+            wtr.complete("queue", task.task_id, 0.0, wtr.now())
+        run_t0 = wtr.now() if wtr is not None else 0.0
         try:
             # FAULT_TASK_EXEC_DELAY_MS: stall task EXECUTION (not the
             # fetch path) — makes this worker a deterministic
@@ -780,6 +815,13 @@ class TaskRuntime:
                 partial = dataclasses.replace(cut, step="partial")
             ex = runner.executor
             runner.apply_session()
+            if wtr is not None:
+                # the fragment executor records its attempt spans into
+                # the task trace too (overflow-ladder visibility ships
+                # to the coordinator with the queue/run phases)
+                from presto_tpu import obs as OBS
+
+                OBS.attach(ex, wtr)
             import jax
 
             sources = req.get("sources") or {}
@@ -854,7 +896,13 @@ class TaskRuntime:
                     partial, emit, cancelled=lambda: task.cancelled,
                     on_attempt=on_attempt,
                 )
+                if wtr is not None:
+                    wtr.complete("run", task.task_id, run_t0,
+                                 wtr.now(),
+                                 spooled=state["spool"].page_count)
                 with task.lock:
+                    if wtr is not None:
+                        task.spans = wtr.export()
                     task.spool = state["spool"]
                     task.done = True
             else:
@@ -864,14 +912,24 @@ class TaskRuntime:
                 blobs: List = ex.stream_fragment(
                     partial, emit, cancelled=lambda: task.cancelled
                 )
+                if wtr is not None:
+                    wtr.complete("run", task.task_id, run_t0,
+                                 wtr.now(), pages=len(blobs))
                 with task.lock:
+                    if wtr is not None:
+                        task.spans = wtr.export()
                     task.pages.extend(blobs)
                     task.done = True
         except Exception as e:  # noqa: BLE001 - task failures surface
             # to the coordinator via the X-Task-Error results header
             # (real error text, no fetch-retry spinning), never as a
             # hung task
+            if wtr is not None:
+                wtr.complete("run", task.task_id, run_t0, wtr.now(),
+                             error=repr(e)[:200])
             with task.lock:
+                if wtr is not None:
+                    task.spans = wtr.export()
                 task.error = repr(e)[:400]
                 task.done = True
 

@@ -328,6 +328,36 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1 << 30,
         ),
         PropertyMetadata(
+            "query_trace_enabled",
+            "record a query-lifecycle span trace (presto_tpu/obs/): "
+            "query -> stage -> task -> attempt -> operator spans on "
+            "one monotonic clock with one wall anchor, served live as "
+            "the /v1/query/{id} QueryInfo tree and "
+            "system.runtime_tasks. Off = zero recording cost "
+            "(trace_spans counter pins 0). The HTTP server enables "
+            "this by default for its queries",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "query_trace_dir",
+            "directory for per-query Chrome-trace (Perfetto-loadable) "
+            "JSON exports; setting it also enables tracing (empty = "
+            "no files). Each query writes "
+            "<query-id>.trace.json on completion",
+            str, "",
+        ),
+        PropertyMetadata(
+            "stats_profile_dir",
+            "directory for persisted observed-stats profiles "
+            "(presto_tpu/obs/profile.py), keyed by (canonical plan "
+            "fingerprint, connector snapshot): settled capacity "
+            "bucket + observed cardinalities. Repeated queries seed "
+            "their starting capacity from the profile and skip the "
+            "overflow-retry ladder (capacity_boost_retries -> 0); "
+            "empty = disabled",
+            str, "",
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

@@ -1,0 +1,575 @@
+"""ISSUE 9: query-lifecycle tracing (presto_tpu/obs/).
+
+Covers the subsystem surface by surface:
+  - span-tree shape for local and distributed (stage-DAG) execution
+    (>= 3 stages; coordinator and worker task spans nest consistently
+    on one clamped monotonic timeline);
+  - recovery annotations: retry spans under injected submit faults,
+    speculate spans under an injected straggler;
+  - Chrome-trace JSON validity (sorted ts, complete X events, dur>=0);
+  - /v1/query/{id} served LIVE mid-query and its agreement with
+    system.runtime_tasks (one tree, two surfaces);
+  - /metrics histogram exposition + bucket math;
+  - observed-stats profile store: round-trip, and the acceptance
+    contract — a repeated query skips the overflow-retry ladder
+    (capacity_boost_retries = 0 on the second run, counter-pinned);
+  - tracing-off overhead pinned at zero recorded spans;
+  - the lint `spans` registry rule (clean repo + seeded violation).
+"""
+
+import collections
+import json
+import textwrap
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu import obs as OBS
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.dist.dcn import DcnRunner
+from presto_tpu.runner import LocalRunner
+from presto_tpu.server.worker import WorkerServer
+
+SF = 0.01
+PAGE_ROWS = 1 << 13
+
+# the 3+-stage shape from test_stagedag (join -> agg -> join -> agg):
+# fragments into >= 3 stages with repartition/broadcast/gather edges
+DAG_QUERY = (
+    "select n_name, count(*), sum(top.c_count) from nation join ("
+    "  select c_nationkey nk, c_custkey ck, count(o_orderkey) c_count"
+    "  from customer left join orders on c_custkey = o_custkey"
+    "  group by c_nationkey, c_custkey) top on n_nationkey = top.nk "
+    "group by n_name order by n_name"
+)
+
+
+def rows_equal(a, b):
+    return collections.Counter(map(repr, a)) == collections.Counter(
+        map(repr, b))
+
+
+@pytest.fixture(scope="module")
+def single():
+    return LocalRunner({"tpch": TpchConnector(SF)},
+                       page_rows=PAGE_ROWS)
+
+
+@pytest.fixture(scope="module")
+def workers():
+    w1 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w1",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    w2 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w2",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    uris = [f"http://127.0.0.1:{w1.start()}",
+            f"http://127.0.0.1:{w2.start()}"]
+    yield uris
+    w1.stop()
+    w2.stop()
+
+
+def _make_coord(workers, listeners=(), **props):
+    defaults = {"retry_backoff_ms": 20, "agg_gather_capacity": 64,
+                "query_trace_enabled": "true"}
+    defaults.update(props)
+    return DcnRunner({"tpch": TpchConnector(SF)}, workers,
+                     default_catalog="tpch", page_rows=PAGE_ROWS,
+                     session_props=defaults, listeners=listeners)
+
+
+def _post_fault(uri, **cfg):
+    req = urllib.request.Request(
+        f"{uri}/v1/fault", data=json.dumps(cfg).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=5).close()
+
+
+def _assert_chrome_valid(trace):
+    ch = trace.to_chrome()
+    events = ch["traceEvents"]
+    assert events, "empty chrome trace"
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts), "chrome events not sorted by ts"
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["name"]
+    return ch
+
+
+# ------------------------------------------------------ local tracing
+def test_local_span_tree_shape(single):
+    single.session.set("query_trace_enabled", True)
+    try:
+        res = single.execute(
+            "select l_returnflag, count(*), sum(l_quantity) "
+            "from lineitem group by l_returnflag")
+    finally:
+        single.session.unset("query_trace_enabled")
+    assert len(res.rows) == 3
+    tr = single.last_trace
+    assert tr is not None
+    kinds = collections.Counter(s.kind for s in tr.spans())
+    assert kinds["query"] == 1
+    assert kinds["execute"] >= 1
+    assert kinds["attempt"] >= 1
+    assert kinds["operator"] >= 3  # scan/agg/output at least
+    # operator spans carry the EXPLAIN ANALYZE rows accounting
+    ops = [s for s in tr.spans() if s.kind == "operator"]
+    assert any(s.attrs.get("rows", 0) > 0 for s in ops)
+    # the executor's registry counter saw the spans
+    assert single.executor.trace_spans == tr.span_count
+    # QueryInfo tree: one synthetic local stage, one task, its spans
+    info = tr.to_info()
+    assert [s["stageId"] for s in info["stages"]] == ["local"]
+    task = info["stages"][0]["tasks"][0]
+    assert task["state"] == "FINISHED"
+    assert {sp["kind"] for sp in task["spans"]} >= {
+        "attempt", "operator"}
+
+
+def test_tracing_off_records_no_spans(single):
+    # default: tracing off — the near-zero-cost contract is pinned by
+    # the registry counter (no spans recorded anywhere this query)
+    res = single.execute("select count(*) from nation")
+    assert res.rows == [(25,)]
+    assert single.last_trace is None
+    assert single.executor.trace is None
+    assert single.executor.trace_spans == 0
+    from presto_tpu.exec.counters import QUERY_COUNTERS, snapshot
+
+    assert "trace_spans" in QUERY_COUNTERS
+    assert snapshot(single.executor)["trace_spans"] == 0
+
+
+def test_chrome_trace_file_written_and_valid(single, tmp_path):
+    single.session.set("query_trace_dir", str(tmp_path))
+    try:
+        single.execute("select max(o_totalprice) from orders")
+    finally:
+        single.session.unset("query_trace_dir")
+    tr = single.last_trace
+    assert tr is not None
+    _assert_chrome_valid(tr)
+    path = tmp_path / f"{tr.query_id}.trace.json"
+    assert path.exists()
+    with open(path) as f:
+        data = json.load(f)
+    assert data["traceEvents"]
+    assert data["otherData"]["queryId"] == tr.query_id
+
+
+def test_control_statements_write_no_trace(single, tmp_path):
+    """SET SESSION / PREPARE never reach the executor: no junk trace
+    file, and last_trace keeps the previous REAL query's timeline."""
+    single.session.set("query_trace_dir", str(tmp_path))
+    try:
+        single.execute("select count(*) from region")
+        real = single.last_trace
+        assert real is not None
+        n_files = len(list(tmp_path.iterdir()))
+        single.execute("set session page_rows = 8192")
+        single.execute("prepare p1 from select 1")
+        assert single.last_trace is real, \
+            "control statement clobbered the real query's trace"
+        assert len(list(tmp_path.iterdir())) == n_files, \
+            "control statement wrote a junk trace file"
+    finally:
+        single.session.unset("query_trace_dir")
+        single.session.unset("page_rows")
+        single.execute("deallocate prepare p1")
+
+
+def test_unwritable_trace_dir_never_fails_query(single, tmp_path):
+    """finalize() runs in the query's finally: an unwritable trace
+    dir degrades to no file, never to a failed query."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a dir")
+    single.session.set("query_trace_dir", str(blocker / "sub"))
+    try:
+        res = single.execute("select count(*) from region")
+        assert res.rows == [(5,)]
+        assert single.last_trace is not None  # traced, just unwritten
+    finally:
+        single.session.unset("query_trace_dir")
+
+
+# ------------------------------------------------ distributed tracing
+def test_distributed_dag_trace_three_stages(single, workers):
+    """The acceptance shape: a distributed stage-DAG run records >= 3
+    stage spans whose coordinator task spans contain the worker-side
+    queue/run spans, nested consistently (clamped monotonic), and the
+    Chrome export validates."""
+    events = []
+    from presto_tpu.events import EventListener
+
+    class Rec(EventListener):
+        def stage_completed(self, e):
+            events.append(("stage", e))
+
+        def task_completed(self, e):
+            events.append(("task", e))
+
+    coord = _make_coord(workers, listeners=[Rec()])
+    try:
+        want = single.execute(DAG_QUERY).rows
+        got = coord.execute(DAG_QUERY)
+        assert coord.last_distribution == "stage-dag"
+        assert rows_equal(got, want)
+        tr = coord.runner.last_trace
+        assert tr is not None
+        info = tr.to_info()
+        stages = info["stages"]
+        assert len(stages) >= 3, [s["stageId"] for s in stages]
+        # every task span contains its worker-side spans (queue/run
+        # shipped on the status plane, clamped into the coordinator
+        # window — the cross-node nesting acceptance check)
+        child_kinds = set()
+        for st in stages:
+            assert st["state"] == "FINISHED"
+            for t in st["tasks"]:
+                for sp in t["spans"]:
+                    child_kinds.add(sp["kind"])
+                    assert sp["startMs"] >= t["startMs"] - 1, (sp, t)
+                    assert sp["endMs"] <= t["endMs"] + 1, (sp, t)
+        assert {"dispatch", "queue", "run"} <= child_kinds, child_kinds
+        # the coordinator's root-fragment drain + local execution spans
+        all_kinds = {s.kind for s in tr.spans()}
+        assert {"fetch", "execute", "attempt"} <= all_kinds
+        _assert_chrome_valid(tr)
+        # both workers appear in the timeline
+        uris = {t.get("uri") for st in stages for t in st["tasks"]}
+        assert set(workers) <= uris
+        # EventListener SPI: every stage and task completion fired,
+        # with worker-measured run walls on the task events
+        stage_events = [e for k, e in events if k == "stage"]
+        task_events = [e for k, e in events if k == "task"]
+        assert len(stage_events) >= 3
+        assert len(task_events) >= len(stage_events)
+        assert any(e.run_ms > 0 for e in task_events)
+        assert all(e.query_id == stage_events[0].query_id
+                   for e in stage_events)
+    finally:
+        coord.close()
+
+
+def test_legacy_cut_trace_ingests_worker_spans(single, workers):
+    """The legacy (non-DAG) distributed cuts assemble a cross-node
+    timeline too: dispatch/fetch on the coordinator plus the workers'
+    shipped queue/run spans (fetched by one status poll per task)."""
+    coord = _make_coord(workers, stage_scheduler="false")
+    try:
+        q = ("select l_returnflag, count(*), sum(l_quantity) "
+             "from lineitem group by l_returnflag")
+        got = coord.execute(q)
+        assert coord.last_distribution in ("hash", "roundrobin")
+        assert rows_equal(got, single.execute(q).rows)
+        tr = coord.runner.last_trace
+        kinds = collections.Counter(s.kind for s in tr.spans())
+        assert kinds["dispatch"] == 2 and kinds["fetch"] == 2
+        assert kinds["run"] >= 2, "worker spans not ingested"
+        _assert_chrome_valid(tr)
+    finally:
+        coord.close()
+
+
+def test_retry_span_under_submit_fault(single, workers):
+    """Every submit to w2 is dropped (injected): initial dispatch
+    recovers through _redispatch and the timeline carries the retry
+    annotation (replay=False — the task never ran)."""
+    coord = _make_coord(workers)
+    _post_fault(workers[1], FAULT_SUBMIT_DROP_EVERY=1)
+    try:
+        want = single.execute(DAG_QUERY).rows
+        got = coord.execute(DAG_QUERY)
+        assert rows_equal(got, want)
+        tr = coord.runner.last_trace
+        retries = [s for s in tr.spans() if s.kind == "retry"]
+        assert retries, "no retry span under injected submit fault"
+        assert any(s.attrs.get("replay") is False for s in retries)
+        assert all(s.attrs.get("cause") for s in retries)
+    finally:
+        _post_fault(workers[1])
+        coord.close()
+
+
+def test_speculate_span_under_straggler(single, workers):
+    """A deterministic straggler (injected exec delay on w2) triggers
+    speculation; the dispatched copy shows as a speculate span on the
+    straggling task."""
+    coord = _make_coord(workers, speculation_enabled=True)
+    _post_fault(workers[1], FAULT_TASK_EXEC_DELAY_MS=4000)
+    try:
+        want = single.execute(DAG_QUERY).rows
+        got = coord.execute(DAG_QUERY)
+        assert rows_equal(got, want), "speculation duplicated rows"
+        tr = coord.runner.last_trace
+        specs = [s for s in tr.spans() if s.kind == "speculate"]
+        assert specs, "no speculate span under injected straggler"
+        assert coord.runner.executor.speculative_tasks_won > 0
+    finally:
+        _post_fault(workers[1])
+        coord.close()
+
+
+def test_listener_errors_counted_not_lost(single, workers):
+    """A throwing listener never fails the query AND is no longer
+    silent: every swallowed exception lands on the listener_errors
+    registry counter."""
+    from presto_tpu.events import EventListener
+
+    class Bad(EventListener):
+        def stage_completed(self, e):
+            raise RuntimeError("boom")
+
+        def task_completed(self, e):
+            raise RuntimeError("boom")
+
+    coord = _make_coord(workers, listeners=[Bad()])
+    try:
+        got = coord.execute(DAG_QUERY)
+        assert rows_equal(got, single.execute(DAG_QUERY).rows)
+        ex = coord.runner.executor
+        assert ex.listener_errors > 0
+        from presto_tpu.exec.counters import QUERY_COUNTERS, snapshot
+
+        assert "listener_errors" in QUERY_COUNTERS
+        assert snapshot(ex)["listener_errors"] == ex.listener_errors
+    finally:
+        coord.close()
+
+
+# --------------------------------------------------- server surfaces
+class _SlowTpch(TpchConnector):
+    """Per-page sleep so a query is observably RUNNING while tests
+    poll the live QueryInfo surface."""
+
+    def page_for_split(self, split, columns=None):
+        time.sleep(0.2)
+        return super().page_for_split(split, columns)
+
+
+@pytest.fixture(scope="module")
+def server():
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    srv = PrestoTpuServer({"tpch": _SlowTpch(SF)}, port=0,
+                          default_catalog="tpch",
+                          page_rows=PAGE_ROWS)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_v1_query_live_then_final_and_runtime_tasks_agree(server):
+    from presto_tpu.client import StatementClient
+
+    base = f"http://127.0.0.1:{server.port}"
+    c = StatementClient(server=base)
+    # several slow pages -> seconds of RUNNING time to poll into
+    res_holder = {}
+    import threading
+
+    def run():
+        res_holder["res"] = c.execute(
+            "select l_returnflag, count(*) from lineitem "
+            "group by l_returnflag")
+
+    t = threading.Thread(target=run)
+    t.start()
+    # live mid-query: poll until the tree shows a RUNNING task with
+    # spans (the acceptance criterion: /v1/query/{id} serves the same
+    # tree live)
+    live = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        queries = _get_json(f"{base}/v1/query")
+        running = [q for q in queries if q["state"] == "RUNNING"]
+        if running:
+            qi = _get_json(f"{base}/v1/query/{running[0]['queryId']}")
+            if qi.get("stages") and qi["stages"][0]["tasks"]:
+                live = qi
+                break
+        time.sleep(0.05)
+    t.join(timeout=60)
+    assert "res" in res_holder and res_holder["res"].error is None
+    assert live is not None, "never observed a live QueryInfo tree"
+    assert live["state"] == "RUNNING"
+    assert live["stages"][0]["tasks"][0]["state"] == "RUNNING"
+    qid = live["queryId"]
+    # final tree: FINISHED with attempt/operator spans
+    final = _get_json(f"{base}/v1/query/{qid}")
+    assert final["state"] == "FINISHED"
+    task = final["stages"][0]["tasks"][0]
+    assert task["state"] == "FINISHED"
+    assert {sp["kind"] for sp in task["spans"]} >= {"attempt",
+                                                    "operator"}
+    # system.runtime_tasks serves the SAME tree (agreement check)
+    rows = c.execute(
+        "select query_id, stage_id, task_id, state, wall_ms "
+        "from system.runtime_tasks").rows
+    mine = [r for r in rows if r[0] == qid]
+    assert len(mine) == len(final["stages"][0]["tasks"])
+    assert mine[0][1] == final["stages"][0]["stageId"]
+    assert mine[0][2] == task["taskId"]
+    assert mine[0][3] == "FINISHED"
+    assert abs(int(mine[0][4]) - task["wallMs"]) < 5000
+
+
+def test_metrics_histogram_exposition(server):
+    from presto_tpu.client import StatementClient
+
+    base = f"http://127.0.0.1:{server.port}"
+    StatementClient(server=base).execute("select count(*) from nation")
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        body = r.read().decode()
+    for name in ("presto_tpu_query_latency_seconds",
+                 "presto_tpu_stage_wall_seconds"):
+        assert f"# TYPE {name} histogram" in body
+        assert f'{name}_bucket{{le="+Inf"}}' in body
+        assert f"{name}_sum" in body and f"{name}_count" in body
+    # at least one completed query observed
+    count_line = next(
+        ln for ln in body.splitlines()
+        if ln.startswith("presto_tpu_query_latency_seconds_count"))
+    assert int(count_line.split()[-1]) >= 1
+    # cumulative bucket monotonicity straight off the scrape
+    buckets = [
+        int(ln.split()[-1]) for ln in body.splitlines()
+        if ln.startswith("presto_tpu_query_latency_seconds_bucket")
+    ]
+    assert buckets == sorted(buckets)
+
+
+# ------------------------------------------------------ histogram math
+def test_histogram_bucket_math():
+    from presto_tpu.obs.histo import Histogram
+
+    h = Histogram(bounds=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.total == 5
+    assert h.counts == [2, 1, 1, 1]  # <=10ms, <=100ms, <=1s, +Inf
+    assert abs(h.sum - 5.56) < 1e-9
+    # quantiles land in the right bucket
+    assert h.quantile(0.3) <= 0.01
+    assert 0.01 <= h.quantile(0.6) <= 0.1
+    assert h.quantile(1.0) >= 1.0
+    lines = h.prom_lines("x_seconds")
+    assert lines[0] == "# TYPE x_seconds histogram"
+    assert 'x_seconds_bucket{le="0.01"} 2' in lines
+    assert 'x_seconds_bucket{le="0.1"} 3' in lines
+    assert 'x_seconds_bucket{le="1"} 4' in lines
+    assert 'x_seconds_bucket{le="+Inf"} 5' in lines
+    assert "x_seconds_count 5" in lines
+
+
+# ------------------------------------------------------ profile store
+def test_profile_store_roundtrip(tmp_path, single):
+    from presto_tpu.obs.profile import ProfileStore, plan_fingerprint
+
+    store = ProfileStore(str(tmp_path))
+    plan = single.plan("select count(*) from orders")
+    key = store.key(plan, single.catalogs)
+    assert store.lookup(key) is None
+    store.record(key, {"capacity_boost": 4, "rows_out": 1})
+    # fresh instance reads the persisted file (cross-process contract)
+    store2 = ProfileStore(str(tmp_path))
+    prof = store2.lookup(key)
+    assert prof == {"capacity_boost": 4, "rows_out": 1}
+    # fingerprints: stable across replans, sensitive to the plan and
+    # to the connector snapshot (row counts)
+    assert plan_fingerprint(plan, single.catalogs) == key
+    plan2 = single.plan("select count(*) from orders")
+    assert plan_fingerprint(plan2, single.catalogs) == key
+    other = single.plan("select count(*) from customer")
+    assert plan_fingerprint(other, single.catalogs) != key
+    bigger = {"tpch": TpchConnector(0.02)}
+    assert plan_fingerprint(plan, bigger) != key
+
+
+def test_repeated_query_skips_boost_ladder(tmp_path):
+    """THE acceptance contract: run 1 climbs the overflow-retry
+    ladder (capacity_boost_retries > 0) and persists its settled
+    bucket; run 2 — a fresh runner sharing only the profile dir —
+    starts there and never boosts (capacity_boost_retries = 0,
+    profile_store_hits >= 1), with identical rows."""
+    q = ("select n_regionkey, array_agg(n_nationkey) from nation "
+         "group by n_regionkey")
+
+    def run():
+        r = LocalRunner({"tpch": TpchConnector(SF)},
+                        default_catalog="tpch", page_rows=PAGE_ROWS)
+        r.session.set("stats_profile_dir", str(tmp_path))
+        # 5 nations per region vs 2 slots: guaranteed first-run
+        # collect-state overflow onto the boost ladder
+        r.session.set("array_agg_max_elements", 2)
+        rows = r.execute(q).rows
+        ex = r.executor
+        return (rows, ex.capacity_boost_retries,
+                ex.profile_store_hits, ex._capacity_boost)
+
+    rows1, retries1, hits1, boost1 = run()
+    assert retries1 > 0 and boost1 > 1
+    assert hits1 == 0
+    rows2, retries2, hits2, boost2 = run()
+    assert rows_equal(rows1, rows2)
+    assert retries2 == 0, "second run climbed the ladder again"
+    assert hits2 >= 1 and boost2 == boost1
+    # counter-pinned through the registry
+    from presto_tpu.exec.counters import QUERY_COUNTERS
+
+    assert "capacity_boost_retries" in QUERY_COUNTERS
+    assert "profile_store_hits" in QUERY_COUNTERS
+
+
+# ------------------------------------------------------ lint coverage
+def test_spans_lint_rule_clean_and_catches_seeded(tmp_path):
+    from tools.lint import check_spans
+
+    # the repo itself is clean (also covered by the full-lint gate)
+    assert not check_spans()
+    # a seeded undeclared kind is caught
+    p = tmp_path / "seeded.py"
+    p.write_text(textwrap.dedent("""
+        def f(tr):
+            tr.begin("bogus-kind", "x")
+            tr.complete("also-bogus", "y", 0.0, 1.0)
+    """))
+    found = check_spans(paths=[str(p)])
+    msgs = [f.message for f in found]
+    assert any("bogus-kind" in m for m in msgs), msgs
+    assert any("also-bogus" in m for m in msgs), msgs
+    # every declared kind has an emission site (no stale entries) —
+    # the reverse direction of the same registry discipline
+    assert not [m for m in (str(f) for f in check_spans())
+                if "stale" in m]
+
+
+def test_span_ingest_clamps_skew():
+    """The timing-source rule: remote spans re-base into the parent
+    window and CLAMP — wall-clock skew can never produce a negative
+    interval or a child escaping its parent."""
+    tr = OBS.QueryTrace("q")
+    parent = tr.begin("task", "t0")
+    time.sleep(0.01)
+    tr.end(parent)
+    lo, hi = parent.t0, parent.t1
+    n = tr.ingest([
+        {"kind": "run", "name": "r", "t0": -5.0, "t1": 999.0},
+        {"kind": "queue", "name": "k", "t0": 0.0, "t1": 0.001},
+        {"kind": "junk"},  # malformed: dropped, not fatal
+    ], parent, lo, hi)
+    assert n == 2
+    kids = [s for s in tr.spans()
+            if s.parent_id == parent.span_id]
+    for s in kids:
+        assert lo <= s.t0 <= s.t1 <= hi

@@ -29,12 +29,31 @@ def main() -> int:
     print(f"# warm run (compile + flush): {time.time() - t0:.1f}s",
           file=sys.stderr)
     ex.stats_drain = True
+    # lifecycle trace for the analyzed run (obs/trace.py): the
+    # critical-path and phase-split summaries below read it, and the
+    # same spans back the /v1/query tree on a server
+    from presto_tpu import obs as OBS
+
+    tr = OBS.QueryTrace(f"rung-{suite}-q{qid}-sf{sf}")
+    OBS.attach(ex, tr)
     t0 = time.time()
     _names, _rows, stats = ex.execute_with_stats(plan)
     total = time.time() - t0
+    OBS.finalize(ex, tr, os.environ.get("PRESTO_TPU_TRACE_DIR"))
     from presto_tpu.runner import explain_text
 
     print(explain_text(plan, stats=stats))
+    # critical path: the slowest span chain root -> leaf, plus the
+    # per-kind wall split (queue vs run vs fetch on distributed
+    # traces; attempt/operator locally)
+    cp = OBS.critical_path(tr)
+    print("# critical path: " + " -> ".join(
+        f"{s['kind']}:{s['name']}={s['ms']}ms" for s in cp["chain"]
+    ) if cp["chain"] else "# critical path: (no spans)",
+        file=sys.stderr)
+    print("# phase split (ms): " + ", ".join(
+        f"{k}={v}" for k, v in cp["by_kind_ms"].items()
+    ), file=sys.stderr)
     # gather accounting + fusion engagement for the analyzed run (the
     # late-materialization / fused-partial-agg observability contract)
     ctr = stats.get("counters", {})

@@ -34,6 +34,13 @@ counter plumbing fixes):
                   jax.jit/vmap/lax.scan/self._jit (a key or traced
                   program depending on wall clock or identity breaks
                   canonicalization and the persistent compile cache).
+  spans           every trace-span kind emitted anywhere (a constant
+                  first argument to a .begin(...)/.complete(...) span
+                  recorder call) is declared in obs.SPAN_KINDS, and
+                  every declared kind has an emission site — the
+                  QUERY_COUNTERS discipline applied to the trace
+                  vocabulary, so the QueryInfo tree, Chrome export,
+                  and analyze_rung's phase split cannot drift.
 
 Run: `python -m tools.lint` (exit 1 on findings); tier-1 runs the
 same checks via tests/test_static_analysis.py, and tools/ci_static.sh
@@ -599,8 +606,53 @@ def check_purity(paths=None) -> List[Finding]:
     return out
 
 
+# ------------------------------------------------------------ rule: spans
+# the span-recorder emission methods (obs/trace.QueryTrace; _new is
+# the internal constructor the root "query" span uses). A call
+# `<anything>.begin("kind", ...)` / `.complete("kind", ...)` with a
+# constant first argument IS an emission site; dynamic kinds (the
+# ingest path re-materializing remote spans) are invisible here by
+# design — every dynamic kind originates at some constant site.
+_SPAN_EMIT_METHODS = ("begin", "complete", "_new")
+
+
+def check_spans(paths=None) -> List[Finding]:
+    from presto_tpu.obs import SPAN_KINDS
+
+    out: List[Finding] = []
+    emitted: Dict[str, Tuple[str, int]] = {}
+    for path in (paths or _py_files("presto_tpu", "tools",
+                                    "bench.py")):
+        tree, _ = _parse(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SPAN_EMIT_METHODS and \
+                    node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                emitted.setdefault(node.args[0].value,
+                                   (_rel(path), node.lineno))
+    for kind, (path, line) in sorted(emitted.items()):
+        if kind not in SPAN_KINDS:
+            out.append(Finding(
+                "spans", path, line,
+                f"span kind {kind!r} is emitted but not declared in "
+                f"obs.SPAN_KINDS — trace surfaces (QueryInfo tree, "
+                f"Chrome export, analyze_rung) would carry an "
+                f"undocumented vocabulary; declare it with help text"))
+    for kind in sorted(set(SPAN_KINDS) - set(emitted)):
+        out.append(Finding(
+            "spans", "presto_tpu/obs/__init__.py", 1,
+            f"SPAN_KINDS declares {kind!r} but no "
+            f".begin()/.complete() emission site exists in the "
+            f"engine (stale entry?)"))
+    return out
+
+
 # ----------------------------------------------------------------- driver
-ALL_RULES = ("excepts", "session-props", "counters", "locks", "purity")
+ALL_RULES = ("excepts", "session-props", "counters", "locks",
+             "purity", "spans")
 
 
 def run_lint(rules=ALL_RULES) -> List[Finding]:
@@ -616,4 +668,6 @@ def run_lint(rules=ALL_RULES) -> List[Finding]:
         findings += check_locks()
     if "purity" in rules:
         findings += check_purity()
+    if "spans" in rules:
+        findings += check_spans()
     return findings
