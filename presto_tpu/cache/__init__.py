@@ -1,0 +1,44 @@
+"""presto_tpu/cache — the two-level result-cache subsystem (ISSUE 10).
+
+Reference: the reuse ladder presto built one rung at a time — compiled
+expressions (ExpressionCompiler cache), compiled artifacts, and the
+result-set reuse that dashboard traffic actually needs. This package
+is the RESULT rung, built on prerequisites already in-tree:
+
+  level 1 — fragment-result cache (exec/executor.py hooks): cacheable
+      plan subtrees (cache/rules.py: deterministic, snapshot-keyable)
+      are keyed by (canonical plan fingerprint, connector snapshot
+      versions) and their page streams stored through the byte-
+      budgeted store below; a hit replays pages and skips
+      compile+launch entirely (``program_launches`` stays 0).
+  level 2 — full-statement cache (runner.py): identical (canonical
+      statement AST, catalog/schema, result-affecting session props,
+      snapshot versions) statements return the finished row set
+      without planning or executing.
+
+Invalidation is structural: the Connector SPI's ``snapshot_version``
+(connectors/base.py; the writable memory connector bumps an explicit
+write counter) rides in every key, so a write makes stale entries
+unreachable; ``invalidate_tables`` reclaims their bytes eagerly on the
+runner's write path. Governed by session properties
+``result_cache_enabled`` / ``result_cache_bytes`` /
+``result_cache_ttl_ms``; observable via the four ``result_cache_*``
+registry counters (exec/counters.py) and ``cache`` spans in the trace
+plane (obs/).
+"""
+
+from presto_tpu.cache.rules import (  # noqa: F401
+    RESULT_AFFECTING_PROPS,
+    VOLATILE_FUNCTIONS,
+    cacheable,
+    scan_tables,
+    select_cache_points,
+    snapshot_tokens,
+    subtree_key,
+    uncacheable_reason,
+)
+from presto_tpu.cache.store import (  # noqa: F401
+    ResultCache,
+    shared_cache,
+    shared_cache_if_exists,
+)

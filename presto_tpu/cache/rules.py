@@ -1,0 +1,221 @@
+"""Cacheability rules + cache-point selection for the result cache.
+
+Reference: presto-main's materialized-view/result-set staleness model —
+a cached result is servable exactly when (a) the computation is
+deterministic and (b) the data it read is provably unchanged. Ours
+expresses (a) as a structural walk over the physical plan (no system-
+catalog scans, no volatile expressions, no remote sources, no
+query-unique row ids) and (b) as the connector-SPI ``snapshot_version``
+token folded into every cache key — a write to any scanned table moves
+the token, so stale entries become structurally unreachable rather
+than needing a coordinated flush (the memory connector bumps an
+explicit write counter; read-only generator connectors derive a
+row-count token for free).
+
+Key material is built on the same identity-free structural walker the
+observed-stats profile store uses (`obs/profile.structural_encode`),
+so two processes — or two per-query runners inside one server — key
+the same plan identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, Optional, Set, Tuple
+
+from presto_tpu.exec import plan as P
+from presto_tpu.expr.ir import Call, RowExpression
+from presto_tpu.obs.profile import plan_fingerprint, structural_fingerprint
+
+# SQL functions whose value depends on when/where they run, not on
+# their inputs — a plan containing one can never be cached. The
+# current registry implements none of these; the gate exists so adding
+# one later cannot silently poison the cache.
+VOLATILE_FUNCTIONS: FrozenSet[str] = frozenset({
+    "random", "rand", "shuffle", "uuid",
+    "now", "current_timestamp", "current_time", "current_date",
+    "localtime", "localtimestamp",
+})
+
+# session properties whose value can change a successful query's
+# RESULT (not just its speed): they ride in every full-statement cache
+# key. array_agg_max_elements bounds collect-state aggregates;
+# page_rows moves split boundaries and therefore unordered row order.
+RESULT_AFFECTING_PROPS: Tuple[str, ...] = (
+    "array_agg_max_elements", "page_rows",
+)
+
+# subtree roots worth caching: operators that materialize/recompute
+# state (a bare scan replays as cheaply as its cache entry would —
+# scan == generate for the generator connectors, and the caching
+# CONNECTOR already covers host-page scans)
+_WORTH_CACHING = (
+    P.Aggregation, P.HashJoin, P.CrossJoin, P.Sort, P.TopN,
+    P.Window, P.MarkDistinct, P.GroupId, P.Unnest,
+)
+
+
+def _volatile_call(x) -> Optional[str]:
+    """First volatile function name reachable from any RowExpression
+    field of a plan node (walked structurally, like the encoder)."""
+    if isinstance(x, RowExpression):
+        if isinstance(x, Call) and x.name in VOLATILE_FUNCTIONS:
+            return x.name
+        for c in x.children():
+            hit = _volatile_call(c)
+            if hit:
+                return hit
+        return None
+    if isinstance(x, (tuple, list)):
+        for v in x:
+            hit = _volatile_call(v)
+            if hit:
+                return hit
+        return None
+    if dataclasses.is_dataclass(x) and not isinstance(x, type) and \
+            not isinstance(x, P.PhysicalNode):
+        for f in dataclasses.fields(x):
+            hit = _volatile_call(getattr(x, f.name))
+            if hit:
+                return hit
+    return None
+
+
+def scan_tables(node: P.PhysicalNode) -> Set[Tuple[str, str]]:
+    """Every (catalog, table) the subtree scans."""
+    out: Set[Tuple[str, str]] = set()
+
+    def walk(n):
+        if isinstance(n, P.TableScan):
+            out.add((n.catalog, n.table))
+        for c in n.children():
+            walk(c)
+
+    walk(node)
+    return out
+
+
+def uncacheable_reason(node: P.PhysicalNode,
+                       catalogs) -> Optional[str]:
+    """None when the subtree is deterministic and snapshot-keyable;
+    otherwise a short human-readable reason (surfaced by tests and
+    tools, never raised)."""
+    if isinstance(node, P.RemoteSource):
+        return "remote source (pages come from runtime task state)"
+    if isinstance(node, P.UniqueId):
+        return "query-unique row ids"
+    if isinstance(node, P.TableScan):
+        if node.catalog == "system":
+            return "system-catalog scan (live engine state)"
+        conn = catalogs.get(node.catalog)
+        if conn is None:
+            return f"unknown catalog {node.catalog!r}"
+        if snapshot_of(conn, node.table) is None:
+            return (f"{node.catalog}.{node.table} has no snapshot "
+                    f"version (connector cannot prove staleness)")
+    elif dataclasses.is_dataclass(node):
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, P.PhysicalNode):
+                continue  # children walk below
+            hit = _volatile_call(v)
+            if hit:
+                return f"volatile function {hit}()"
+    for c in node.children():
+        reason = uncacheable_reason(c, catalogs)
+        if reason:
+            return reason
+    return None
+
+
+def cacheable(node: P.PhysicalNode, catalogs) -> bool:
+    return uncacheable_reason(node, catalogs) is None
+
+
+def snapshot_of(conn, table: str) -> Optional[str]:
+    """The connector's snapshot token for one table, None when the
+    connector cannot provide one (-> uncacheable). Tolerates legacy
+    connectors without the SPI method."""
+    fn = getattr(conn, "snapshot_version", None)
+    if fn is None:
+        return None
+    try:
+        v = fn(table)
+    except Exception:  # noqa: BLE001 - a failing snapshot probe means
+        return None    # "cannot prove staleness", never a query error
+    return None if v is None else str(v)
+
+
+def snapshot_tokens(tables, catalogs) -> Optional[Tuple]:
+    """Sorted ((catalog, table, version), ...) for a table set; None
+    when any table has no snapshot (the whole key is then unbuildable
+    and the caller skips caching)."""
+    out = []
+    for catalog, table in sorted(tables):
+        conn = catalogs.get(catalog)
+        v = snapshot_of(conn, table) if conn is not None else None
+        if v is None:
+            return None
+        out.append((catalog, table, v))
+    return tuple(out)
+
+
+def subtree_key(node: P.PhysicalNode, catalogs):
+    """(cache key, scanned tables) for one cacheable subtree, or None.
+    The key folds the canonical plan fingerprint (which already embeds
+    per-scan row-count tokens) with every scanned table's
+    snapshot_version — a write to any input moves the key, so a stale
+    entry can never be addressed again."""
+    tables = frozenset(scan_tables(node))
+    snap = snapshot_tokens(tables, catalogs)
+    if snap is None:
+        return None
+    fp = plan_fingerprint(node, catalogs)
+    return (f"frag:{fp}:{structural_fingerprint(snap)}", tables)
+
+
+def _worth_caching(node: P.PhysicalNode) -> bool:
+    if isinstance(node, _WORTH_CACHING):
+        return True
+    return any(_worth_caching(c) for c in node.children())
+
+
+def select_cache_points(root: P.PhysicalNode, catalogs, *,
+                        root_only: bool = False) -> Dict[int, tuple]:
+    """Choose the subtrees whose page streams this query caches:
+    the MAXIMAL cacheable subtrees that contain at least one
+    materializing operator. A fully cacheable plan gets exactly one
+    point (its root); a plan with one volatile/system branch still
+    caches every clean expensive branch under it. Returns
+    {id(subnode): (key, subnode, tables)} — node references are held
+    in the values so ids stay stable for the query's lifetime.
+
+    ``root_only`` restricts selection to the whole plan (the
+    distributed executor's mid-plan pages are mesh-sharded global
+    arrays a host replay could not reproduce; its root output is
+    ordinary decodable pages)."""
+    points: Dict[int, tuple] = {}
+
+    def consider(node) -> bool:
+        """True when ``node`` was made a cache point (callers then
+        skip its subtree)."""
+        if not _worth_caching(node):
+            return False
+        if uncacheable_reason(node, catalogs) is None:
+            keyed = subtree_key(node, catalogs)
+            if keyed is not None:
+                key, tables = keyed
+                points[id(node)] = (key, node, tables)
+                return True
+        return False
+
+    if consider(root) or root_only:
+        return points
+
+    def descend(node):
+        for c in node.children():
+            if not consider(c):
+                descend(c)
+
+    descend(root)
+    return points

@@ -1,0 +1,308 @@
+"""The process-shared, byte-budgeted result-cache store.
+
+Reference: presto-main's coordinator-side result reuse direction
+(compiled-artifact reuse extended to RESULTS) and presto-memory's
+MemoryPagesStore (a scan of unchanged data is a memory read, not a
+recomputation). One lock-disciplined store per process holds two entry
+kinds under ONE LRU/byte budget:
+
+  - fragment entries: the host-side page pytrees of one cacheable plan
+    subtree, held in a PageStore (host tier while the resident budget
+    allows; demoted entry-by-entry to the DISK tier — the same spill
+    files and pid-tagged dir lifecycle every other engine
+    materialization uses — when the host budget is exceeded);
+  - statement entries: the finished (names, rows, types) of one full
+    statement, host-RAM only (row tuples have no useful disk form at
+    this scale; under pressure they simply evict).
+
+Governance: ``result_cache_bytes`` is the HOST-resident budget; disk-
+demoted bytes are bounded at ``_DISK_BUDGET_FACTOR`` x that budget,
+past which LRU entries evict outright. ``result_cache_ttl_ms`` > 0
+ages entries out on access. Every key embeds connector snapshot
+versions (cache/rules.py), so invalidation-by-write needs no flush —
+``invalidate_tables`` exists to reclaim memory eagerly on the writable
+connectors' DML path and to serve wrapped page caches
+(connectors/cached.py ``drop_cache``).
+
+Concurrency: the QueryManager's per-query runners share one instance
+(``shared_cache()``); all map/byte-accounting mutations happen under
+``self._lock``. Page payloads are immutable after publication (readers
+take a list snapshot under the lock; host pytrees are never mutated),
+so replay needs no lock. An entry that alone exceeds the budget is not
+admitted (one oversized result must not flush the whole working set).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+DEFAULT_BUDGET_BYTES = 1 << 28  # 256 MiB host-resident
+_DISK_BUDGET_FACTOR = 4
+
+
+class _Entry:
+    __slots__ = ("key", "kind", "nbytes", "tables", "created",
+                 "store", "payload")
+
+    def __init__(self, key: str, kind: str, nbytes: int,
+                 tables: FrozenSet[Tuple[str, str]], created: float,
+                 store=None, payload=None):
+        self.key = key
+        self.kind = kind          # "pages" | "rows"
+        self.nbytes = nbytes
+        self.tables = tables
+        self.created = created
+        self.store = store        # PageStore (pages kind)
+        self.payload = payload    # (names, rows, types) (rows kind)
+
+    @property
+    def on_disk(self) -> bool:
+        return self.store is not None and self.store.tier == "disk"
+
+
+def _rows_bytes(names, rows, types) -> int:
+    """Cheap, stable size estimate for a statement entry: sampled
+    per-row getsizeof (tuple + cells) extrapolated over the row count.
+    An estimate is fine — the budget is a governor, not an allocator."""
+    base = 256 + 64 * (len(names) + len(types))
+    if not rows:
+        return base
+    sample = rows[:64]
+    per_row = sum(
+        sys.getsizeof(r) + sum(sys.getsizeof(v) for v in r)
+        for r in sample
+    ) / len(sample)
+    return base + int(per_row * len(rows))
+
+
+class ResultCache:
+    """Two-level result cache; see module docstring. All four
+    observability tallies mirror the executor-family registry counters
+    (exec/counters.QUERY_COUNTERS) as PROCESS totals — the /metrics
+    and system.metrics surfaces render these, while EXPLAIN ANALYZE
+    renders the querying executor's own counts."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                 ttl_ms: int = 0, spill_dir: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self.budget_bytes = int(budget_bytes) or DEFAULT_BUDGET_BYTES
+        self.ttl_ms = int(ttl_ms)
+        self.spill_dir = spill_dir
+        # process-total tallies (see class docstring)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    # ------------------------------------------------------ configure
+    def configure(self, budget_bytes: Optional[int] = None,
+                  ttl_ms: Optional[int] = None,
+                  spill_dir: Optional[str] = None) -> None:
+        """Re-apply session-level governance (last writer wins — the
+        store is process-shared, so the newest session's budget/TTL
+        governs; shrinking the budget evicts immediately)."""
+        with self._lock:
+            if budget_bytes is not None and int(budget_bytes) > 0:
+                self.budget_bytes = int(budget_bytes)
+            if ttl_ms is not None:
+                self.ttl_ms = int(ttl_ms)
+            if spill_dir is not None:
+                self.spill_dir = spill_dir or None
+            self._maintain()
+
+    # ----------------------------------------------------- inspection
+    def counters(self) -> Dict[str, int]:
+        """Process-total tallies under the registry counter names."""
+        return {
+            "result_cache_hits": self.hits,
+            "result_cache_misses": self.misses,
+            "result_cache_evictions": self.evictions,
+            "result_cache_invalidations": self.invalidations,
+        }
+
+    @property
+    def entry_count(self) -> int:
+        return len(self._entries)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values()
+                       if not e.on_disk)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    # ----------------------------------------------------- pages kind
+    def get_pages(self, key: str) -> Optional[List]:
+        """Host-side page pytrees for a fragment key, or None. The
+        returned list is a safe snapshot: host entries hand back their
+        (immutable, GC-protected) page list; disk entries load their
+        spill files under the lock so eviction can never race a
+        reader's file access."""
+        with self._lock:
+            e = self._expire_locked(key)
+            if e is None or e.kind != "pages":
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return list(e.store.host_pages())
+
+    def put_pages(self, key: str, pages, tables) -> int:
+        """Publish one fragment's completed page stream. ``pages`` may
+        be device or host pytrees (PageStore.put stages host-side
+        either way — callers publish AFTER the attempt completes, so
+        the D2H read happens off the deferred-sync hot path). Returns
+        the number of entries evicted to admit it."""
+        from presto_tpu.exec.pagestore import PageStore
+
+        store = PageStore(tier="host")
+        for p in pages:
+            store.put(p)
+        with self._lock:
+            if store.bytes > self.budget_bytes:
+                store.close()  # oversized: never admitted (see above)
+                return 0
+            self._drop_locked(key)
+            self._entries[key] = _Entry(
+                key, "pages", store.bytes, frozenset(tables),
+                time.monotonic(), store=store,
+            )
+            return self._maintain()
+
+    # ------------------------------------------------------ rows kind
+    def get_rows(self, key: str):
+        """(names, rows, types) for a statement key, or None. Lists
+        are copied so callers can own their QueryResult."""
+        with self._lock:
+            e = self._expire_locked(key)
+            if e is None or e.kind != "rows":
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            names, rows, types = e.payload
+            return list(names), list(rows), list(types)
+
+    def put_rows(self, key: str, names, rows, types, tables) -> int:
+        nbytes = _rows_bytes(names, rows, types)
+        with self._lock:
+            if nbytes > self.budget_bytes:
+                return 0
+            self._drop_locked(key)
+            self._entries[key] = _Entry(
+                key, "rows", nbytes, frozenset(tables),
+                time.monotonic(),
+                payload=(list(names), list(rows), list(types)),
+            )
+            return self._maintain()
+
+    # --------------------------------------------------- invalidation
+    def invalidate_tables(self, tables) -> int:
+        """Drop every entry that read any of the given (catalog,
+        table) pairs — the eager-reclaim path the runner drives after
+        DML/CTAS writes (snapshot-keyed entries were already
+        unreachable; this frees their bytes now). Returns the count."""
+        tset = set(tables)
+        with self._lock:
+            doomed = [k for k, e in self._entries.items()
+                      if e.tables & tset]
+            for k in doomed:
+                self._drop_locked(k)
+            self.invalidations += len(doomed)
+            return len(doomed)
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            for k in list(self._entries):
+                self._drop_locked(k)
+            return n
+
+    # ------------------------------------------------- internals
+    def _drop_locked(self, key: str) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None and e.store is not None:
+            e.store.close()
+
+    def _expire_locked(self, key: str) -> Optional[_Entry]:
+        """TTL-aware lookup (caller holds the lock): an entry older
+        than result_cache_ttl_ms drops and reads as a miss — counted
+        as an eviction (age-based reclaim, not a write invalidation)."""
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        if self.ttl_ms > 0 and \
+                (time.monotonic() - e.created) * 1000.0 > self.ttl_ms:
+            self._drop_locked(key)
+            self.evictions += 1
+            return None
+        return e
+
+    def _maintain(self) -> int:
+        """Enforce the budgets (caller holds the lock): demote LRU
+        host-resident page entries to the disk tier past the resident
+        budget, evict LRU entries outright past the disk factor.
+        Returns the number of evictions."""
+        resident = sum(e.nbytes for e in self._entries.values()
+                       if not e.on_disk)
+        if resident > self.budget_bytes:
+            from presto_tpu.exec.pagestore import PageStore
+
+            for k in list(self._entries):
+                if resident <= self.budget_bytes:
+                    break
+                e = self._entries[k]
+                if e.kind != "pages" or e.on_disk:
+                    continue  # rows entries evict below, never demote
+                disk = PageStore(tier="disk", spill_dir=self.spill_dir)
+                for p in e.store.host_pages():
+                    disk.put(p)
+                e.store.close()
+                e.store = disk
+                resident -= e.nbytes
+        evicted = 0
+        total = sum(e.nbytes for e in self._entries.values())
+        cap = self.budget_bytes * _DISK_BUDGET_FACTOR
+        resident = sum(e.nbytes for e in self._entries.values()
+                       if not e.on_disk)
+        for k in list(self._entries):
+            if total <= cap and resident <= self.budget_bytes:
+                break
+            e = self._entries[k]
+            total -= e.nbytes
+            if not e.on_disk:
+                resident -= e.nbytes
+            self._drop_locked(k)
+            evicted += 1
+        self.evictions += evicted
+        return evicted
+
+
+# ------------------------------------------------- the shared instance
+_shared_lock = threading.Lock()
+_shared: Optional[ResultCache] = None
+
+
+def shared_cache() -> ResultCache:
+    """THE process-shared store (one per server process, like the
+    compiled-kernel cache): every per-query runner the QueryManager
+    spins up sees the same entries, which is what makes dashboard-
+    style repeated traffic collapse across concurrent clients."""
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            _shared = ResultCache()
+        return _shared
+
+
+def shared_cache_if_exists() -> Optional[ResultCache]:
+    """The shared store iff some session already created it — metric
+    surfaces use this so scraping /metrics never allocates a cache."""
+    return _shared

@@ -160,6 +160,9 @@ ETC_SESSION_KEYS: Dict[str, str] = {
     "query-trace.enabled": "query_trace_enabled",
     "query-trace.dir": "query_trace_dir",
     "stats-profile.dir": "stats_profile_dir",
+    "result-cache.enabled": "result_cache_enabled",
+    "result-cache.bytes": "result_cache_bytes",
+    "result-cache.ttl-ms": "result_cache_ttl_ms",
 }
 
 # consumed structurally by server_from_etc (constructor args /

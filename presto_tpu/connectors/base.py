@@ -209,6 +209,26 @@ class Connector:
         table layouts/constraints consulted by the planner)."""
         return frozenset()
 
+    def snapshot_version(self, table: str) -> Optional[str]:
+        """Opaque token that changes whenever the table's CONTENT may
+        have changed — the result cache (presto_tpu/cache/) folds it
+        into every key, so a write makes stale cached results
+        structurally unreachable (reference analog: connector-provided
+        table versions consulted for materialized-view staleness).
+
+        Default: derived from the row count, which is exact for the
+        immutable deterministic generators (content is a pure function
+        of (schema, scale), and scale moves the count). Writable
+        connectors MUST override with a token that also moves on
+        content-preserving-cardinality writes (UPDATE): the memory
+        connector bumps an explicit write counter. Return None when
+        staleness cannot be proven — scans of this table then never
+        cache."""
+        try:
+            return f"rows:{self.row_count(table)}"
+        except Exception:  # noqa: BLE001 - a connector without counts
+            return None    # is simply uncacheable, never a query error
+
     def splits(self, table: str, target_rows: int) -> List[Split]:
         """Chop the table into row-range splits of ~target_rows each."""
         total = self.row_count(table)

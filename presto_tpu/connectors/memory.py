@@ -69,6 +69,22 @@ class MemoryConnector(Connector):
 
     def __init__(self):
         self._tables: Dict[str, _StoredTable] = {}
+        # per-table write counter: every mutation path bumps it, so
+        # snapshot_version moves even when the row count does not
+        # (UPDATE rewrites through create_table(replace=True) with the
+        # same cardinality — a row-count-derived token would falsely
+        # certify stale cached results; see Connector.snapshot_version)
+        self._write_versions: Dict[str, int] = {}
+
+    def _bump(self, name: str) -> None:
+        self._write_versions[name] = (
+            self._write_versions.get(name, 0) + 1
+        )
+
+    def snapshot_version(self, table: str) -> str:
+        t = self._tables.get(table)
+        return (f"w{self._write_versions.get(table, 0)}"
+                f":r{t.row_count if t is not None else 0}")
 
     # ------------------------------------------------------------- write
     def create_table(
@@ -90,6 +106,7 @@ class MemoryConnector(Connector):
             ),
         )
         self._tables[name] = _StoredTable(schema, list(rows))
+        self._bump(name)
         return len(rows)
 
     def insert(self, name: str, rows: List[tuple]) -> int:
@@ -97,12 +114,14 @@ class MemoryConnector(Connector):
         if t is None:
             raise KeyError(f"no table {name!r}")
         self._tables[name] = _StoredTable(t.schema, t.rows + list(rows))
+        self._bump(name)
         return len(rows)
 
     def drop_table(self, name: str) -> None:
         if name not in self._tables:
             raise KeyError(f"no table {name!r}")
         del self._tables[name]
+        self._bump(name)
 
     # -------------------------------------------------------------- read
     def tables(self) -> List[str]:

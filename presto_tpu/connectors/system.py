@@ -54,6 +54,14 @@ class SystemConnector(Connector):
         self._schemas: Dict[str, TableSchema] = {}
         self._providers: Dict[str, Callable[[], List[tuple]]] = {}
 
+    def snapshot_version(self, table: str) -> None:
+        """Live provider tables have no staleness token — content can
+        change with no cardinality movement (e.g. a query's state
+        column), so scans of the system catalog never result-cache
+        (cache/rules.py also excludes the catalog by name; this is
+        the SPI-level belt to that brace)."""
+        return None
+
     def register(
         self,
         table: str,

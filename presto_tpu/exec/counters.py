@@ -118,6 +118,22 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "gauge", "runs whose starting capacity bucket was seeded "
         "from a persisted observed-stats profile (obs/profile.py; "
         "per query)"),
+    "result_cache_hits": (
+        "counter", "result-cache hits: fragment page replays + full-"
+        "statement row replays (presto_tpu/cache/; executor lifetime "
+        "— /metrics and system.metrics overlay the process-shared "
+        "store's totals)"),
+    "result_cache_misses": (
+        "counter", "result-cache lookups that executed for real (the "
+        "entry is published when the attempt completes overflow-free)"),
+    "result_cache_evictions": (
+        "counter", "result-cache entries dropped by the byte-budget "
+        "LRU or TTL aging (result_cache_bytes / result_cache_ttl_ms)"),
+    "result_cache_invalidations": (
+        "counter", "result-cache entries reclaimed by the write-path "
+        "invalidation hook after DML/CTAS to their scanned tables "
+        "(staleness itself is structural: snapshot_version rides in "
+        "every key)"),
     "trace_spans": (
         "gauge", "spans recorded into this query's lifecycle trace "
         "(obs/trace.py; pinned 0 when tracing is off)"),

@@ -495,6 +495,31 @@ class Executor:
         self.profile_store = None
         self.capacity_boost_retries = 0
         self.profile_store_hits = 0
+        # ---- result cache (ISSUE 10, presto_tpu/cache/): when a
+        # ResultCache is wired (result_cache_enabled session property
+        # -> runner.apply_session, or set directly by library users),
+        # execute()/stream_fragment() select the plan's maximal
+        # cacheable subtrees as CACHE POINTS (cache/rules.py) and
+        # pages() serves those subtrees from the cache — a hit replays
+        # stored host pages and skips compile+launch entirely
+        # (program_launches stays 0); a miss streams normally while
+        # collecting, and publishes ONLY after the attempt completes
+        # overflow-free (a truncated page set can never be cached).
+        # Counters are lifetime-cumulative like the join counters;
+        # /metrics + system.metrics overlay the process-shared store's
+        # totals so concurrent per-query executors aggregate.
+        self.result_cache = None
+        self.result_cache_hits = 0
+        self.result_cache_misses = 0
+        self.result_cache_evictions = 0
+        self.result_cache_invalidations = 0
+        # per-query cache-point state: id(subtree) -> (key, node,
+        # tables) — node refs held so ids stay stable; inflight guards
+        # the miss path's re-entrant pages() call; pending holds
+        # completed-but-unpublished streams until the attempt succeeds
+        self._cache_points: Dict[int, tuple] = {}
+        self._cache_inflight: set = set()
+        self._cache_pending: List = []
 
     # ------------------------------------------------------------ plumbing
     def count_listener_error(self) -> None:
@@ -503,6 +528,13 @@ class Executor:
         misbehaving EventListener shows on /metrics, system.metrics,
         and EXPLAIN ANALYZE instead of disappearing."""
         self.listener_errors += 1
+
+    def count_cache_invalidations(self, n: int) -> None:
+        """Registry-counter sink for the runner's write-path result-
+        cache invalidation (runner._invalidate_caches) — same pattern
+        as count_listener_error: the increment lives on the executor
+        so every counter surface renders it."""
+        self.result_cache_invalidations += n
 
     def _trace_operators(self, tr, att_span) -> None:
         """Emit per-plan-node operator spans from the successful
@@ -836,6 +868,17 @@ class Executor:
         """Stream pages for a node, collecting per-node stats when an
         EXPLAIN ANALYZE run enabled them (reference: OperatorContext
         wall/row accounting feeding PlanPrinter)."""
+        # result-cache points (presto_tpu/cache/): a designated
+        # cacheable subtree serves from / populates the shared store;
+        # the inflight guard lets the miss path re-enter this method
+        # for the real stream. One dict probe when caching is on, zero
+        # overhead (empty-dict falsy check) when off.
+        if self._cache_points:
+            entry = self._cache_points.get(id(node))
+            if entry is not None and \
+                    id(node) not in self._cache_inflight:
+                yield from self._cached_pages(node, entry)
+                return
         impl = self._pages_impl(node)
         if self._collect_stats is None:
             for page in impl:
@@ -1549,6 +1592,10 @@ class Executor:
         # jit-key material — auto-on under pytest and bench --prewarm,
         # off on the hot serving path (plan_check session property)
         self._verify_plan(node)
+        # result-cache points (presto_tpu/cache/): pages() serves the
+        # selected subtrees from the shared store; a whole-plan hit
+        # replays with zero compiles and zero launches
+        self._select_cache_points(node)
         # lifecycle tracing (obs/trace.py): spans record at attempt/
         # page boundaries on the driver thread only — one `is None`
         # check is the entire cost with tracing off. Tracing borrows
@@ -1621,6 +1668,9 @@ class Executor:
                 if tr is not None:
                     self._trace_operators(tr, att_span)
                     tr.end(att_span, outcome="ok", rows=len(rows))
+                # overflow-free attempt: completed cache streams are
+                # safe to publish (decode above already paid the sync)
+                self._publish_cache_pending()
                 if prof_key is not None:
                     self._record_profile(prof_key, len(rows))
                 return names, rows
@@ -1631,6 +1681,8 @@ class Executor:
             # release materialized intermediates (HBM/host pages) the
             # moment the query is done
             self._release_stream_cache()
+            self._cache_points = {}
+            self._cache_pending = []
             self._snap_compile_counters(cc_base)
             if tr is not None:
                 tr.end(exec_span, boost=self._capacity_boost)
@@ -1644,15 +1696,115 @@ class Executor:
         results), and the per-attempt gather/fusion counters — a
         retried attempt re-defers and re-materializes from scratch, so
         cumulative counts would break the exactly-one-gather-per-
-        carried-column accounting."""
+        carried-column accounting. Unpublished result-cache streams
+        drop too: they may embed the overflow that forced this retry."""
         self._pending_overflow = []
         self._release_stream_cache()
+        self._cache_pending = []
+        self._cache_inflight = set()
         self.gathers_deferred = 0
         self.gathers_materialized = 0
         self.fused_partial_aggs = 0
         self.program_launches = 0
         self.splits_scanned = 0
         self.memory_chunked_pipelines = 0
+
+    # -------------------------------------------------- result cache
+    def _select_cache_points(self, node: P.PhysicalNode) -> None:
+        """Per-query cache-point selection (cache/rules.py): maximal
+        cacheable subtrees containing a materializing operator.
+        Subclasses (the distributed executor) restrict to the root —
+        their mid-plan pages are mesh-sharded global arrays a host
+        replay could not reproduce.
+
+        Keys are salted with the EXECUTOR config that can change a
+        successful subtree's output without appearing in the plan:
+        collect_k bounds collect-state aggregates (array_agg & family)
+        and page_rows shapes the replayed page stream itself — the
+        store is process-shared, so two sessions with different
+        settings must never address one entry."""
+        self._cache_points = {}
+        if self.result_cache is None:
+            return
+        from presto_tpu.cache import select_cache_points
+
+        salt = f"k{self.collect_k}.p{self.page_rows}"
+        self._cache_points = {
+            i: (f"{key}:{salt}", n, tables)
+            for i, (key, n, tables) in select_cache_points(
+                node, self.catalogs,
+                root_only=type(self).__name__ != "Executor",
+            ).items()
+        }
+
+    def _cached_pages(self, node: P.PhysicalNode,
+                      entry) -> Iterator[Page]:
+        """Serve one cache point: replay stored host pages on a hit
+        (no compile, no launch, one device_put per page); on a miss,
+        stream the real subtree (re-entrant through pages() via the
+        inflight guard) while collecting, and stage the completed
+        stream for publication after the attempt proves overflow-free.
+        An abandoned stream (downstream Limit stopped consuming) never
+        reaches the staging append, so partial page sets cannot be
+        published."""
+        key, _node_ref, tables = entry
+        tr = self.trace
+        t0 = tr.now() if tr is not None else 0.0
+        host_pages = self.result_cache.get_pages(key)
+        label = type(node).__name__
+        if host_pages is not None:
+            self.result_cache_hits += 1
+            # replayed pages still pass the per-query accounting: the
+            # memory limit holds whether a page came off the device or
+            # out of the cache, and EXPLAIN ANALYZE shows the replay's
+            # pages/rows on this node (its subtree honestly shows
+            # nothing — nothing executed; the Counters line carries
+            # the result_cache_hits that explain why)
+            st = None
+            if self._collect_stats is not None:
+                st = self._collect_stats.setdefault(
+                    id(node), NodeStats(label))
+            for hp in host_pages:
+                dp = jax.device_put(hp)
+                self._account_page(dp)
+                if st is not None:
+                    st.pages += 1
+                    st.row_counts.append(dp.num_rows())
+                yield dp
+            if tr is not None:
+                tr.complete("cache", f"hit:{label}", t0, tr.now(),
+                            pages=len(host_pages), key=key)
+                self.trace_spans += 1
+            return
+        self.result_cache_misses += 1
+        if tr is not None:
+            tr.complete("cache", f"miss:{label}", t0, tr.now(),
+                        key=key)
+            self.trace_spans += 1
+        self._cache_inflight.add(id(node))
+        try:
+            collected: List = []
+            for page in self.pages(node):
+                collected.append(page)
+                yield page
+        finally:
+            self._cache_inflight.discard(id(node))
+        self._cache_pending.append((key, collected, tables))
+
+    def _publish_cache_pending(self) -> None:
+        """Publish the attempt's completed cache streams — called by
+        the drivers exactly once per SUCCESSFUL (overflow-free)
+        attempt, which is also where the engine syncs anyway, so the
+        store's per-page D2H reads stay off the deferred-sync hot
+        path."""
+        pending, self._cache_pending = self._cache_pending, []
+        cache = self.result_cache
+        if cache is None:
+            return
+        for key, pages, tables in pending:
+            self.result_cache_evictions += cache.put_pages(
+                key, pages, tables
+            )
 
     def _overflow_flagged(self) -> bool:
         """OR-reduce the attempt's deferred overflow flags — the ONE
@@ -1693,6 +1845,11 @@ class Executor:
         # same pre-compile verification as execute(): a shipped
         # fragment is a plan tree too (worker-side task runtime)
         self._verify_plan(node)
+        # and the same result-cache point selection: a repeated leaf
+        # fragment replays on the worker too (split identity rides in
+        # the SplitFilterConnector's snapshot token, so two tasks of
+        # one fragment on different shares can never share a key)
+        self._select_cache_points(node)
         tr = self.trace
         try:
             attempts = 0
@@ -1732,6 +1889,9 @@ class Executor:
                 if not self._overflow_flagged():
                     if tr is not None:
                         tr.end(att_span, outcome="ok", pages=len(out))
+                    # publication mirrors the emit discipline: only a
+                    # completed overflow-free attempt's streams cache
+                    self._publish_cache_pending()
                     if prof_key is not None:
                         self._record_profile(prof_key, None,
                                              pages_out=len(out))
@@ -1752,6 +1912,8 @@ class Executor:
             # dirs) the moment the fragment is done — never rely on
             # __del__ timing (same discipline as execute())
             self._release_stream_cache()
+            self._cache_points = {}
+            self._cache_pending = []
             self._snap_compile_counters(cc_base)
 
     def _snap_compile_counters(self, base) -> None:

@@ -175,6 +175,26 @@ class PageStore:
                 return f.read()
         return entry
 
+    def host_pages(self) -> List:
+        """Host-side page pytrees WITHOUT device staging — the result
+        cache's replay/demotion plane: demoting a host-tier store to a
+        disk-tier one must not round-trip every page through the
+        device (stream() device_puts), and cache replay wants a safe
+        host snapshot it can stage lazily. Host tier returns the
+        retained pytrees; disk tier loads its spill files; device tier
+        returns the device pages as-is (callers on that tier want
+        them resident anyway)."""
+        if self.tier == "disk":
+            out = []
+            for path, treedef, n in self._pages:
+                with np.load(path) as z:
+                    leaves = [z[f"a{i}"] for i in range(n)]
+                out.append(
+                    jax.tree_util.tree_unflatten(treedef, leaves)
+                )
+            return out
+        return list(self._pages)
+
     def stream(self) -> Iterator[Page]:
         if self.tier == "host":
             for p in self._pages:

@@ -62,6 +62,11 @@ SPAN_KINDS: Dict[str, str] = {
              "fault-tolerance paths' trace annotation",
     "speculate": "one straggler-speculation copy dispatched (attrs: "
                  "uri); win/loss lands on the task span",
+    "cache": "one result-cache point served (presto_tpu/cache/): "
+             "hit:<Node> replays stored pages (attrs: pages, key) in "
+             "the span's interval — compile+launch skipped; "
+             "miss:<Node> marks the lookup, the real execution "
+             "follows as ordinary attempt/operator spans",
 }
 
 

@@ -30,21 +30,24 @@ import threading
 from typing import Dict, Optional
 
 
-def plan_fingerprint(plan, catalogs) -> str:
-    """Stable structural hash of a physical plan + the snapshot token
-    of every table it scans. Deliberately identity-free: dataclasses
-    encode as (classname, field values), scans append their current
-    row_count, anything exotic degrades to its type name."""
+def structural_encode(x, scan_token=None):
+    """THE identity-free structural walker: dataclasses encode as
+    (classname, field values), containers recurse, anything exotic
+    degrades to its type name — so two structurally identical objects
+    built in different processes encode byte-identically (no id(), no
+    dict ordering, no repr of opaque objects). Shared by the profile
+    fingerprint below, the result-cache keys (presto_tpu/cache/), and
+    the caching connector's constraint key (connectors/cached.py).
+
+    ``scan_token(scan) -> value``, when given, appends a per-TableScan
+    token (the profile store passes the table's current row count —
+    its connector-snapshot component)."""
     from presto_tpu.exec import plan as P
 
     def enc(x):
-        if isinstance(x, P.TableScan):
-            try:
-                rc = catalogs[x.catalog].row_count(x.table)
-            except Exception:  # noqa: BLE001 - a connector without
-                rc = -1  # counts still fingerprints structurally
+        if scan_token is not None and isinstance(x, P.TableScan):
             return ("TableScan", x.catalog, x.table,
-                    tuple(x.columns), rc,
+                    tuple(x.columns), scan_token(x),
                     tuple(sorted((f.name, enc(getattr(x, f.name)))
                                  for f in dataclasses.fields(x)
                                  if f.name not in ("catalog", "table",
@@ -60,8 +63,29 @@ def plan_fingerprint(plan, catalogs) -> str:
         if isinstance(x, (str, int, float, bool)) or x is None:
             return x
         return type(x).__name__  # callables/arrays: structure only
-    blob = repr(enc(plan)).encode()
+    return enc(x)
+
+
+def structural_fingerprint(x, scan_token=None) -> str:
+    """sha256 of the structural encoding, truncated like
+    plan_fingerprint (the shared key-material hash)."""
+    blob = repr(structural_encode(x, scan_token=scan_token)).encode()
     return hashlib.sha256(blob).hexdigest()[:24]
+
+
+def plan_fingerprint(plan, catalogs) -> str:
+    """Stable structural hash of a physical plan + the snapshot token
+    of every table it scans. Deliberately identity-free: dataclasses
+    encode as (classname, field values), scans append their current
+    row_count, anything exotic degrades to its type name."""
+
+    def rc_token(scan):
+        try:
+            return catalogs[scan.catalog].row_count(scan.table)
+        except Exception:  # noqa: BLE001 - a connector without
+            return -1  # counts still fingerprints structurally
+
+    return structural_fingerprint(plan, scan_token=rc_token)
 
 
 class ProfileStore:

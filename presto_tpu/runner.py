@@ -88,6 +88,11 @@ class LocalRunner:
         # the last query's lifecycle trace (obs.QueryTrace), None when
         # tracing was off — tools and the HTTP server read it here
         self.last_trace = None
+        # plan-time scalar-subquery plans of the CURRENT statement:
+        # their scans execute during planning and fold into the plan
+        # as literals, so the full-statement cache must fold THEIR
+        # snapshot versions into its key too (reset per plan pass)
+        self._scalar_subplans: List = []
         self._ctor_page_rows = page_rows
         if mesh is None:
             self.executor = Executor(catalogs, page_rows=page_rows)
@@ -103,6 +108,9 @@ class LocalRunner:
             # plan-time scalar subqueries execute during planning, so
             # they get their own access check
             self._check_plan_access(node)
+            # ...and record for the statement cache's key material
+            # (their snapshot versions guard the baked-in literal)
+            self._scalar_subplans.append(node)
             # ...and must be fragmented before they hit a distributed
             # executor
             if self.mesh is not None:
@@ -288,6 +296,25 @@ class LocalRunner:
             ex.profile_store = ProfileStore.at(profile_dir)
         else:
             ex.profile_store = None
+        # result cache (ISSUE 10, presto_tpu/cache/): ONE process-
+        # shared store behind every enabled session — that sharing is
+        # what collapses repeated dashboard statements across the
+        # QueryManager's concurrent per-query runners. Budget/TTL are
+        # session-governed, last writer wins (the store is shared;
+        # shrinking the budget evicts immediately).
+        if bool(self.session.get("result_cache_enabled")):
+            from presto_tpu.cache import shared_cache
+
+            rc = shared_cache()
+            rc.configure(
+                budget_bytes=int(
+                    self.session.get("result_cache_bytes")),
+                ttl_ms=int(self.session.get("result_cache_ttl_ms")),
+                spill_dir=self.session.get("spill_path") or None,
+            )
+            ex.result_cache = rc
+        else:
+            ex.result_cache = None
 
     def prewarm(self, sql: str) -> Dict:
         """Compile a query's program set ahead of timing: plan + execute
@@ -478,6 +505,7 @@ class LocalRunner:
                 self.session.user, cat, table
             )
             conn.drop_table(table)
+            self._invalidate_caches(cat, table)
             return QueryResult([], [], update_type="DROP TABLE")
         if isinstance(stmt, (N.Delete, N.Update)):
             _conn, cat, table = self._resolve_write_target(stmt.parts)
@@ -503,11 +531,13 @@ class LocalRunner:
             names, rows = self.executor.execute(inner_plan)
             if isinstance(stmt, N.CreateTableAs):
                 n = conn.create_table(table, names or [], types, rows)
+                self._invalidate_caches(cat, table)
                 return QueryResult(
                     ["rows"], [(n,)], update_type="CREATE TABLE AS",
                     column_types=["bigint"],
                 )
             n = conn.insert(table, rows)
+            self._invalidate_caches(cat, table)
             return QueryResult(["rows"], [(n,)], update_type="INSERT",
                                column_types=["bigint"])
         if isinstance(stmt, N.Explain):
@@ -521,13 +551,118 @@ class LocalRunner:
                 text = explain_text(out)
             return QueryResult(["Query Plan"],
                                [(line,) for line in text.splitlines()])
+        # plain query: the full-statement result cache short-circuits
+        # everything past planning for an identical (canonical AST,
+        # catalog/schema, result-affecting props, snapshot versions)
+        # repeat (presto_tpu/cache/; level 2 of the result cache —
+        # level 1, the fragment cache, engages inside execute())
         out = self._plan_statement_query(stmt)
+        keyed = self._statement_cache_key(out)
+        if keyed is not None:
+            hit = self.executor.result_cache.get_rows(keyed[0])
+            if hit is not None:
+                names, rows, types = hit
+                ex = self.executor
+                ex.result_cache_hits += 1
+                # the executor never ran: every per-query gauge must
+                # describe THIS query (zero launches, zero spills,
+                # zero boosts), not whatever executed last on this
+                # runner — _begin_attempt resets the per-attempt set,
+                # the per-query gauges execute() resets follow
+                ex._begin_attempt()
+                for gauge in ("peak_memory_bytes",
+                              "spill_partitions_used",
+                              "host_spill_pages", "disk_spill_pages",
+                              "skew_chunks_used", "device_oom_retries",
+                              "capacity_boost_retries",
+                              "profile_store_hits"):
+                    setattr(ex, gauge, 0)
+                return QueryResult(names, rows, column_types=types)
         names, rows = self.executor.execute(out)
         types = [str(t) for t in self.executor.output_types(out)]
+        if keyed is not None:
+            key, tables = keyed
+            self.executor.result_cache_evictions += (
+                self.executor.result_cache.put_rows(
+                    key, list(names or []), rows, types, tables
+                )
+            )
         return QueryResult(list(names or []), rows, column_types=types)
 
     def _qualified_view(self, parts) -> tuple:
         return self._resolve_catalog(parts)
+
+    def _statement_cache_key(self, plan):
+        """(key, scanned tables) for the full-statement cache, or None
+        when this statement cannot cache: no cache wired, a
+        non-deterministic / snapshot-less plan, or a plan-time scalar
+        subquery that was itself uncacheable (its result is baked into
+        the plan as a literal — a volatile or system-reading scalar
+        would make the whole statement unreplayable). Key material:
+        the canonical fingerprint of the PLANNED statement — after
+        view expansion and parameter binding, so whitespace/case
+        differences still hit while CREATE OR REPLACE VIEW moves the
+        key (keying the raw AST would serve the OLD view's rows) —
+        plus the resolved catalog/schema, the result-affecting session
+        properties, and every scanned table's snapshot version (main
+        plan AND scalar subplans; a baked-in scalar literal is covered
+        twice: its value changes the plan fingerprint, its source's
+        snapshot rides in the key)."""
+        from presto_tpu.cache import (
+            RESULT_AFFECTING_PROPS,
+            cacheable,
+            scan_tables,
+            snapshot_tokens,
+        )
+        from presto_tpu.obs.profile import (
+            plan_fingerprint,
+            structural_fingerprint,
+        )
+
+        if self.executor.result_cache is None:
+            return None
+        if not cacheable(plan, self.catalogs):
+            return None
+        tables = scan_tables(plan)
+        for sub in self._scalar_subplans:
+            if not cacheable(sub, self.catalogs):
+                return None
+            tables |= scan_tables(sub)
+        snap = snapshot_tokens(tables, self.catalogs)
+        if snap is None:
+            return None
+        props = tuple(
+            (p, str(self.session.get(p)))
+            for p in RESULT_AFFECTING_PROPS
+        )
+        fp = structural_fingerprint((
+            plan_fingerprint(plan, self.catalogs),
+            self._current_catalog(), self.session.schema, props, snap,
+        ))
+        return f"stmt:{fp}", frozenset(tables)
+
+    def _invalidate_caches(self, catalog: str, table: str) -> None:
+        """THE write-path invalidation hub: after any DML/CTAS/DROP
+        through this runner, (a) eagerly reclaim result-cache entries
+        that read the written table (their keys are already
+        unreachable — snapshot_version moved — this frees the bytes
+        now), and (b) drop a wrapping page cache's stale lists
+        (connectors/cached.py registers via invalidate()/drop_cache()).
+        Counted on the result_cache_invalidations registry counter."""
+        from presto_tpu.cache import shared_cache_if_exists
+
+        n = 0
+        rc = shared_cache_if_exists()
+        if rc is not None:
+            n += rc.invalidate_tables({(catalog, table)})
+        conn = self.catalogs.get(catalog)
+        inv = getattr(conn, "invalidate", None)
+        if inv is not None:
+            n += int(inv(table) or 0)
+        elif hasattr(conn, "drop_cache"):
+            conn.drop_cache()
+        if n:
+            self.executor.count_cache_invalidations(n)
 
     def _execute_dml(self, stmt) -> QueryResult:
         """DELETE/UPDATE as rewrite-through-SELECT + table replace
@@ -567,6 +702,7 @@ class LocalRunner:
             types = self.executor.output_types(plan)
             _names, rows = self.executor.execute(plan)
             conn.create_table(table, cols, types, rows, replace=True)
+            self._invalidate_caches(catalog, table)
             return QueryResult(
                 ["rows"], [(n_before - len(rows),)],
                 update_type="DELETE", column_types=["bigint"],
@@ -606,6 +742,7 @@ class LocalRunner:
             table, cols, [schema.column_type(c) for c in cols], rows,
             replace=True,
         )
+        self._invalidate_caches(catalog, table)
         return QueryResult(
             ["rows"], [(matched,)],
             update_type="UPDATE", column_types=["bigint"],
@@ -614,6 +751,9 @@ class LocalRunner:
     def _plan_statement_query(self, query: N.Query) -> P.Output:
         from presto_tpu.exec.pushdown import push_scan_constraints
 
+        # fresh scalar-subquery record per plan pass (the statement
+        # cache reads it right after planning the outermost statement)
+        self._scalar_subplans = []
         out = self._planner().plan_statement(query)
         self._check_plan_access(out)
         out = prune_plan(out, self.catalogs)

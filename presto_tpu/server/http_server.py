@@ -111,6 +111,23 @@ class MemoryArbiter:
             self._cv.notify_all()
 
 
+def _result_cache_totals():
+    """Process-total result-cache tallies under the registry counter
+    names (zeros when no session ever created the shared store —
+    scraping metrics must never allocate a cache)."""
+    from presto_tpu.cache import shared_cache_if_exists
+
+    rc = shared_cache_if_exists()
+    if rc is None:
+        return {
+            "result_cache_hits": 0,
+            "result_cache_misses": 0,
+            "result_cache_evictions": 0,
+            "result_cache_invalidations": 0,
+        }
+    return rc.counters()
+
+
 class QueryManager:
     """Reference: execution/SqlQueryManager.java — registry + lifecycle
     (QUEUED -> RUNNING -> FINISHED/FAILED/CANCELED)."""
@@ -407,6 +424,13 @@ class QueryManager:
             from presto_tpu.exec import counters as CTRS
 
             snap = CTRS.snapshot(executor)
+            # result-cache totals come from the PROCESS-shared store,
+            # not the bootstrap executor: on the concurrent path each
+            # query runs its own executor whose counters are
+            # discarded, while the store the queries actually shared
+            # keeps the fleet truth (the hit-rate surface
+            # tools/loadbench.py scrapes)
+            snap.update(_result_cache_totals())
             for name, (kind, _help) in CTRS.QUERY_COUNTERS.items():
                 suffix = "_total" if kind == "counter" else ""
                 lines += [
@@ -898,7 +922,11 @@ class PrestoTpuServer:
             # the three surfaces cannot drift
             from presto_tpu.exec import counters as CTRS
 
-            out.extend(sorted(CTRS.snapshot(ex).items()))
+            snap = CTRS.snapshot(ex)
+            # same process-shared overlay as /metrics (see
+            # _result_cache_totals): one truth on both surfaces
+            snap.update(_result_cache_totals())
+            out.extend(sorted(snap.items()))
             return out
 
         def runtime_tasks():

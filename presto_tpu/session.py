@@ -358,6 +358,40 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             str, "",
         ),
         PropertyMetadata(
+            "result_cache_enabled",
+            "serve repeated work from the two-level result cache "
+            "(presto_tpu/cache/): cacheable plan subtrees replay "
+            "their pages (skipping compile+launch) and identical "
+            "full statements return the finished row set, keyed by "
+            "(canonical plan/statement fingerprint, connector "
+            "snapshot versions) so a write to any scanned table "
+            "structurally invalidates. The store is process-shared "
+            "across concurrent queries. Observability: "
+            "result_cache_hits / result_cache_misses / "
+            "result_cache_evictions / result_cache_invalidations "
+            "counters in EXPLAIN ANALYZE",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "result_cache_bytes",
+            "host-resident byte budget for the result cache: LRU "
+            "page entries past it demote to disk-tier PageStore "
+            "spill files, and total bytes past 4x the budget evict "
+            "outright (result_cache_evictions counts both reclaim "
+            "paths). An entry larger than the whole budget is never "
+            "admitted",
+            int, 1 << 28,
+        ),
+        PropertyMetadata(
+            "result_cache_ttl_ms",
+            "age bound for result-cache entries in milliseconds: an "
+            "entry older than this reads as a miss and is reclaimed "
+            "(0 = no age bound; snapshot-version keying already "
+            "handles write staleness — TTL exists for wall-clock "
+            "freshness policies on slowly-polled dashboards)",
+            int, 0,
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

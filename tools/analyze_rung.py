@@ -91,6 +91,18 @@ def main() -> int:
           f"workers_excluded={ctr.get('workers_excluded', 0)} "
           f"deadline_ms_remaining="
           f"{ctr.get('deadline_ms_remaining', -1)}", file=sys.stderr)
+    # result cache (ISSUE 10, presto_tpu/cache/): hit/miss for the
+    # analyzed run plus the store's hit rate so far in this process —
+    # a repeated rung with hits=0 means its plan is uncacheable or the
+    # session left result_cache_enabled off
+    hits = ctr.get("result_cache_hits", 0)
+    misses = ctr.get("result_cache_misses", 0)
+    looked = hits + misses
+    print(f"# result cache: hits={hits} misses={misses} "
+          f"hit_rate={hits / looked if looked else 0.0:.2f} "
+          f"evictions={ctr.get('result_cache_evictions', 0)} "
+          f"invalidations={ctr.get('result_cache_invalidations', 0)}",
+          file=sys.stderr)
     print(f"# analyzed wall (incl. per-page drain overhead): {total:.2f}s")
     return 0
 

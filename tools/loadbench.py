@@ -1,0 +1,232 @@
+"""Concurrent-load benchmark for /v1/statement — the BENCH surface
+ROADMAP item 1 names, exercised here (ISSUE 10) to prove the
+process-shared result cache is safe and effective under concurrency.
+
+Reference workload model: dashboard-style production traffic is
+dominated by REPEATED statements with a tail of unique ones. The deck
+mixes both: each client thread loops over a shuffled deck of
+``--repeat-frac`` repeated statements (drawn from a small fixed set —
+these should collapse to cache hits after first execution) and unique
+statements (a varying literal defeats the cache — these measure the
+real execution floor under concurrency).
+
+Reported (one JSON line on stdout, like bench.py's driver contract):
+  clients, duration_s, queries, errors, qps,
+  p50_ms / p99_ms  — read from the server's OWN
+      ``presto_tpu_query_latency_seconds`` /metrics histogram (the
+      PR 9 surface; bucket-interpolated exactly like obs/histo.py,
+      and over the server's whole query population — client-side
+      stopwatches would double-count protocol polling),
+  cache_hits / cache_misses / cache_hit_rate — from the
+      ``presto_tpu_result_cache_*`` counters (the process-shared
+      store's totals).
+
+Usage:
+  python -m tools.loadbench                      # self-hosted server
+  python -m tools.loadbench --server http://...  # external server
+  python -m tools.loadbench --clients 16 --duration 20 --no-cache
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import re
+import sys
+import threading
+import time
+import urllib.request
+
+from tools._common import REPO  # noqa: F401  (sys.path side effect)
+
+# repeated deck: the Q1/Q3-style aggregates dashboards poll (small-SF
+# tpch so the self-hosted mode is fast on CPU)
+REPEATED_STATEMENTS = [
+    "select l_returnflag, l_linestatus, count(*), sum(l_quantity), "
+    "sum(l_extendedprice) from lineitem group by l_returnflag, "
+    "l_linestatus order by l_returnflag, l_linestatus",
+    "select count(*), sum(l_extendedprice * l_discount) from lineitem "
+    "where l_discount between 5 and 7",
+    "select o_orderpriority, count(*) from orders "
+    "group by o_orderpriority order by o_orderpriority",
+]
+# unique-statement template: the varying literal moves the canonical
+# statement fingerprint, so every instance misses by construction
+UNIQUE_TEMPLATE = (
+    "select count(*), sum(l_quantity) from lineitem "
+    "where l_partkey > {}"
+)
+
+
+def _scrape_metrics(server: str) -> str:
+    with urllib.request.urlopen(f"{server}/metrics", timeout=30) as r:
+        return r.read().decode()
+
+
+def _metric(text: str, name: str) -> int:
+    m = re.search(rf"^{re.escape(name)} (\d+)", text, re.M)
+    return int(m.group(1)) if m else 0
+
+
+def _histo_quantile(text: str, name: str, q: float,
+                    base: dict = None) -> float:
+    """Bucket-interpolated quantile over a Prometheus cumulative
+    histogram (the obs/histo.py estimate, recomputed from exposition
+    text; ``base`` subtracts a pre-run scrape so only this run's
+    observations count)."""
+    pat = re.compile(
+        rf'^{re.escape(name)}_bucket{{le="([^"]+)"}} (\d+)', re.M)
+    cum = [(float("inf") if le == "+Inf" else float(le), int(c))
+           for le, c in pat.findall(text)]
+    if not cum:
+        return 0.0
+    cum.sort()
+    base_map = dict(base or {})
+    counts, prev = [], 0
+    for le, c in cum:
+        c -= base_map.get(le, 0)
+        counts.append((le, max(c - prev, 0)))
+        prev = max(c, prev)
+    total = sum(c for _, c in counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    seen, lo = 0, 0.0
+    for le, c in counts:
+        if seen + c >= rank and c > 0:
+            hi = le if le != float("inf") else lo
+            frac = (rank - seen) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        seen += c
+        lo = le
+    return lo
+
+
+def _histo_base(text: str, name: str) -> dict:
+    pat = re.compile(
+        rf'^{re.escape(name)}_bucket{{le="([^"]+)"}} (\d+)', re.M)
+    return {(float("inf") if le == "+Inf" else float(le)): int(c)
+            for le, c in pat.findall(text)}
+
+
+def run_load(server: str, clients: int, duration_s: float,
+             repeat_frac: float, cache: bool, seed: int = 0) -> dict:
+    from presto_tpu.client import StatementClient
+
+    stop_at = time.time() + duration_s
+    lock = threading.Lock()
+    tally = {"queries": 0, "errors": 0, "rows": 0}
+
+    def worker(idx: int) -> None:
+        rng = random.Random(seed * 1000 + idx)
+        cl = StatementClient(server, user=f"load{idx}",
+                             catalog="tpch")
+        if cache:
+            cl.session_properties["result_cache_enabled"] = "true"
+        uniq = idx * 1_000_000  # per-client namespace: no cross-client
+        while time.time() < stop_at:  # accidental repeats
+            if rng.random() < repeat_frac:
+                sql = rng.choice(REPEATED_STATEMENTS)
+            else:
+                uniq += 1
+                sql = UNIQUE_TEMPLATE.format(uniq)
+            try:
+                res = cl.execute(sql)
+                ok = res.error is None
+            except Exception:  # noqa: BLE001 - a load generator
+                ok = False     # counts failures, it never crashes
+                res = None
+            with lock:
+                tally["queries"] += 1
+                if not ok:
+                    tally["errors"] += 1
+                elif res is not None:
+                    tally["rows"] += len(res.rows)
+
+    pre = _scrape_metrics(server)
+    hname = "presto_tpu_query_latency_seconds"
+    base_hist = _histo_base(pre, hname)
+    base_hits = _metric(pre, "presto_tpu_result_cache_hits_total")
+    base_miss = _metric(pre, "presto_tpu_result_cache_misses_total")
+
+    t0 = time.time()
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s * 4 + 60)
+    wall = time.time() - t0
+
+    post = _scrape_metrics(server)
+    hits = _metric(post, "presto_tpu_result_cache_hits_total") - base_hits
+    misses = (_metric(post, "presto_tpu_result_cache_misses_total")
+              - base_miss)
+    looked = hits + misses
+    return {
+        "clients": clients,
+        "duration_s": round(wall, 2),
+        "repeat_frac": repeat_frac,
+        "result_cache": cache,
+        "queries": tally["queries"],
+        "errors": tally["errors"],
+        "rows": tally["rows"],
+        "qps": round(tally["queries"] / wall, 2) if wall else 0.0,
+        "p50_ms": round(
+            _histo_quantile(post, hname, 0.50, base_hist) * 1000, 1),
+        "p99_ms": round(
+            _histo_quantile(post, hname, 0.99, base_hist) * 1000, 1),
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "cache_hit_rate": round(hits / looked, 3) if looked else 0.0,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--server", default=None,
+                    help="existing server URL; default boots one "
+                         "in-process (tpch sf0.01, concurrent path)")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--repeat-frac", type=float, default=0.8,
+                    help="fraction of statements drawn from the "
+                         "repeated (cacheable-hit) deck")
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--no-cache", action="store_true",
+                    help="run the same load without the result cache "
+                         "(the A/B baseline)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    srv = None
+    server = args.server
+    if server is None:
+        from presto_tpu.connectors.tpch import TpchConnector
+        from presto_tpu.server.http_server import PrestoTpuServer
+
+        # memory arbiter on => the CONCURRENT QueryManager path: each
+        # query gets its own runner/executor, all sharing the one
+        # result-cache store — exactly the contention this tool exists
+        # to exercise
+        srv = PrestoTpuServer(
+            {"tpch": TpchConnector(scale=args.scale)},
+            port=0, memory_budget_bytes=1 << 32,
+        )
+        port = srv.start()
+        server = f"http://127.0.0.1:{port}"
+        print(f"# self-hosted server on {server}", file=sys.stderr)
+    try:
+        out = run_load(server, args.clients, args.duration,
+                       args.repeat_frac, cache=not args.no_cache,
+                       seed=args.seed)
+    finally:
+        if srv is not None:
+            srv.stop()
+    print(json.dumps(out, sort_keys=True))
+    return 1 if out["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
