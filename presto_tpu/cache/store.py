@@ -35,10 +35,11 @@ admitted (one oversized result must not flush the whole working set).
 from __future__ import annotations
 
 import sys
-import threading
 import time
 from collections import OrderedDict
 from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from presto_tpu.obs.sanitizer import make_lock, register_owner
 
 DEFAULT_BUDGET_BYTES = 1 << 28  # 256 MiB host-resident
 _DISK_BUDGET_FACTOR = 4
@@ -86,9 +87,14 @@ class ResultCache:
     and system.metrics surfaces render these, while EXPLAIN ANALYZE
     renders the querying executor's own counts."""
 
+    # lock discipline (tools/lint `locks` rule): everything the
+    # concurrent per-query runners mutate through one shared instance
+    _shared_attrs = ("_entries", "budget_bytes", "ttl_ms", "spill_dir",
+                     "hits", "misses", "evictions", "invalidations")
+
     def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES,
                  ttl_ms: int = 0, spill_dir: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.store.ResultCache._lock")
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self.budget_bytes = int(budget_bytes) or DEFAULT_BUDGET_BYTES
         self.ttl_ms = int(ttl_ms)
@@ -98,6 +104,7 @@ class ResultCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0
+        register_owner(self)
 
     # ------------------------------------------------------ configure
     def configure(self, budget_bytes: Optional[int] = None,
@@ -113,7 +120,7 @@ class ResultCache:
                 self.ttl_ms = int(ttl_ms)
             if spill_dir is not None:
                 self.spill_dir = spill_dir or None
-            self._maintain()
+            self._maintain_locked()
 
     # ----------------------------------------------------- inspection
     def counters(self) -> Dict[str, int]:
@@ -174,7 +181,7 @@ class ResultCache:
                 key, "pages", store.bytes, frozenset(tables),
                 time.monotonic(), store=store,
             )
-            return self._maintain()
+            return self._maintain_locked()
 
     # ------------------------------------------------------ rows kind
     def get_rows(self, key: str):
@@ -201,7 +208,7 @@ class ResultCache:
                 time.monotonic(),
                 payload=(list(names), list(rows), list(types)),
             )
-            return self._maintain()
+            return self._maintain_locked()
 
     # --------------------------------------------------- invalidation
     def invalidate_tables(self, tables) -> int:
@@ -245,7 +252,7 @@ class ResultCache:
             return None
         return e
 
-    def _maintain(self) -> int:
+    def _maintain_locked(self) -> int:
         """Enforce the budgets (caller holds the lock): demote LRU
         host-resident page entries to the disk tier past the resident
         budget, evict LRU entries outright past the disk factor.
@@ -262,8 +269,12 @@ class ResultCache:
                 if e.kind != "pages" or e.on_disk:
                     continue  # rows entries evict below, never demote
                 disk = PageStore(tier="disk", spill_dir=self.spill_dir)
+                # put_host, not put: the pages are already host pytrees
+                # and this runs under self._lock — a jax.device_get
+                # here would serialize every cache reader behind a
+                # device sync (the concheck blocking-under-lock find)
                 for p in e.store.host_pages():
-                    disk.put(p)
+                    disk.put_host(p)
                 e.store.close()
                 e.store = disk
                 resident -= e.nbytes
@@ -286,7 +297,7 @@ class ResultCache:
 
 
 # ------------------------------------------------- the shared instance
-_shared_lock = threading.Lock()
+_shared_lock = make_lock("cache.store._shared_lock")
 _shared: Optional[ResultCache] = None
 
 

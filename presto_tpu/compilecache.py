@@ -26,8 +26,9 @@ window they land in — same caveat as every process-wide metric.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Dict, List, Optional
+
+from presto_tpu.obs.sanitizer import make_lock
 
 # NOTE on jax's event semantics (verified on 0.4.37): the
 # backend_compile_duration event wraps compile_or_get_cached, so it
@@ -40,7 +41,7 @@ _CACHE_RETRIEVAL = "/jax/compilation_cache/cache_retrieval_time_sec"
 _CACHE_HIT = "/jax/compilation_cache/cache_hits"
 _CACHE_MISS = "/jax/compilation_cache/cache_misses"
 
-_lock = threading.Lock()
+_lock = make_lock("compilecache._lock")
 _raw: Dict[str, float] = {
     "requests": 0,
     "request_wall_s": 0.0,

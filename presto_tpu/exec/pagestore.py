@@ -147,6 +147,25 @@ class PageStore:
         else:
             self._pages.append(page)
 
+    def put_host(self, host_page) -> None:
+        """Append an ALREADY-HOST page pytree with no device-sync API
+        in the path (put() calls jax.device_get even on host inputs).
+        The result-cache demotion plane runs under the store's lock —
+        concheck's blocking-under-lock rule is why this exists: moving
+        host_pages() output between tiers must never touch the device."""
+        from presto_tpu.exec.executor import page_bytes
+
+        self.bytes += page_bytes(host_page)
+        self.page_count += 1
+        if self.tier == "disk":
+            leaves, treedef = jax.tree_util.tree_flatten(host_page)
+            path = os.path.join(self._dir, f"p{self.page_count}.npz")
+            np.savez(path, **{f"a{i}": leaf
+                              for i, leaf in enumerate(leaves)})
+            self._pages.append((path, treedef, len(leaves)))
+        else:
+            self._pages.append(host_page)
+
     # ---------------------------------------------------- byte plane
     # The spooled-exchange tier (dist/scheduler.py) stores SERIALIZED
     # pages — the worker's wire blobs — through the same host/disk

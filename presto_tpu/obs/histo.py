@@ -16,8 +16,9 @@ mergeable by simple addition.
 from __future__ import annotations
 
 import bisect
-import threading
 from typing import List, Sequence
+
+from presto_tpu.obs.sanitizer import make_lock, register_owner
 
 # seconds; geometric ~2.5x ladder from 1ms to 600s
 DEFAULT_BOUNDS: Sequence[float] = (
@@ -29,6 +30,10 @@ DEFAULT_BOUNDS: Sequence[float] = (
 class Histogram:
     """Thread-safe fixed-bucket histogram of seconds."""
 
+    # lock discipline (tools/lint `locks` rule): observation state
+    # shared between completion paths and /metrics scrapes
+    _shared_attrs = ("counts", "total", "sum")
+
     def __init__(self, bounds: Sequence[float] = DEFAULT_BOUNDS):
         self.bounds: List[float] = sorted(float(b) for b in bounds)
         # counts[i] = observations <= bounds[i] exclusive-bucket form;
@@ -36,7 +41,8 @@ class Histogram:
         self.counts: List[int] = [0] * (len(self.bounds) + 1)
         self.total = 0
         self.sum = 0.0
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.histo.Histogram._lock")
+        register_owner(self)
 
     def observe(self, seconds: float) -> None:
         v = max(float(seconds), 0.0)

@@ -26,8 +26,9 @@ import dataclasses
 import hashlib
 import json
 import os
-import threading
 from typing import Dict, Optional
+
+from presto_tpu.obs.sanitizer import make_lock, register_owner
 
 
 def structural_encode(x, scan_token=None):
@@ -93,24 +94,35 @@ class ProfileStore:
     `ProfileStore.at(dir)` shares one instance per directory per
     process so concurrent per-query runners reuse the cache."""
 
+    # lock discipline (tools/lint `locks` rule): the in-memory profile
+    # cache is shared across the concurrent per-query runners
+    _shared_attrs = ("_cache",)
+
     _instances: Dict[str, "ProfileStore"] = {}
-    _instances_lock = threading.Lock()
+    _instances_lock = make_lock(
+        "obs.profile.ProfileStore._instances_lock")
 
     def __init__(self, directory: str):
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
         self._cache: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.profile.ProfileStore._lock")
+        register_owner(self)
 
     @classmethod
     def at(cls, directory: str) -> "ProfileStore":
         directory = os.path.abspath(directory)
         with cls._instances_lock:
             store = cls._instances.get(directory)
-            if store is None:
-                store = cls(directory)
-                cls._instances[directory] = store
+        if store is not None:
             return store
+        # construct OUTSIDE the instance-map lock: __init__ touches the
+        # filesystem (makedirs), which must not stall every other
+        # directory's lookup behind one slow mount. Racing creators
+        # both build; the map insert below picks one winner.
+        store = cls(directory)
+        with cls._instances_lock:
+            return cls._instances.setdefault(directory, store)
 
     def key(self, plan, catalogs) -> str:
         return plan_fingerprint(plan, catalogs)

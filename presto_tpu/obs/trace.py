@@ -25,9 +25,10 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import threading
 import time
 from typing import Dict, List, Optional
+
+from presto_tpu.obs.sanitizer import make_lock, register_owner
 
 
 @dataclasses.dataclass
@@ -52,6 +53,10 @@ class QueryTrace:
     """One query's span tree. Thread-safe (worker status polls and the
     scheduler's dispatch loop record concurrently); reads snapshot."""
 
+    # lock discipline (tools/lint `locks` rule): the span list and its
+    # sequence counter are the shared recording surface
+    _shared_attrs = ("_spans", "_seq")
+
     def __init__(self, query_id: str, sql: Optional[str] = None,
                  anchor_mono: Optional[float] = None,
                  anchor_wall: Optional[float] = None):
@@ -62,11 +67,12 @@ class QueryTrace:
                             else anchor_wall)
         self._anchor_mono = (time.monotonic() if anchor_mono is None
                              else anchor_mono)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.trace.QueryTrace._lock")
         self._spans: List[Span] = []
         self._seq = 0
         attrs = {"sql": sql} if sql else {}
         self.root = self._new("query", query_id, None, 0.0, None, attrs)
+        register_owner(self)
 
     # ------------------------------------------------------- recording
     def now(self) -> float:

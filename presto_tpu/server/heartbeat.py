@@ -22,6 +22,8 @@ import urllib.error
 import urllib.request
 from typing import Dict, List
 
+from presto_tpu.obs.sanitizer import make_lock, register_owner
+
 
 @dataclasses.dataclass
 class NodeHealth:
@@ -71,7 +73,9 @@ class HeartbeatFailureDetector:
         }
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        self._lock = make_lock(
+            "server.heartbeat.HeartbeatFailureDetector._lock")
+        register_owner(self)
 
     # ------------------------------------------------------------ control
     def start(self) -> None:

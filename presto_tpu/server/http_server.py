@@ -25,6 +25,11 @@ from typing import Dict, List, Optional
 from urllib.parse import urlparse
 
 from presto_tpu import types as T
+from presto_tpu.obs.sanitizer import (
+    make_condition,
+    make_lock,
+    register_owner,
+)
 from presto_tpu.session import SYSTEM_SESSION_PROPERTIES, Session
 
 _PAGE_ROWS = 4096  # rows per protocol fetch (client paging granularity)
@@ -87,11 +92,17 @@ class MemoryArbiter:
     progress is guaranteed, concurrency degrades to serial exactly
     when memory demands it (the reference's reserved-pool promotion)."""
 
+    # lock discipline (tools/lint `locks` rule): the reservation
+    # tallies every query's admission thread contends on
+    _shared_attrs = ("used", "active")
+
     def __init__(self, total_bytes: int):
         self.total = int(total_bytes)
         self.used = 0
         self.active = 0
-        self._cv = threading.Condition()
+        self._cv = make_condition(
+            "server.http_server.MemoryArbiter._cv")
+        register_owner(self, lock_attrs=("_cv",))
 
     def acquire(self, est: int, should_abort=None) -> bool:
         with self._cv:
@@ -146,9 +157,11 @@ class QueryManager:
         self._runner_factory = runner_factory
         self._queries: Dict[str, _Query] = {}
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock(
+            "server.http_server.QueryManager._lock")
         # serial fallback when no arbiter is configured
-        self._exec_lock = threading.Lock()
+        self._exec_lock = make_lock(
+            "server.http_server.QueryManager._exec_lock")
         self.memory = memory_arbiter
         self.listeners = list(listeners)
         # swallowed-listener-exception sink (events.dispatch on_error
@@ -168,6 +181,7 @@ class QueryManager:
         # (the surface ROADMAP item 1's load benchmark reads)
         self.latency_histo = Histogram()
         self.stage_histo = Histogram()
+        register_owner(self)
 
     def submit(self, sql: str, session: Session) -> _Query:
         from presto_tpu import events as E
@@ -244,12 +258,15 @@ class QueryManager:
                 self._record_completion(q)
                 return
         try:
-            self._run_locked(q)
+            self._run_admitted(q)
         finally:
             if group is not None:
                 self.resource_groups.release(group)
 
-    def _run_locked(self, q: _Query) -> None:
+    # NB: not named `*_locked` — that suffix is the machine-checked
+    # caller-holds-the-lock convention (tools/concheck.py); this
+    # method ACQUIRES the execution lock/arbiter itself
+    def _run_admitted(self, q: _Query) -> None:
         if self.memory is None:
             with self._exec_lock:
                 self._execute(q)

@@ -20,8 +20,13 @@ from __future__ import annotations
 
 import dataclasses
 import re
-import threading
 from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.obs.sanitizer import (
+    make_condition,
+    make_lock,
+    register_owner,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,10 +70,15 @@ class ResourceGroupManager:
     holds a queue slot, then a concurrency slot, then (optionally)
     reserved memory at EVERY level of its path."""
 
+    # lock discipline (tools/lint `locks` rule): the per-path slot/
+    # queue/memory tallies shared across every query's admission thread
+    _shared_attrs = ("_running", "_queued", "_memory")
+
     def __init__(self, groups: Optional[List[ResourceGroupSpec]] = None):
         self.groups = list(groups or [ResourceGroupSpec("global")])
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = make_lock(
+            "server.resource_groups.ResourceGroupManager._lock")
+        self._cv = make_condition(lock=self._lock)
         self._running: Dict[str, int] = {}
         self._queued: Dict[str, int] = {}
         self._memory: Dict[str, int] = {}
@@ -85,6 +95,7 @@ class ResourceGroupManager:
 
         for g in self.groups:
             walk(g, "")
+        register_owner(self)
 
     # ---------------------------------------------------------- selection
     def select(self, user: str) -> GroupSelection:

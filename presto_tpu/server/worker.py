@@ -69,6 +69,8 @@ from typing import Dict, List, Optional
 from presto_tpu.connectors.split_filter import SplitFilterConnector
 from presto_tpu.dist import serde
 from presto_tpu.exec import plan as P
+from presto_tpu.obs import sanitizer as SAN
+from presto_tpu.obs.sanitizer import make_lock, register_owner
 from presto_tpu.session import Session
 
 
@@ -159,6 +161,14 @@ class _TaskSpool:
 
 
 class _Task:
+    # lock discipline (tools/lint `locks` rule): lifecycle flags and
+    # result buffers shared between the execution thread and the
+    # fetch/status/cancel handlers — written under self.lock (the
+    # writes live in TaskRuntime/route_* but the contract is the
+    # task's; the runtime sanitizer enforces it per instance)
+    _shared_attrs = ("pages", "spool", "done", "error", "cancelled",
+                     "spans")
+
     def __init__(self, task_id: str):
         self.task_id = task_id
         self.pages: List[bytes] = []
@@ -166,7 +176,7 @@ class _Task:
         self.done = False
         self.error: Optional[str] = None
         self.cancelled = False
-        self.lock = threading.Lock()
+        self.lock = make_lock("server.worker._Task.lock")
         # lifecycle tracing (ISSUE 9): interval math on monotonic,
         # ONE wall anchor for cross-node correlation — the span
         # timing-source rule (obs/trace.py docstring)
@@ -176,6 +186,7 @@ class _Task:
         # from created_mono and shipped to the coordinator on the
         # status plane so it can assemble one cross-node timeline
         self.spans: Optional[List[Dict]] = None
+        register_owner(self, lock_attrs=("lock",))
 
     # --------- unified read surface (legacy byte list OR spool tiers)
     def part_count(self, part: int) -> int:
@@ -467,7 +478,7 @@ def route_task_get(app, path: str, query: str):
     # /v1/task/{id}/results/{token}[?part=p]
     if len(parts) == 5 and parts[:2] == ["v1", "task"] \
             and parts[3] == "results":
-        task = app.tasks.get(parts[2])
+        task = app.get_task(parts[2])
         if task is None:
             return _jresp({"error": "no such task"}, 404)
         token = int(parts[4])
@@ -520,7 +531,7 @@ def route_task_get(app, path: str, query: str):
             time.sleep(0.02)
         return (204, [("X-Done", "0")], _JSON_CT, b"")
     if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-        task = app.tasks.get(parts[2])
+        task = app.get_task(parts[2])
         if task is None:
             return _jresp({"error": "no such task"}, 404)
         with task.lock:
@@ -552,7 +563,7 @@ def route_task_delete(app, path: str):
     # can return exchange memory before the whole task expires
     if len(parts) == 5 and parts[:2] == ["v1", "task"] \
             and parts[3] == "spool":
-        task = app.tasks.get(parts[2])
+        task = app.get_task(parts[2])
         if task is None:
             return _jresp({"error": "no such task"}, 404)
         with task.lock:
@@ -564,10 +575,12 @@ def route_task_delete(app, path: str):
                            "state": "RELEASED"})
         return _jresp({"error": "no such spool partition"}, 404)
     if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-        task = app.tasks.pop(parts[2], None)
+        task = app.pop_task(parts[2])
         if task is not None:
-            task.cancelled = True
             with task.lock:
+                # under the task lock like every other lifecycle-flag
+                # write (the execution thread polls it between pages)
+                task.cancelled = True
                 task.free()  # page buffers + spool tiers
             return _jresp({"taskId": task.task_id,
                            "state": "CANCELED"})
@@ -599,13 +612,19 @@ class _WorkerHandler(BaseHTTPRequestHandler):
 
         split = urlsplit(self.path)
         if split.path.startswith("/v1/info"):
-            self._write(_jresp({
+            info = {
                 "nodeId": self.app.node_id,
                 "state": "ACTIVE",
                 "uptime_s": round(
                     time.monotonic() - self.app.started_mono, 1),
-                "tasks": len(self.app.tasks),
-            }))
+                "tasks": self.app.task_count(),
+            }
+            if SAN.is_armed():
+                # sanitized-mode surface: tools/chaos.py --sanitize
+                # polls each worker's violation count at the end of a
+                # run (the worker process has no other reporting plane)
+                info["sanitizerViolations"] = SAN.violation_count()
+            self._write(_jresp(info))
             return
         resp = route_task_get(self.app, split.path, split.query)
         self._write(resp if resp is not None
@@ -624,6 +643,13 @@ class TaskRuntime:
     coordinator server (http_server.py) embeds one directly so a
     single process can serve both roles."""
 
+    # lock discipline (tools/lint `locks` rule): the task registry is
+    # mutated by HTTP handler threads (create/cancel) while status/
+    # fetch handlers and expiry sweeps read it — guarded by
+    # _tasks_lock; the fault overlay + its call counters by _fault_lock
+    _shared_attrs = ("tasks", "fault_config", "_results_calls",
+                     "_submit_calls")
+
     def __init__(self, catalogs, *, node_id: str = "w0",
                  default_catalog: Optional[str] = None,
                  page_rows: int = 1 << 16):
@@ -632,11 +658,14 @@ class TaskRuntime:
         self.default_catalog = default_catalog
         self.page_rows = page_rows
         self.tasks: Dict[str, _Task] = {}
+        self._tasks_lock = make_lock(
+            "server.worker.TaskRuntime._tasks_lock")
         self.started = time.time()
         # uptime arithmetic runs on monotonic (the wall `started` is
         # display/correlation only — timing-source audit, ISSUE 9)
         self.started_mono = time.monotonic()
-        self._fault_lock = threading.Lock()
+        self._fault_lock = make_lock(
+            "server.worker.TaskRuntime._fault_lock")
         self._results_calls = 0
         self._submit_calls = 0
         # runtime-settable fault injection (POST /v1/fault): posted
@@ -645,6 +674,26 @@ class TaskRuntime:
         # so `{}` restores env-ruled mode — the overlay is never
         # one-way
         self.fault_config: Dict[str, int] = {}
+        register_owner(self, lock_attrs=("_tasks_lock", "_fault_lock"))
+
+    # ------------------------------------------------- task registry
+    # The locked read/write surface: handler threads, task threads,
+    # and expiry sweeps all go through these (the bare dict used to be
+    # mutated from ThreadingHTTPServer handler threads while
+    # create_task's expiry sweep iterated it — the unlocked-shared-
+    # write shape this PR's concurrency pass exists to catch).
+
+    def get_task(self, task_id: str) -> Optional[_Task]:
+        with self._tasks_lock:
+            return self.tasks.get(task_id)
+
+    def pop_task(self, task_id: str) -> Optional[_Task]:
+        with self._tasks_lock:
+            return self.tasks.pop(task_id, None)
+
+    def task_count(self) -> int:
+        with self._tasks_lock:
+            return len(self.tasks)
 
     # -------------------------------------------------- fault injection
     def set_fault_config(self, cfg: Dict[str, int]) -> None:
@@ -705,21 +754,29 @@ class TaskRuntime:
 
     def create_task(self, req: Dict) -> _Task:
         # expire oldest finished tasks (reference: SqlTaskManager task
-        # expiry) so a long-lived worker's page buffers are bounded
-        for pool, cap in (
-            ([tid for tid, t in self.tasks.items()
-              if t.done and t.spool is None], self.MAX_RETAINED_TASKS),
-            ([tid for tid, t in self.tasks.items()
-              if t.done and t.spool is not None],
-             self.MAX_RETAINED_SPOOLED),
-        ):
-            while len(pool) > cap:
-                old = self.tasks.pop(pool.pop(0), None)
-                if old is not None:
-                    with old.lock:
-                        old.free()
-        task = _Task(req.get("taskId") or f"t{len(self.tasks)}")
-        self.tasks[task.task_id] = task
+        # expiry) so a long-lived worker's page buffers are bounded.
+        # Registry mutation happens under _tasks_lock (handler threads
+        # create concurrently); the evictees' buffer frees happen
+        # OUTSIDE it so spool-file cleanup never stalls task lookups.
+        doomed: List[_Task] = []
+        with self._tasks_lock:
+            for pool, cap in (
+                ([tid for tid, t in self.tasks.items()
+                  if t.done and t.spool is None],
+                 self.MAX_RETAINED_TASKS),
+                ([tid for tid, t in self.tasks.items()
+                  if t.done and t.spool is not None],
+                 self.MAX_RETAINED_SPOOLED),
+            ):
+                while len(pool) > cap:
+                    old = self.tasks.pop(pool.pop(0), None)
+                    if old is not None:
+                        doomed.append(old)
+            task = _Task(req.get("taskId") or f"t{len(self.tasks)}")
+            self.tasks[task.task_id] = task
+        for old in doomed:
+            with old.lock:
+                old.free()
         t = threading.Thread(target=self._run_task, args=(task, req),
                              daemon=True)
         t.start()

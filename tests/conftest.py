@@ -10,6 +10,15 @@ Env vars MUST be set before jax is imported anywhere.
 
 import os
 
+# Arm the lock sanitizer (presto_tpu/obs/sanitizer.py) for the whole
+# suite BEFORE any engine module creates a lock: every engine lock
+# created under pytest is instrumented (held-set tracking, ordering,
+# shared-attr write checks). Violations accumulate process-wide and
+# never fail a test by themselves — tests/test_concurrent_serving.py
+# races the serving path deliberately and asserts the count stays 0.
+# Export PRESTO_TPU_LOCK_SANITIZER=0 to opt out.
+os.environ.setdefault("PRESTO_TPU_LOCK_SANITIZER", "1")
+
 # force CPU even if the ambient env targets a real TPU (axon tunnel)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")

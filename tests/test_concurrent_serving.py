@@ -1,0 +1,111 @@
+"""ISSUE 11 satellite: the concurrent serving path as a gated
+invariant — N protocol clients x the CONCURRENT QueryManager path
+(memory arbiter on, per-query runners) x the process-shared result
+cache x the armed lock sanitizer, raced deliberately in tier-1.
+
+This is ROADMAP item 1(d)'s "result cache on by default for the
+server" prerequisite turned into a test: before the cache can default
+on, concurrent clients hammering the shared store must produce
+IDENTICAL rows per statement and ZERO sanitizer violations (no
+lock-order inversion, no unlocked shared-attr write anywhere in the
+engine while the race runs). tools/loadbench.py --sanitize is the
+same gate at benchmark scale.
+"""
+
+import threading
+
+import pytest
+
+from presto_tpu.obs import sanitizer as SAN
+
+CLIENTS = 8
+ROUNDS = 3
+
+# small repeated deck (dashboard shape): after each statement's first
+# execution the rest should collapse onto the shared result cache —
+# which is exactly the cross-thread traffic being raced
+STATEMENTS = (
+    "select count(*), sum(n_nationkey) from nation",
+    "select r_name, count(*) from region group by r_name "
+    "order by r_name",
+    "select n_regionkey, count(*), max(n_name) from nation "
+    "group by n_regionkey order by n_regionkey",
+)
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.server.http_server import PrestoTpuServer
+
+    # memory arbiter on => the CONCURRENT path: every query gets its
+    # own runner/executor; the result-cache store, jit cache, views,
+    # and histograms are the process-shared surfaces under race
+    srv = PrestoTpuServer(
+        {"tpch": TpchConnector(scale=0.01)},
+        port=0, memory_budget_bytes=1 << 32,
+    )
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.stop()
+
+
+def test_concurrent_clients_cache_on_zero_sanitizer_violations(
+        server_url):
+    if not SAN.is_armed():
+        pytest.skip("sanitizer disarmed via PRESTO_TPU_LOCK_SANITIZER")
+    from presto_tpu.client import StatementClient
+
+    SAN.reset()
+    results = [[] for _ in range(CLIENTS)]
+    errors = []
+
+    def client(idx: int) -> None:
+        cl = StatementClient(server_url, user=f"race{idx}",
+                             catalog="tpch")
+        cl.session_properties["result_cache_enabled"] = "true"
+        for _ in range(ROUNDS):
+            for sql in STATEMENTS:
+                try:
+                    res = cl.execute(sql)
+                except Exception as e:  # noqa: BLE001 - the assertion
+                    errors.append(repr(e))  # below reports transport
+                    continue  # failures with full context
+                if res.error is not None:
+                    errors.append(str(res.error))
+                else:
+                    results[idx].append(
+                        (sql, tuple(map(tuple, res.rows))))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "client hung"
+    assert not errors, errors[:5]
+
+    # every client saw every statement every round...
+    for idx in range(CLIENTS):
+        assert len(results[idx]) == ROUNDS * len(STATEMENTS)
+    # ...and all of them identical rows (a cache serving one client a
+    # torn/stale page set would diverge here)
+    by_sql = {}
+    for idx in range(CLIENTS):
+        for sql, rows in results[idx]:
+            by_sql.setdefault(sql, set()).add(rows)
+    for sql, variants in by_sql.items():
+        assert len(variants) == 1, \
+            f"divergent rows across clients for {sql!r}"
+
+    # the cache actually engaged across the race (the point of the
+    # exercise: hits ARE the contended path)
+    from presto_tpu.cache import shared_cache_if_exists
+
+    rc = shared_cache_if_exists()
+    assert rc is not None and rc.hits > 0
+
+    # and the armed sanitizer observed ZERO violations anywhere in
+    # the engine while 8 threads raced it
+    assert SAN.violation_count() == 0, SAN.report()

@@ -1,0 +1,263 @@
+"""ISSUE 11: the runtime lock sanitizer (presto_tpu/obs/sanitizer.py).
+
+Each capability is pinned by a deliberately-misbehaving synthetic
+owner: ordering inversions, re-entrant acquisition, unlocked
+shared-attr writes, Condition integration, and the zero-cost off
+path. The conftest arms the sanitizer suite-wide; these tests manage
+the armed state explicitly so they pass standalone too.
+"""
+
+import threading
+
+import pytest
+
+from presto_tpu.obs import sanitizer as SAN
+
+
+@pytest.fixture
+def armed():
+    """Armed sanitizer with clean state; restores prior arming."""
+    was = SAN.is_armed()
+    SAN.arm()
+    SAN.reset()
+    yield SAN
+    SAN.reset()
+    if not was:
+        SAN.disarm()
+
+
+# ----------------------------------------------------------- off path
+
+
+def test_disarmed_returns_plain_primitives():
+    was = SAN.is_armed()
+    SAN.disarm()
+    try:
+        lk = SAN.make_lock("x.y.z")
+        assert isinstance(lk, type(threading.Lock()))
+        cv = SAN.make_condition("x.y.cv")
+        assert isinstance(cv, threading.Condition)
+
+        class Plain:
+            _shared_attrs = ("n",)
+
+            def __init__(self):
+                self._lock = SAN.make_lock("x.Plain._lock")
+                self.n = 0
+                SAN.register_owner(self)
+
+        p = Plain()
+        assert type(p) is Plain  # no class swap when off
+        p.n = 5  # unchecked when off
+        assert SAN.violation_count() == 0
+    finally:
+        if was:
+            SAN.arm()
+
+
+# ------------------------------------------------------ held/ordering
+
+
+def test_ordering_recorded_and_inversion_detected(armed):
+    a = SAN.make_lock("t.A")
+    b = SAN.make_lock("t.B")
+    with a:
+        with b:
+            pass
+    assert ("t.A", "t.B") in SAN.order_edges()
+    assert SAN.violation_count() == 0
+    with b:
+        with a:  # the opposite order: classic deadlock shape
+            pass
+    v = SAN.violations()
+    assert len(v) == 1 and "lock-order inversion" in v[0]
+    assert "t.A" in v[0] and "t.B" in v[0]
+    # both sites are named so the report is actionable
+    assert "test_sanitizer.py" in v[0]
+
+
+def test_consistent_order_is_silent(armed):
+    a = SAN.make_lock("t.A")
+    b = SAN.make_lock("t.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert SAN.violation_count() == 0
+
+
+def test_reentrant_acquire_raises_instead_of_deadlocking(armed):
+    a = SAN.make_lock("t.R")
+    with a:
+        with pytest.raises(RuntimeError, match="re-entrant"):
+            a.acquire()
+    assert any("re-entrant" in v for v in SAN.violations())
+    # the lock recovered: a fresh acquire works
+    with a:
+        pass
+
+
+def test_release_clears_held_set(armed):
+    a = SAN.make_lock("t.H")
+    with a:
+        assert a.held_by_me()
+    assert not a.held_by_me()
+
+
+# ------------------------------------------------- shared-attr checks
+
+
+class _Owner:
+    _shared_attrs = ("n",)
+
+    def __init__(self):
+        self._lock = SAN.make_lock("t.Owner._lock")
+        self.n = 0
+        SAN.register_owner(self)
+
+    def bump_locked(self):
+        with self._lock:
+            self.n += 1
+
+    def bump_racy(self):
+        self.n += 1
+
+
+def test_unlocked_shared_write_detected(armed):
+    o = _Owner()
+    o.bump_locked()
+    assert SAN.violation_count() == 0
+    o.bump_racy()
+    v = SAN.violations()
+    assert len(v) == 1 and "unlocked shared-attr write" in v[0]
+    assert ".n" in v[0] and "t.Owner._lock" in v[0]
+    assert o.n == 2  # the write itself still lands
+
+
+def test_instrumented_class_keeps_name_and_isinstance(armed):
+    o = _Owner()
+    assert type(o).__name__ == "_Owner"
+    assert isinstance(o, _Owner)
+
+
+def test_unshared_attrs_are_not_checked(armed):
+    o = _Owner()
+    o.other = 7  # not in _shared_attrs: free to write anywhere
+    assert SAN.violation_count() == 0
+
+
+def test_multi_lock_owner_any_lock_satisfies(armed):
+    """The TaskRuntime shape: several locks, a write under ANY of the
+    registered ones passes (domain split is documented, not checked)."""
+
+    class Two:
+        _shared_attrs = ("x",)
+
+        def __init__(self):
+            self._a_lock = SAN.make_lock("t.Two._a_lock")
+            self._b_lock = SAN.make_lock("t.Two._b_lock")
+            self.x = 0
+            SAN.register_owner(self, lock_attrs=("_a_lock", "_b_lock"))
+
+    t = Two()
+    with t._b_lock:
+        t.x = 1
+    assert SAN.violation_count() == 0
+    t.x = 2
+    assert SAN.violation_count() == 1
+
+
+# -------------------------------------------------------- Conditions
+
+
+def test_condition_fronts_sanitized_lock(armed):
+    """make_condition integrates with threading.Condition: holding the
+    Condition IS holding the backing sanitized lock, wait() keeps the
+    held-set honest, and notify paths see ownership correctly."""
+
+    class Arbiter:
+        _shared_attrs = ("used",)
+
+        def __init__(self):
+            self._cv = SAN.make_condition("t.Arbiter._cv")
+            self.used = 0
+            SAN.register_owner(self, lock_attrs=("_cv",))
+
+    a = Arbiter()
+    with a._cv:
+        a.used += 1       # under the condition's lock: clean
+        a._cv.wait(0.01)  # releases + reacquires through the wrapper
+        a.used += 1       # still owned after wait
+        a._cv.notify_all()
+    assert SAN.violation_count() == 0
+    a.used = 0
+    assert SAN.violation_count() == 1
+
+
+def test_condition_alias_unifies_held_set(armed):
+    """The ResourceGroupManager shape: a Condition built over an
+    existing lock — acquiring either names the same lock."""
+    lk = SAN.make_lock("t.Alias._lock")
+    cv = SAN.make_condition(lock=lk)
+    with cv:
+        assert lk.held_by_me()
+    assert not lk.held_by_me()
+
+
+# ------------------------------------------------ cross-thread races
+
+
+def test_real_two_thread_race_is_caught(armed):
+    """The dynamic side earns its keep: a racy writer thread hammering
+    an owner without the lock is observed as violations (not a crash,
+    not silence)."""
+    o = _Owner()
+    stop = threading.Event()
+
+    def racer():
+        while not stop.is_set():
+            o.bump_racy()
+
+    t = threading.Thread(target=racer, daemon=True)
+    t.start()
+    for _ in range(50):
+        o.bump_locked()
+    stop.set()
+    t.join(timeout=5)
+    assert SAN.violation_count() > 0
+
+
+def test_profile_store_instance_map_race_single_winner(tmp_path):
+    """Pin the ISSUE-11 ProfileStore.at fix: construction happens
+    OUTSIDE the class instance-map lock (no filesystem work under it),
+    and racing lookups still converge on ONE shared instance."""
+    from presto_tpu.obs.profile import ProfileStore
+
+    d = str(tmp_path / "profiles")
+    got = []
+
+    def lookup():
+        got.append(ProfileStore.at(d))
+
+    threads = [threading.Thread(target=lookup) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(got) == 8
+    assert all(s is got[0] for s in got), \
+        "racing ProfileStore.at() returned different instances"
+
+
+def test_engine_locks_are_instrumented_under_pytest():
+    """The conftest arming reached the engine: a freshly built
+    ResultCache (created AFTER arming) carries sanitized locks, so the
+    serving-path stress test is actually exercising instrumentation."""
+    if not SAN.is_armed():
+        pytest.skip("sanitizer disarmed via PRESTO_TPU_LOCK_SANITIZER")
+    from presto_tpu.cache.store import ResultCache
+
+    rc = ResultCache(budget_bytes=1 << 20)
+    assert isinstance(rc._lock, SAN._SanitizedLock)
+    assert type(rc).__name__ == "ResultCache"
+    assert getattr(type(rc), "_san_instrumented", False)

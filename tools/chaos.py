@@ -20,9 +20,16 @@ contract — a worker killed while serving spool fetches mid-DAG must
 recover via spooled NON-LEAF replay (`--mode kill-nonleaf` exits
 nonzero if no nonleaf_replays were recorded across the run).
 
+``--sanitize`` (ISSUE 11) arms the runtime lock sanitizer
+(presto_tpu/obs/sanitizer.py) in the coordinator AND every worker
+subprocess (via the environment), so randomized fault schedules also
+race the instrumented locks; the run fails if any process observed a
+lock-order inversion or unlocked shared-attr write (workers report
+their count on /v1/info).
+
 Usage: chaos.py [--iterations 20] [--seed 0] [--scale 0.01]
                 [--workers 2] [--deadline-ms 180000]
-                [--mode kill-nonleaf]
+                [--mode kill-nonleaf] [--sanitize]
 """
 
 import argparse
@@ -152,7 +159,21 @@ def main() -> int:
                     help="pin every iteration to one fault mode "
                     "(kill-nonleaf additionally requires at least "
                     "one nonleaf_replay across the run)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="arm the runtime lock sanitizer in the "
+                    "coordinator and every worker; fail on any "
+                    "observed violation")
     args = ap.parse_args()
+
+    san = None
+    if args.sanitize:
+        # before ANY presto_tpu import creates a lock, here and (via
+        # the inherited environment) in every worker subprocess
+        os.environ["PRESTO_TPU_LOCK_SANITIZER"] = "1"
+        from presto_tpu.obs import sanitizer as san
+
+        san.arm()
+        san.reset()
 
     from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.dist.dcn import DcnRunner
@@ -186,6 +207,34 @@ def main() -> int:
     ex = coord.runner.executor
 
     failures = 0
+    worker_violations = 0
+    _seen_violations = {}  # worker -> count at last successful poll
+
+    def poll_worker_violations() -> None:
+        """Accumulate sanitizer violations from live workers' /v1/info.
+        A killed worker's in-process list dies with it, so this runs
+        EVERY iteration (before the next fault schedule can kill
+        anyone) — a kill loses at most one iteration's window, not the
+        whole run's. Per-worker deltas: a count that went DOWN means
+        the worker rebooted (fresh process), so the new count adds in
+        full instead of being masked by the old high-water mark."""
+        nonlocal worker_violations
+        import http.client
+
+        for w in workers:
+            if not w.alive():
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"{w.uri}/v1/info", timeout=5) as r:
+                    n = int(json.load(r).get(
+                        "sanitizerViolations", 0) or 0)
+            except (OSError, ValueError, http.client.HTTPException):
+                continue  # dying mid-response: retry next iteration
+            last = _seen_violations.get(w, 0)
+            worker_violations += n - last if n >= last else n
+            _seen_violations[w] = n
+
     try:
         for i in range(args.iterations):
             mode = args.mode or rng.choice(FAULT_MODES)
@@ -196,6 +245,8 @@ def main() -> int:
                      else rng.choice(sorted(matrix)))
             for w in workers:
                 w.ensure()
+            if san is not None:
+                poll_worker_violations()
             victim = rng.choice(workers)
             config = {
                 "none": {},
@@ -232,6 +283,17 @@ def main() -> int:
                   f"+{ex.nonleaf_replays - nonleaf0} dist="
                   f"{coord.last_distribution}: {status}", flush=True)
     finally:
+        if san is not None:
+            # final poll before teardown picks up the last iteration's
+            # window (the per-iteration polls covered everything else)
+            poll_worker_violations()
+            if worker_violations:
+                print(f"# chaos: workers recorded {worker_violations} "
+                      f"sanitizer violation(s) across the run")
+                failures += worker_violations
+            if san.violation_count():
+                print(san.report())
+                failures += san.violation_count()
         coord.close()
         for w in workers:
             w.kill()

@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
-# Pre-PR static gate (ISSUE 6): the engine-invariant linter plus the
-# full plan audit (bench rungs + TPC-H/TPC-DS corpus, strict mode).
-# Pure host Python — nothing compiles or touches a device — so the
-# whole gate runs in well under 60 s on the 2-core box. bench.py
-# --prewarm runs the same plan verifier per rung before compiling.
+# Pre-PR static gate (ISSUE 6 + ISSUE 11): the engine-invariant
+# linter, the concurrency soundness pass (lock registry + acquisition
+# graph + blocking-under-lock), and the full plan audit (bench rungs +
+# TPC-H/TPC-DS corpus, strict mode). Pure host Python — nothing
+# compiles or touches a device — so the whole gate runs in well under
+# 60 s on the 2-core box (combined budget: <= 30 s for the static
+# rules, the rest for the plan audit). bench.py --prewarm runs the
+# same plan verifier per rung before compiling.
 #
 # Usage: tools/ci_static.sh   (exit nonzero on any finding/violation)
 set -euo pipefail
@@ -12,6 +15,9 @@ cd "$(dirname "$0")/.."
 t0=$(date +%s)
 echo "# ci_static: engine-invariant lint (python -m tools.lint)" >&2
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.lint
+
+echo "# ci_static: concurrency soundness (tools/concheck.py)" >&2
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/concheck.py
 
 echo "# ci_static: plan audit (tools/plan_audit.py)" >&2
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/plan_audit.py

@@ -22,13 +22,18 @@ counter plumbing fixes):
                   re-raise or carry an explained annotation
                   (`# noqa: BLE001 - <why>` or `# lint: broad-ok -
                   <why>`).
-  locks           in dist/dcn.py, server/heartbeat.py, and
-                  server/http_server.py: classes owning a lock declare
-                  their shared attributes (`_shared_attrs`), writes to
-                  declared attributes outside __init__ happen under
-                  `with self._lock`, and an under-lock write to an
-                  UNdeclared attribute fails (the declaration is the
-                  reviewable contract).
+  locks           EVERY class in presto_tpu/ owning a threading lock
+                  or Condition (created directly or via
+                  obs.sanitizer.make_lock/make_condition) declares its
+                  shared attributes (`_shared_attrs`) or carries an
+                  explicit `# lint: single-threaded - <why>`
+                  annotation; writes to declared attributes outside
+                  __init__ happen under `with self.<lock>`, and an
+                  under-lock write to an UNdeclared attribute fails
+                  (the declaration is the reviewable contract). The
+                  runtime half of the same contract is
+                  obs/sanitizer.py; the acquisition-ORDER half is
+                  tools/concheck.py.
   purity          no time/random/uuid/id() reachable from jit-cache
                   key expressions or from functions handed to
                   jax.jit/vmap/lax.scan/self._jit (a key or traced
@@ -58,17 +63,17 @@ from typing import Dict, List, Optional, Set, Tuple
 REPO = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
-# files whose classes get the lock-discipline rule
-LOCK_FILES = (
-    "presto_tpu/dist/dcn.py",
-    "presto_tpu/server/heartbeat.py",
-    "presto_tpu/server/http_server.py",
-)
+# the instrumentation layer itself is exempt from the lock-discipline
+# sweep (its wrapper class OWNS a raw lock by design; concheck exempts
+# it from the raw-lock rule for the same reason)
+_LOCK_EXEMPT_FILES = ("presto_tpu/obs/sanitizer.py",)
 
 # the broad-except annotation: a trailing comment on the except line
 # (or the line above) naming the suppression AND a reason after " - "
 _BROAD_OK = re.compile(r"#\s*(noqa: BLE001|lint:\s*broad-ok)\s*-\s*\S")
 _UNLOCKED_OK = re.compile(r"#\s*lint:\s*unlocked-ok\s*-\s*\S")
+_SINGLE_THREADED_OK = re.compile(
+    r"#\s*lint:\s*single-threaded\s*-\s*\S")
 
 # callables that must not be reachable from jit keys / traced code
 _IMPURE_CALLS = {
@@ -338,20 +343,38 @@ def check_counters() -> List[Finding]:
 
 
 # ------------------------------------------------------------ rule: locks
-def _lock_classes(tree: ast.AST) -> List[ast.ClassDef]:
+# a lock-owning class is detected by VALUE, not attribute name: any
+# assignment whose RHS constructs a threading primitive or goes
+# through the sanitizer factory counts, so `_fault_lock`, `_cv`, and
+# class-level `_instances_lock` all bind their owner to the contract
+_LOCKISH_TAILS = ("Lock", "RLock", "Condition",
+                  "make_lock", "make_condition")
+
+
+def _lockish(value: ast.AST) -> bool:
+    return isinstance(value, ast.Call) and \
+        (_dotted(value.func) or "").rsplit(".", 1)[-1] in _LOCKISH_TAILS
+
+
+def _lock_classes(tree: ast.AST) -> List[Tuple[ast.ClassDef, Set[str]]]:
+    """(class, lock-attribute names) for every lock-owning class."""
     out = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.ClassDef):
             continue
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Assign) and \
-                    any(isinstance(t, ast.Attribute) and
-                        t.attr in ("_lock", "lock") and
-                        isinstance(t.value, ast.Name) and
-                        t.value.id == "self"
-                        for t in sub.targets):
-                out.append(node)
-                break
+        attrs: Set[str] = set()
+        for stmt in node.body:  # class-level locks (Name targets)
+            if isinstance(stmt, ast.Assign) and _lockish(stmt.value):
+                attrs.update(t.id for t in stmt.targets
+                             if isinstance(t, ast.Name))
+        for sub in ast.walk(node):  # instance locks (self.X targets)
+            if isinstance(sub, ast.Assign) and _lockish(sub.value):
+                attrs.update(t.attr for t in sub.targets
+                             if isinstance(t, ast.Attribute) and
+                             isinstance(t.value, ast.Name) and
+                             t.value.id == "self")
+        if attrs:
+            out.append((node, attrs))
     return out
 
 
@@ -368,9 +391,12 @@ def _declared_shared(cls: ast.ClassDef) -> Optional[Set[str]]:
 
 
 class _LockWalk(ast.NodeVisitor):
-    """Per-method walk tracking lexical `with self._lock:` nesting."""
+    """Per-method walk tracking lexical `with self.<lock>:` nesting
+    for the owning class's detected lock attributes (a Condition
+    fronting the lock counts: holding it IS holding the lock)."""
 
-    def __init__(self):
+    def __init__(self, lock_attrs: Optional[Set[str]] = None):
+        self.lock_attrs = lock_attrs or {"_lock", "lock"}
         self.depth = 0
         # attr -> [(line, under_lock)]
         self.writes: List[Tuple[str, int, bool]] = []
@@ -380,9 +406,9 @@ class _LockWalk(ast.NodeVisitor):
         # `with q.lock:` on some other object must not count
         locked = any(
             isinstance(item.context_expr, ast.Attribute) and
-            item.context_expr.attr in ("_lock", "lock") and
+            item.context_expr.attr in self.lock_attrs and
             isinstance(item.context_expr.value, ast.Name) and
-            item.context_expr.value.id == "self"
+            item.context_expr.value.id in ("self", "cls")
             for item in node.items
         )
         if locked:
@@ -412,33 +438,50 @@ class _LockWalk(ast.NodeVisitor):
 
 def check_locks(paths=None) -> List[Finding]:
     out: List[Finding] = []
-    for rel in (paths or LOCK_FILES):
-        path = os.path.join(REPO, rel)
+    if paths is None:
+        paths = [_rel(p) for p in _py_files("presto_tpu")
+                 if _rel(p) not in _LOCK_EXEMPT_FILES]
+    for rel in paths:
+        path = rel if os.path.isabs(rel) else os.path.join(REPO, rel)
+        rel = _rel(path)
         tree, lines = _parse(path)
-        for cls in _lock_classes(tree):
+        for cls, lock_attrs in _lock_classes(tree):
             declared = _declared_shared(cls)
             observed: Dict[str, int] = {}
             unlocked: List[Tuple[str, int]] = []
             for meth in (n for n in cls.body
                          if isinstance(n, (ast.FunctionDef,
                                            ast.AsyncFunctionDef))):
-                walker = _LockWalk()
+                walker = _LockWalk(lock_attrs | {"_lock", "lock"})
+                # `*_locked` helper convention: the suffix documents
+                # "caller holds the lock" — the walker starts held.
+                # The convention's HONESTY is enforced at runtime by
+                # obs/sanitizer.py (a caller that doesn't hold the
+                # lock trips the unlocked-shared-write check live)
+                if meth.name.endswith("_locked"):
+                    walker.depth = 1
                 walker.visit(meth)
                 init = meth.name == "__init__"
                 for attr, line, under in walker.writes:
-                    if attr.endswith("lock"):
+                    if attr in lock_attrs or attr.endswith("lock"):
                         continue
                     if under:
                         observed.setdefault(attr, line)
                     elif not init:
                         unlocked.append((attr, line))
-            if observed and declared is None:
-                out.append(Finding(
-                    "locks", rel, cls.lineno,
-                    f"class {cls.name} writes "
-                    f"{sorted(observed)} under its lock but declares "
-                    f"no `_shared_attrs` — declare the shared set so "
-                    f"the race contract is reviewable"))
+            if declared is None:
+                ctx = "\n".join(
+                    lines[max(cls.lineno - 2, 0):cls.lineno])
+                if not _SINGLE_THREADED_OK.search(ctx):
+                    out.append(Finding(
+                        "locks", rel, cls.lineno,
+                        f"class {cls.name} owns a lock "
+                        f"({sorted(lock_attrs)}) but declares no "
+                        f"`_shared_attrs` — declare the shared set "
+                        f"(observed under-lock writes: "
+                        f"{sorted(observed)}) so the race contract "
+                        f"is reviewable, or annotate the class "
+                        f"`# lint: single-threaded - <why>`"))
                 declared = set(observed)
             declared = declared or set()
             for attr in sorted(set(observed) - declared):

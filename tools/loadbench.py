@@ -21,10 +21,20 @@ Reported (one JSON line on stdout, like bench.py's driver contract):
       ``presto_tpu_result_cache_*`` counters (the process-shared
       store's totals).
 
+``--sanitize`` (ISSUE 11) arms the runtime lock sanitizer
+(presto_tpu/obs/sanitizer.py) before the self-hosted server builds a
+single lock, so N protocol clients x the shared ResultCache x the
+admission arbiter x per-query executor threads race the instrumented
+engine deliberately; the run FAILS (exit 1, violations printed) if
+any lock-order inversion or unlocked shared-attr write is observed,
+and the JSON gains ``sanitizer_violations``. This is the CI shape of
+ROADMAP item 1(d)'s "cache on by default" prerequisite.
+
 Usage:
   python -m tools.loadbench                      # self-hosted server
   python -m tools.loadbench --server http://...  # external server
   python -m tools.loadbench --clients 16 --duration 20 --no-cache
+  python -m tools.loadbench --sanitize --clients 8 --duration 10
 """
 
 from __future__ import annotations
@@ -197,8 +207,24 @@ def main() -> int:
     ap.add_argument("--no-cache", action="store_true",
                     help="run the same load without the result cache "
                          "(the A/B baseline)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="arm the runtime lock sanitizer over the "
+                         "self-hosted server and fail on any "
+                         "violation (concurrency soundness gate)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+
+    san = None
+    if args.sanitize:
+        # arm BEFORE the server (and its module-level locks) exists —
+        # instrumentation is a lock-creation-time choice
+        from presto_tpu.obs import sanitizer as san
+
+        san.arm()
+        san.reset()
+        if args.server is not None:
+            print("# --sanitize instruments THIS process only; the "
+                  "external server runs unsanitized", file=sys.stderr)
 
     srv = None
     server = args.server
@@ -224,8 +250,12 @@ def main() -> int:
     finally:
         if srv is not None:
             srv.stop()
+    if san is not None:
+        out["sanitizer_violations"] = san.violation_count()
+        if out["sanitizer_violations"]:
+            print(san.report(), file=sys.stderr)
     print(json.dumps(out, sort_keys=True))
-    return 1 if out["errors"] else 0
+    return 1 if out["errors"] or out.get("sanitizer_violations") else 0
 
 
 if __name__ == "__main__":
