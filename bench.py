@@ -463,6 +463,9 @@ def group_child(only_names) -> int:
 
         def run_device(ex=ex, plan=plan):
             ex._pending_overflow = []
+            # transfer ledger (ISSUE 12): per-run crossing tallies so
+            # BENCH_DETAILS records each rung's copy tax
+            ex._reset_transfer_gauges()
             # per-run path attribution (VERDICT Weak #4: rung
             # discrepancies were unexplainable without it): which
             # execution paths actually engaged, and how many fused-scan
@@ -503,6 +506,14 @@ def group_child(only_names) -> int:
                 # (or injected) device fault via the OOM-degradation
                 # ladder — a slow correct rung, not a crashed one
                 "device_oom_retries": ex.device_oom_retries,
+                # transfer ledger (ISSUE 12, exec/xfer.py): the rung's
+                # host<->device copy tax — ROADMAP item 6's
+                # device-resident work is graded against these
+                "h2d_bytes": ex.h2d_bytes,
+                "d2h_bytes": ex.d2h_bytes,
+                "h2d_transfers": ex.h2d_transfers,
+                "d2h_transfers": ex.d2h_transfers,
+                "transfer_wall_s": round(ex.transfer_wall_s, 6),
             }
 
         # ---- first (warm-up) run doubles as the BOOST-SETTLE loop:

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 from presto_tpu import types as T
 from presto_tpu.exec import agg_states as S
 from presto_tpu.exec import plan as P
+from presto_tpu.exec import xfer as XF
 from presto_tpu.exec.executor import (
     Executor,
     _final_agg_page,
@@ -55,6 +56,7 @@ REPLICATED = "replicated"
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
+    # xfercheck: raw-ok - object array of device HANDLES; no bytes cross
     return Mesh(np.array(devs[:n]), ("d",))
 
 
@@ -122,7 +124,8 @@ class DistExecutor(Executor):
 
         def fenced(*args):
             out = fn(*args)
-            jax.block_until_ready(out)
+            # xfercheck: raw-ok - sync fence (no copy): pins collective
+            jax.block_until_ready(out)  # rendezvous order on CPU
             return out
 
         return fenced
@@ -304,8 +307,10 @@ class DistExecutor(Executor):
             real = len(chunk)
             # pad the tail round; padded starts generate fully-masked rows
             chunk = chunk + [total] * (self.D - len(chunk))
-            start_arr = jax.device_put(
-                np.asarray(chunk, dtype=np.int64), spec
+            start_arr = XF.to_device(
+                # xfercheck: raw-ok - chunk is a host list of split starts
+                np.asarray(chunk, dtype=np.int64),
+                spec=spec, label="split-starts",
             )
             datas, valid = fn(start_arr)
             # launch amortization (ROOFLINE §7): a mesh round is one
@@ -812,38 +817,38 @@ def _stack_to_mesh(pages: List[Page], cap: int, D: int, spec) -> Page:
                 blk0 = first.block(ch)
                 if isinstance(blk0.data, tuple):
                     datas.append(tuple(
-                        _np.zeros(cap, _np.asarray(d).dtype)
-                        for d in blk0.data
+                        _np.zeros(cap, d.dtype) for d in blk0.data
                     ))
                 else:
-                    datas.append(
-                        _np.zeros(cap, _np.asarray(blk0.data).dtype)
-                    )
+                    datas.append(_np.zeros(cap, blk0.data.dtype))
                 nulls_l.append(_np.ones(cap, bool))
                 continue
             blk = p.block(ch)
             if isinstance(blk.data, tuple):
                 datas.append(tuple(
-                    _pad_np(_np.asarray(d), cap) for d in blk.data
+                    _pad_np(XF.np_host(d), cap) for d in blk.data
                 ))
             else:
-                datas.append(_pad_np(_np.asarray(blk.data), cap))
+                datas.append(_pad_np(XF.np_host(blk.data), cap))
             nulls_l.append(
-                _pad_np(_np.asarray(blk.nulls), cap)
+                _pad_np(XF.np_host(blk.nulls), cap)
                 if blk.nulls is not None else _np.zeros(cap, bool)
             )
         blk0 = first.block(ch)
         if isinstance(blk0.data, tuple):
             data = tuple(
-                jax.device_put(
-                    _np.concatenate([d[i] for d in datas]), spec
+                XF.to_device(
+                    _np.concatenate([d[i] for d in datas]),
+                    spec=spec, label="stack-to-mesh",
                 )
                 for i in range(len(blk0.data))
             )
         else:
-            data = jax.device_put(_np.concatenate(datas), spec)
+            data = XF.to_device(_np.concatenate(datas), spec=spec,
+                                label="stack-to-mesh")
         nulls = (
-            jax.device_put(_np.concatenate(nulls_l), spec)
+            XF.to_device(_np.concatenate(nulls_l), spec=spec,
+                         label="stack-to-mesh")
             if any_nulls else None
         )
         blocks.append(Block(
@@ -851,11 +856,13 @@ def _stack_to_mesh(pages: List[Page], cap: int, D: int, spec) -> Page:
             dictionary=blk0.dictionary,
         ))
     valid = _np.concatenate([
-        _pad_np(_np.asarray(p.valid), cap) if p is not None
+        _pad_np(XF.np_host(p.valid), cap) if p is not None
         else _np.zeros(cap, bool)
         for p in padded
     ])
-    return Page(blocks=tuple(blocks), valid=jax.device_put(valid, spec))
+    return Page(blocks=tuple(blocks),
+                valid=XF.to_device(valid, spec=spec,
+                                   label="stack-to-mesh"))
 
 
 def _pad_np(arr, cap):

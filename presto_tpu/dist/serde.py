@@ -29,6 +29,7 @@ from typing import Iterator, List
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.exec import xfer as XF
 from presto_tpu.page import Block, Dictionary, Page
 
 _MAGIC = b"PTP2"
@@ -44,7 +45,7 @@ def _type_from_json(s: str) -> T.SqlType:
 
 def _arrays_of(block: Block) -> List[np.ndarray]:
     datas = block.data if isinstance(block.data, tuple) else (block.data,)
-    return [np.asarray(d) for d in datas]
+    return [XF.np_host(d) for d in datas]
 
 
 def _dic_value_to_json(v):
@@ -107,9 +108,9 @@ def serialize_page(page: Page) -> bytes:
         }
         bh["encs"] = [put(a) for a in arrays]
         if blk.nulls is not None:
-            bh["nulls_enc"] = put(np.asarray(blk.nulls))
+            bh["nulls_enc"] = put(XF.np_host(blk.nulls))
         header["blocks"].append(bh)
-    header["valid_enc"] = put(np.asarray(page.valid))
+    header["valid_enc"] = put(XF.np_host(page.valid))
     hdr = json.dumps(header).encode()
     body = zlib.compress(bytes(payload), level=1)
     return (_MAGIC + struct.pack("<ii", len(hdr), len(body))

@@ -47,6 +47,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from presto_tpu.exec import shapes as SH
+from presto_tpu.exec import xfer as XF
 from presto_tpu.ops.hashing import xxhash64_host
 from presto_tpu.page import Block, Page
 
@@ -82,7 +83,7 @@ def _block_value_u64(blk: Block) -> np.ndarray:
     data = blk.data
     if isinstance(data, tuple):
         # long decimal (hi, lo): combine the two words
-        arrs = [np.asarray(d) for d in data]
+        arrs = [XF.np_host(d) for d in data]
         if any(a.ndim != 1 for a in arrs):
             raise TypeError(
                 "collect-state blocks cannot be exchange partition keys"
@@ -92,7 +93,7 @@ def _block_value_u64(blk: Block) -> np.ndarray:
             for a in arrs:
                 h = h * _C31 + a.astype(np.int64).view(np.uint64)
         return h
-    arr = np.asarray(data)
+    arr = XF.np_host(data)
     if blk.dictionary is not None:
         # hash the dictionary VALUES, not the table-local codes —
         # producer tasks with different dictionaries stay consistent
@@ -115,14 +116,14 @@ def row_hash_u64(page: Page, keys: Sequence[int]) -> np.ndarray:
     """Per-row partition hash over the key channels (31*h + mix(col),
     the reference's CombineHashFunction shape over splitmix-dispersed
     column encodings)."""
-    cap = np.asarray(page.valid).shape[0]
+    cap = XF.np_host(page.valid).shape[0]
     h = np.zeros(cap, dtype=np.uint64)
     with np.errstate(over="ignore"):
         for k in keys:
             blk = page.block(k)
             col = _mix64(_block_value_u64(blk))
             if blk.nulls is not None:
-                col = np.where(np.asarray(blk.nulls), _NULL_SENTINEL,
+                col = np.where(XF.np_host(blk.nulls), _NULL_SENTINEL,
                                col)
             h = h * _C31 + col
     return _mix64(h)
@@ -140,10 +141,10 @@ def take_rows_host(page: Page, idx: np.ndarray) -> Page:
     blocks: List[Block] = []
     for blk in page.blocks:
         if isinstance(blk.data, tuple):
-            data = tuple(np.asarray(d)[pad] for d in blk.data)
+            data = tuple(XF.np_host(d)[pad] for d in blk.data)
         else:
-            data = np.asarray(blk.data)[pad]
-        nulls = (np.asarray(blk.nulls)[pad]
+            data = XF.np_host(blk.data)[pad]
+        nulls = (XF.np_host(blk.nulls)[pad]
                  if blk.nulls is not None else None)
         blocks.append(Block(data=data, type=blk.type, nulls=nulls,
                             dictionary=blk.dictionary))
@@ -158,7 +159,7 @@ def partition_host_page(
     """Split one host page into per-partition compacted pages.
     Partitions with zero rows are skipped (deterministically — replay
     regenerates the same skips, so token sequences stay stable)."""
-    valid = np.asarray(page.valid)
+    valid = XF.np_host(page.valid)
     if nparts <= 1:
         return [(0, page)] if valid.any() else []
     part = (row_hash_u64(page, keys) % np.uint64(nparts)).astype(

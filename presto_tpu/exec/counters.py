@@ -134,6 +134,20 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "invalidation hook after DML/CTAS to their scanned tables "
         "(staleness itself is structural: snapshot_version rides in "
         "every key)"),
+    "h2d_bytes": (
+        "gauge", "bytes staged host->device through the exec/xfer.py "
+        "choke points this query (0 on a cache replay served from "
+        "host pages — the ISSUE 12 zero-copy contract)"),
+    "d2h_bytes": (
+        "gauge", "bytes pulled device->host through the exec/xfer.py "
+        "choke points this query (spill, exchange serialization, "
+        "result decode)"),
+    "h2d_transfers": (
+        "gauge", "host->device crossings this query (exec/xfer.py; "
+        "transfer_wall_s carries their summed wall as a computed "
+        "entry)"),
+    "d2h_transfers": (
+        "gauge", "device->host crossings this query (exec/xfer.py)"),
     "trace_spans": (
         "gauge", "spans recorded into this query's lifecycle trace "
         "(obs/trace.py; pinned 0 when tracing is off)"),
@@ -149,6 +163,7 @@ QUERY_COUNTERS: Dict[str, tuple] = {
 COMPUTED_COUNTERS = (
     "splits_per_launch",     # splits_scanned / program_launches
     "compile_wall_s",        # float wall, not an int counter
+    "transfer_wall_s",       # float wall of metered crossings (xfer)
     "peak_device_bytes",     # high-water gauge (max, not +=)
     "deadline_ms_remaining",  # derived from query_deadline
 )

@@ -38,6 +38,7 @@ from presto_tpu.exec import membudget as MB
 from presto_tpu.exec import plan as P
 from presto_tpu.exec import prune as PR
 from presto_tpu.exec import shapes as SH
+from presto_tpu.exec import xfer as XF
 from presto_tpu.expr.eval import evaluate, evaluate_filter
 from presto_tpu.ops import agg as A
 from presto_tpu.ops import hashing as H
@@ -520,6 +521,25 @@ class Executor:
         self._cache_points: Dict[int, tuple] = {}
         self._cache_inflight: set = set()
         self._cache_pending: List = []
+        # ---- transfer accounting (ISSUE 12, exec/xfer.py): the choke
+        # points meter every host<->device crossing onto THIS query's
+        # gauges while the executor is the thread-bound sink
+        # (execute()/stream_fragment() install it via XF.swap_sink).
+        # Per-query, reset at query start like the spill gauges;
+        # transfer_wall_s is the float wall surfaced as a computed
+        # EXPLAIN ANALYZE entry (the compile_wall_s pattern).
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.h2d_transfers = 0
+        self.d2h_transfers = 0
+        self.transfer_wall_s = 0.0
+        # host-serve sink: ids of the plan nodes whose pages feed
+        # ONLY result serialization/decode (the root and its Output
+        # pass-through chain) — a cache replay or RemoteSource ingest
+        # there serves host pages directly (zero h2d, zero d2h)
+        # instead of round-tripping device_put -> decode pull
+        # (exec/xfer.py)
+        self._host_sink_ids: frozenset = frozenset()
 
     # ------------------------------------------------------------ plumbing
     def count_listener_error(self) -> None:
@@ -528,6 +548,43 @@ class Executor:
         misbehaving EventListener shows on /metrics, system.metrics,
         and EXPLAIN ANALYZE instead of disappearing."""
         self.listener_errors += 1
+
+    def count_transfer(self, direction: str, nbytes: int,
+                       wall_s: float) -> None:
+        """THE sink exec/xfer.py meters crossings to while this
+        executor is the thread-bound transfer sink — registry counters
+        (exec/counters.py), so every crossing shows on EXPLAIN
+        ANALYZE, /metrics, and system.metrics."""
+        if direction == "h2d":
+            self.h2d_transfers += 1
+            self.h2d_bytes += nbytes
+        else:
+            self.d2h_transfers += 1
+            self.d2h_bytes += nbytes
+        self.transfer_wall_s += wall_s
+
+    def _reset_transfer_gauges(self) -> None:
+        """Per-query transfer-gauge reset (execute(),
+        stream_fragment(), and the runner's statement-cache hit path
+        — a replayed statement reports ZERO crossings)."""
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.h2d_transfers = 0
+        self.d2h_transfers = 0
+        self.transfer_wall_s = 0.0
+
+    @staticmethod
+    def _sink_chain_ids(node) -> frozenset:
+        """ids of the nodes whose page streams reach result decode /
+        emit untouched: the root plus its Output pass-through chain
+        (Output yields its source's pages verbatim) — the places a
+        host page can be served without any device consumer ever
+        seeing it."""
+        ids = {id(node)}
+        while isinstance(node, P.Output):
+            node = node.source
+            ids.add(id(node))
+        return frozenset(ids)
 
     def count_cache_invalidations(self, n: int) -> None:
         """Registry-counter sink for the runner's write-path result-
@@ -908,8 +965,15 @@ class Executor:
                 break
             st.wall_s += _time.perf_counter() - t0
             st.pages += 1
-            # device scalar; resolved after the run (deferred-sync rule)
-            st.row_counts.append(page.num_rows())
+            # device scalar; resolved after the run (deferred-sync
+            # rule). Host-served pages (cache replay / RemoteSource at
+            # the host sink) count host-side instead — num_rows() on a
+            # numpy page would implicitly re-stage the valid mask, an
+            # un-metered crossing the transfer auditor exists to kill
+            v = page.valid
+            st.row_counts.append(
+                int(XF.np_host(v).sum()) if isinstance(v, np.ndarray)
+                else page.num_rows())
             self._account_page(page)
             yield page
 
@@ -1349,8 +1413,11 @@ class Executor:
         if isinstance(node, P.RemoteSource):
             # DCN ingest (reference: ExchangeOperator): the registered
             # supplier yields deserialized host pages; stage on device
+            # unless the pages feed only result decode (the host sink)
+            serve_host = id(node) in self._host_sink_ids
             for page in self.remote_sources[node.key]():
-                yield jax.device_put(page)
+                yield page if serve_host else XF.to_device(
+                    page, label="remote-source")
             return
         if isinstance(node, P.Values):
             cols = list(zip(*node.rows)) if node.rows else [
@@ -1596,6 +1663,13 @@ class Executor:
         # selected subtrees from the shared store; a whole-plan hit
         # replays with zero compiles and zero launches
         self._select_cache_points(node)
+        # transfer plane (ISSUE 12, exec/xfer.py): fresh per-query
+        # gauges, and the host-serve sink — pages of the root (and of
+        # anything under its Output pass-through chain) feed ONLY row
+        # decode, so a cache replay there serves host pages with zero
+        # crossings
+        self._reset_transfer_gauges()
+        self._host_sink_ids = self._sink_chain_ids(node)
         # lifecycle tracing (obs/trace.py): spans record at attempt/
         # page boundaries on the driver thread only — one `is None`
         # check is the entire cost with tracing off. Tracing borrows
@@ -1615,6 +1689,7 @@ class Executor:
         if tr is not None:
             exec_span = tr.begin("execute", type(node).__name__)
             self.trace_spans += 1
+        _prev_sink = XF.swap_sink(self)
         try:
             attempts = 0
             while attempts < 6:
@@ -1678,6 +1753,7 @@ class Executor:
                 "capacity overflow persisted after 6 boosted retries"
             )
         finally:
+            XF.swap_sink(_prev_sink)
             # release materialized intermediates (HBM/host pages) the
             # moment the query is done
             self._release_stream_cache()
@@ -1764,12 +1840,23 @@ class Executor:
             if self._collect_stats is not None:
                 st = self._collect_stats.setdefault(
                     id(node), NodeStats(label))
+            # the first redundant crossing the transfer auditor
+            # surfaced (ISSUE 12 satellite): a hit whose pages feed
+            # only statement serialization used to device_put every
+            # host page and then pull it straight back at decode —
+            # the host sink serves the stored pages as-is instead
+            # (h2d_bytes == d2h_bytes == 0 on such a replay,
+            # counter-pinned in tests/test_result_cache.py)
+            serve_host = id(node) in self._host_sink_ids
             for hp in host_pages:
-                dp = jax.device_put(hp)
+                dp = hp if serve_host else XF.to_device(
+                    hp, label="cache-replay")
                 self._account_page(dp)
                 if st is not None:
                     st.pages += 1
-                    st.row_counts.append(dp.num_rows())
+                    st.row_counts.append(
+                        int(XF.np_host(dp.valid).sum())
+                        if serve_host else dp.num_rows())
                 yield dp
             if tr is not None:
                 tr.complete("cache", f"hit:{label}", t0, tr.now(),
@@ -1850,6 +1937,12 @@ class Executor:
         # the SplitFilterConnector's snapshot token, so two tasks of
         # one fragment on different shares can never share a key)
         self._select_cache_points(node)
+        # transfer plane: fragment pages feed emit() (host
+        # serialization) directly, so the fragment root chain is the
+        # host-serve sink — a worker-side cache replay never re-stages
+        self._reset_transfer_gauges()
+        self._host_sink_ids = self._sink_chain_ids(node)
+        _prev_sink = XF.swap_sink(self)
         tr = self.trace
         try:
             attempts = 0
@@ -1908,6 +2001,7 @@ class Executor:
                 "retries"
             )
         finally:
+            XF.swap_sink(_prev_sink)
             # close materialized intermediates (incl. disk-tier spill
             # dirs) the moment the fragment is done — never rely on
             # __del__ timing (same discipline as execute())
@@ -1989,6 +2083,10 @@ class Executor:
             if self.program_launches else 0.0
         )
         ctr["compile_wall_s"] = self.compile_wall_s
+        # transfer ledger (ISSUE 12, exec/xfer.py): the float wall of
+        # this query's metered host<->device crossings; the byte/count
+        # gauges ride in the registry snapshot above
+        ctr["transfer_wall_s"] = round(self.transfer_wall_s, 6)
         ctr["peak_device_bytes"] = self.peak_memory_bytes
         ctr["deadline_ms_remaining"] = (
             int((self.query_deadline - time.monotonic()) * 1000)
@@ -3309,7 +3407,8 @@ class Executor:
                     _next_pow2(pg.capacity)),
             )
             f = bfilter(pg, pj)
-            n = int(f.num_rows())  # host sync: admissible on retry
+            # host sync, admissible on retry — metered (exec/xfer.py)
+            n = int(XF.np_host(f.num_rows(), label="skew-count"))
             if n:
                 pieces.append(compact_page(f, _next_pow2(max(n, 256))))
         # greedy pack: pieces accumulate into a chunk until it would

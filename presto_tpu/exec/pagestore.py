@@ -52,6 +52,7 @@ from typing import Iterator, List, Optional, Set
 import jax
 import numpy as np
 
+from presto_tpu.exec import xfer as XF
 from presto_tpu.page import Page
 
 # Spill directories created by THIS process, removed on close() and —
@@ -136,9 +137,9 @@ class PageStore:
             # one bounded D2H transfer per page; the axon runtime
             # degrades post-D2H kernel launches, so callers only pick
             # the host tier when the intermediate cannot stay resident
-            self._pages.append(jax.device_get(page))
+            self._pages.append(XF.to_host(page, label="spill-host"))
         elif self.tier == "disk":
-            host = jax.device_get(page)
+            host = XF.to_host(page, label="spill-disk")
             leaves, treedef = jax.tree_util.tree_flatten(host)
             path = os.path.join(self._dir, f"p{self.page_count}.npz")
             np.savez(path, **{f"a{i}": leaf
@@ -149,9 +150,10 @@ class PageStore:
 
     def put_host(self, host_page) -> None:
         """Append an ALREADY-HOST page pytree with no device-sync API
-        in the path (put() calls jax.device_get even on host inputs).
-        The result-cache demotion plane runs under the store's lock —
-        concheck's blocking-under-lock rule is why this exists: moving
+        in the path (put() routes through xfer.to_host, which concheck
+        treats as the device sync it is). The result-cache demotion
+        plane runs under the store's lock — concheck's
+        blocking-under-lock rule is why this exists: moving
         host_pages() output between tiers must never touch the device."""
         from presto_tpu.exec.executor import page_bytes
 
@@ -217,13 +219,14 @@ class PageStore:
     def stream(self) -> Iterator[Page]:
         if self.tier == "host":
             for p in self._pages:
-                yield jax.device_put(p)
+                yield XF.to_device(p, label="restream")
         elif self.tier == "disk":
             for path, treedef, n in self._pages:
                 with np.load(path) as z:
                     leaves = [z[f"a{i}"] for i in range(n)]
-                yield jax.device_put(
-                    jax.tree_util.tree_unflatten(treedef, leaves)
+                yield XF.to_device(
+                    jax.tree_util.tree_unflatten(treedef, leaves),
+                    label="restream",
                 )
         else:
             yield from self._pages

@@ -29,6 +29,7 @@ from typing import List, Optional
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.exec import xfer as XF
 from presto_tpu.expr.functions import (
     Ctx,
     _elem_result_val,
@@ -304,6 +305,7 @@ def _string_cast_val(ctx: Ctx, col: Val, to: T.SqlType) -> Val:
                 ctx.xp.ones((ctx.capacity,), dtype=bool), to,
             )
         return Val(
+            # xfercheck: raw-ok - r is a host Python value (CAST fold)
             ctx.xp.asarray(np.asarray(r, np.dtype(to.numpy_dtype))),
             None, to, py_value=r,
         )
@@ -964,11 +966,11 @@ def _val_to_pylist(val: Val, n: int) -> list:
     data = val.data
     if isinstance(data, tuple):
         raise TypeError("lambda bodies over long decimals unsupported")
-    arr = np.asarray(data)
+    arr = XF.np_host(data, label="lambda-eval")
     if arr.ndim == 0:
         arr = np.broadcast_to(arr, (n,))
-    nulls = (np.asarray(val.nulls) if val.nulls is not None
-             else np.zeros(n, bool))
+    nulls = (XF.np_host(val.nulls, label="lambda-eval")
+             if val.nulls is not None else np.zeros(n, bool))
     if nulls.ndim == 0:
         nulls = np.broadcast_to(nulls, (n,))
     scale = (val.type.scale
@@ -985,6 +987,7 @@ def _val_to_pylist(val: Val, n: int) -> list:
             )
         else:
             v = arr[i]
+            # xfercheck: raw-ok - numpy scalar unboxing; arr is host
             v = v.item() if hasattr(v, "item") else v
             if scale is not None:
                 # unscaled decimal -> exact Decimal value

@@ -62,6 +62,14 @@ SPAN_KINDS: Dict[str, str] = {
              "fault-tolerance paths' trace annotation",
     "speculate": "one straggler-speculation copy dispatched (attrs: "
                  "uri); win/loss lands on the task span",
+    "xfer": "one metered host<->device crossing (exec/xfer.py choke "
+            "points): d2h:<label> pulls pages/arrays to host (spill, "
+            "exchange serialization, result decode), h2d:<label> "
+            "stages host pages onto the device (restream, cache "
+            "replay, remote-source ingest); attrs carry bytes, and "
+            "the summed span wall equals the query's transfer_wall_s "
+            "counter — the copy-time phase ROADMAP item 6 drives "
+            "toward zero",
     "cache": "one result-cache point served (presto_tpu/cache/): "
              "hit:<Node> replays stored pages (attrs: pages, key) in "
              "the span's interval — compile+launch skipped; "

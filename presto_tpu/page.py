@@ -256,7 +256,11 @@ class Page:
 
         Reference analog: testing/MaterializedResult.
         """
-        valid = np.asarray(self.valid)
+        # metered d2h boundary (exec/xfer.py; imported lazily — page
+        # loads before the exec package during engine import)
+        from presto_tpu.exec import xfer as XF
+
+        valid = XF.np_host(self.valid, label="decode-valid")
         rows_idx = np.nonzero(valid)[0]
         cols = []
         for blk in self.blocks:
@@ -357,14 +361,16 @@ def _collect_elem_decoder(elem_t, dictionary):
 
 
 def _decode_block(blk: Block, rows_idx: np.ndarray) -> list:
-    nulls = np.asarray(blk.nulls) if blk.nulls is not None else None
+    from presto_tpu.exec import xfer as XF
+
+    nulls = XF.np_host(blk.nulls) if blk.nulls is not None else None
     if (isinstance(blk.type, (T.ArrayType, T.MapType))
             and isinstance(blk.data, tuple)):
         # collect-state result: (vals2d, elem-null-flags2d, counts) for
         # array_agg; (k2d, v2d, value-null-flags2d, counts) for map_agg
         *mats, counts = blk.data
-        mats = [np.asarray(m)[rows_idx] for m in mats]
-        counts = np.asarray(counts)[rows_idx]
+        mats = [XF.np_host(m)[rows_idx] for m in mats]
+        counts = XF.np_host(counts)[rows_idx]
         if isinstance(blk.type, T.ArrayType):
             dec = _collect_elem_decoder(blk.type.element, blk.dictionary)
             vals = [
@@ -388,14 +394,14 @@ def _decode_block(blk: Block, rows_idx: np.ndarray) -> list:
                 for i, c in enumerate(counts)
             ]
     elif isinstance(blk.data, tuple):
-        hi = np.asarray(blk.data[0])[rows_idx].astype(object)
-        lo = np.asarray(blk.data[1])[rows_idx].astype(object)
+        hi = XF.np_host(blk.data[0])[rows_idx].astype(object)
+        lo = XF.np_host(blk.data[1])[rows_idx].astype(object)
         vals = [(int(h) << 64) | (int(l) & ((1 << 64) - 1)) for h, l in zip(hi, lo)]
     elif blk.dictionary is not None:
-        codes = np.asarray(blk.data)[rows_idx]
+        codes = XF.np_host(blk.data)[rows_idx]
         vals = list(blk.dictionary.decode(codes))
     else:
-        arr = np.asarray(blk.data)[rows_idx]
+        arr = XF.np_host(blk.data)[rows_idx]
         if arr.dtype == np.bool_:
             vals = [bool(v) for v in arr]
         elif np.issubdtype(arr.dtype, np.integer):

@@ -577,6 +577,9 @@ class LocalRunner:
                               "capacity_boost_retries",
                               "profile_store_hits"):
                     setattr(ex, gauge, 0)
+                # a replayed statement crosses the host<->device
+                # boundary ZERO times (ISSUE 12 acceptance pin)
+                ex._reset_transfer_gauges()
                 return QueryResult(names, rows, column_types=types)
         names, rows = self.executor.execute(out)
         types = [str(t) for t in self.executor.output_types(out)]

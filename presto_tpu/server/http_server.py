@@ -122,6 +122,16 @@ class MemoryArbiter:
             self._cv.notify_all()
 
 
+def _xfer_totals():
+    """Process-total transfer tallies (exec/xfer.py choke points)
+    under the registry counter names — per-query executors come and
+    go on the concurrent path; the copy-tax truth loadbench reads is
+    the process accumulation."""
+    from presto_tpu.exec import xfer as XFER
+
+    return XFER.process_totals()
+
+
 def _result_cache_totals():
     """Process-total result-cache tallies under the registry counter
     names (zeros when no session ever created the shared store —
@@ -448,12 +458,22 @@ class QueryManager:
             # keeps the fleet truth (the hit-rate surface
             # tools/loadbench.py scrapes)
             snap.update(_result_cache_totals())
+            # transfer counters overlay the same way (exec/xfer.py
+            # process totals — the aggregate copy tax next to QPS/p99)
+            xf = _xfer_totals()
+            snap.update({k: int(v) for k, v in xf.items()
+                         if k in CTRS.QUERY_COUNTERS})
             for name, (kind, _help) in CTRS.QUERY_COUNTERS.items():
                 suffix = "_total" if kind == "counter" else ""
                 lines += [
                     f"# TYPE presto_tpu_{name}{suffix} {kind}",
                     f"presto_tpu_{name}{suffix} {snap[name]}",
                 ]
+            lines += [
+                "# TYPE presto_tpu_transfer_wall_seconds gauge",
+                f"presto_tpu_transfer_wall_seconds "
+                f"{xf['transfer_wall_s']}",
+            ]
         return "\n".join(lines) + "\n"
 
 
@@ -943,7 +963,14 @@ class PrestoTpuServer:
             # same process-shared overlay as /metrics (see
             # _result_cache_totals): one truth on both surfaces
             snap.update(_result_cache_totals())
+            xf = _xfer_totals()
+            snap.update({k: int(v) for k, v in xf.items()
+                         if k in CTRS.QUERY_COUNTERS})
             out.extend(sorted(snap.items()))
+            # the float crossing wall rides as integer milliseconds
+            # (system.metrics values are BIGINT)
+            out.append(("transfer_wall_ms",
+                        int(xf["transfer_wall_s"] * 1000)))
             return out
 
         def runtime_tasks():

@@ -69,6 +69,7 @@ from typing import Dict, List, Optional
 from presto_tpu.connectors.split_filter import SplitFilterConnector
 from presto_tpu.dist import serde
 from presto_tpu.exec import plan as P
+from presto_tpu.exec import xfer as XF
 from presto_tpu.obs import sanitizer as SAN
 from presto_tpu.obs.sanitizer import make_lock, register_owner
 from presto_tpu.session import Session
@@ -879,8 +880,6 @@ class TaskRuntime:
                 from presto_tpu import obs as OBS
 
                 OBS.attach(ex, wtr)
-            import jax
-
             sources = req.get("sources") or {}
             nparts = int(req.get("outputPartitions") or 0)
             out_keys = tuple(req.get("outputKeys") or ())
@@ -940,7 +939,7 @@ class TaskRuntime:
                     )
 
                 def emit(page) -> int:
-                    host = jax.device_get(page)
+                    host = XF.to_host(page, label="task-emit")
                     n = 0
                     for p, part_page in SPOOL.partition_host_page(
                             host, out_keys, max(nparts, 1)):
@@ -964,7 +963,8 @@ class TaskRuntime:
                     task.done = True
             else:
                 def emit(page) -> bytes:
-                    return serde.serialize_page(jax.device_get(page))
+                    return serde.serialize_page(
+                        XF.to_host(page, label="task-emit"))
 
                 blobs: List = ex.stream_fragment(
                     partial, emit, cancelled=lambda: task.cancelled

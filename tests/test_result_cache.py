@@ -127,6 +127,60 @@ def test_statement_cache_skips_executor(runner):
     assert res1.column_types == res2.column_types
 
 
+def test_statement_cache_hit_zero_transfers(runner):
+    """ISSUE 12 acceptance pin: a statement-cache hit crosses the
+    host<->device boundary ZERO times — no page replay, no decode
+    pull; the transfer gauges read 0 for the replayed query."""
+    from presto_tpu.exec import xfer as XFER
+
+    runner.session.set("result_cache_enabled", True)
+    runner.execute(AGG_Q)
+    ex = runner.executor
+    hits_before = ex.result_cache_hits
+    base = XFER.process_totals()
+    runner.execute(AGG_Q)
+    assert ex.result_cache_hits > hits_before
+    assert ex.d2h_bytes == 0 and ex.h2d_bytes == 0, (
+        "a replayed statement must not touch the device")
+    assert ex.d2h_transfers == 0 and ex.h2d_transfers == 0
+    assert ex.transfer_wall_s == 0
+    # the per-query gauges are RESET on the hit path, so the
+    # falsifiable half of the pin is the process totals: nothing
+    # anywhere in the process crossed during the replay
+    after = XFER.process_totals()
+    assert after["h2d_bytes"] == base["h2d_bytes"]
+    assert after["d2h_bytes"] == base["d2h_bytes"]
+    assert after["d2h_transfers"] == base["d2h_transfers"]
+    assert after["h2d_transfers"] == base["h2d_transfers"]
+
+
+def test_fragment_hit_serves_host_pages_zero_transfers(runner):
+    """The first redundant crossing the transfer auditor surfaced
+    (ISSUE 12 satellite): a fragment-cache hit whose pages feed only
+    result serialization used to device_put every stored host page
+    and pull it straight back at decode. The host-serve sink now
+    replays host pages directly — a full-plan hit executes with zero
+    crossings either way."""
+    from presto_tpu.exec import xfer as XFER
+
+    ex = runner.executor
+    ex.result_cache = ResultCache()
+    plan = runner.plan(AGG_Q)
+    _n1, rows1 = ex.execute(plan)
+    assert ex.result_cache_misses >= 1
+    base = XFER.process_totals()
+    _n2, rows2 = ex.execute(plan)
+    assert ex.result_cache_hits >= 1
+    assert rows1 == rows2
+    assert ex.h2d_bytes == 0 and ex.d2h_bytes == 0, (
+        "a host-served fragment replay must not round-trip the device")
+    # and nothing leaked around the per-query gauges: the process
+    # totals did not move either
+    after = XFER.process_totals()
+    assert after["h2d_bytes"] == base["h2d_bytes"]
+    assert after["d2h_bytes"] == base["d2h_bytes"]
+
+
 # ------------------------------------------------- counter contracts
 def test_hit_miss_counters_explain_analyze(runner):
     """The four registry counters surface through execute_with_stats

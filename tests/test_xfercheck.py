@@ -1,0 +1,359 @@
+"""ISSUE 12: the static host<->device transfer audit
+(tools/xfercheck.py) plus the dynamic metering contracts of the choke
+points (presto_tpu/exec/xfer.py).
+
+Mirrors the PR-6/PR-11 mutation-suite style: group 1 pins the repo
+itself clean (the gate) and the registry live; group 2 seeds
+deliberately-broken transfer shapes in synthetic files and asserts
+each rule REJECTS them with a pointed message; group 3 covers the
+runtime half — registry counters on every surface, `xfer` spans when
+traced and only then, and span wall == transfer_wall_s.
+"""
+
+import re
+import textwrap
+
+import pytest
+
+from presto_tpu.exec import xfer as XFER
+from tools.xfercheck import run_xfercheck
+
+# --------------------------------------------------------------- gates
+
+
+def test_repo_is_xfercheck_clean():
+    """THE gate: zero findings across registry, plane, and choke rules
+    on the repo itself. A finding here is an unaccounted host<->device
+    crossing — declare it (direction/plane/why), route it through
+    exec/xfer.py, or annotate WHY it stays raw; don't relax the rule."""
+    findings = run_xfercheck()
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_transfer_registry_is_live_and_well_formed():
+    """The inventory is non-trivially populated and every row carries
+    a valid direction, a valid plane, and real help text (stale rows
+    are excluded by the clean gate above)."""
+    assert len(XFER.TRANSFER_REGISTRY) >= 15
+    for site, (direction, plane, why) in \
+            XFER.TRANSFER_REGISTRY.items():
+        assert direction in ("h2d", "d2h", "h2d+d2h"), site
+        assert plane in ("data", "control"), site
+        assert why.strip(), f"{site} has empty justification"
+    # the choke points themselves are declared data-plane sites
+    for site in ("exec.xfer.to_host", "exec.xfer.to_device",
+                 "exec.xfer.np_host"):
+        assert site in XFER.TRANSFER_REGISTRY
+    # the data plane names the per-page query modules
+    assert "exec.pagestore" in XFER.DATA_PLANE_MODULES
+    assert "dist.spool" in XFER.DATA_PLANE_MODULES
+
+
+# ----------------------------------------------------- mutation suite
+
+
+def _tmp_py(tmp_path, body: str, name: str = "seeded.py") -> str:
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def _rules(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def test_mutation_undeclared_device_put(tmp_path):
+    """An undeclared raw jax.device_put site fails the registry rule
+    with the canonical site name in the message."""
+    path = _tmp_py(tmp_path, """
+        import jax
+
+        def stage(page):
+            return jax.device_put(page)
+    """)
+    found = _rules(
+        run_xfercheck(paths=[path], registry={}, data_modules=set()),
+        "xfer-registry")
+    assert found, "undeclared device_put not detected"
+    assert "seeded.stage" in found[0].message
+    assert "TRANSFER_REGISTRY" in found[0].message
+
+
+def test_mutation_stale_registry_row():
+    """A registry row naming a site with no primitive fails the full
+    sweep (the stale-entry discipline of QUERY_COUNTERS/LOCK_REGISTRY
+    applied to transfers)."""
+    registry = dict(XFER.TRANSFER_REGISTRY)
+    registry["exec.nowhere.phantom_pull"] = (
+        "d2h", "data", "a site that does not exist")
+    found = _rules(run_xfercheck(registry=registry), "xfer-registry")
+    assert any("phantom_pull" in f.message and "stale" in f.message
+               for f in found)
+
+
+def test_mutation_unrouted_data_plane_primitive(tmp_path):
+    """A DECLARED site in a data-plane module still fails the choke
+    rule when it uses the raw primitive instead of the xfer API — an
+    unrouted crossing is invisible to the counters."""
+    path = _tmp_py(tmp_path, """
+        import jax
+
+        def pull(page):
+            return jax.device_get(page)
+    """)
+    findings = run_xfercheck(
+        paths=[path],
+        registry={"seeded.pull": ("d2h", "data", "spill pull")},
+        data_modules={"seeded"},
+    )
+    assert not _rules(findings, "xfer-registry")
+    choke = _rules(findings, "xfer-choke")
+    assert choke, "raw data-plane primitive not flagged"
+    assert "xfer.to_host" in choke[0].message
+
+
+def test_mutation_wrong_plane_declaration(tmp_path):
+    """A `data`-plane declaration for a site OUTSIDE the data-plane
+    module list fails — plane classification is load-bearing (a data
+    crossing in a setup module means either a misdeclared row or
+    query work leaking out of the operator tier)."""
+    path = _tmp_py(tmp_path, """
+        import jax
+
+        def warm(tree):
+            return jax.device_get(tree)
+    """)
+    findings = run_xfercheck(
+        paths=[path],
+        registry={"seeded.warm": ("d2h", "data", "warmup pull")},
+        data_modules={"somewhere.else"},
+    )
+    plane = _rules(findings, "xfer-plane")
+    assert plane, "wrong-plane declaration not flagged"
+    assert "DATA_PLANE_MODULES" in plane[0].message
+
+
+def test_escape_comment_is_honored(tmp_path):
+    """`# xfercheck: raw-ok - <why>` waives the choke rule (and the
+    direction cross-check) for a deliberate raw primitive; the site
+    still needs its registry row."""
+    path = _tmp_py(tmp_path, """
+        import jax
+
+        def fence(tree):
+            # xfercheck: raw-ok - sync fence, no bytes cross
+            jax.block_until_ready(tree)
+            return tree
+    """)
+    findings = run_xfercheck(
+        paths=[path],
+        registry={"seeded.fence": ("d2h", "data", "fence")},
+        data_modules={"seeded"},
+    )
+    assert not findings, "\n".join(str(f) for f in findings)
+    # ...but without the registry row the site still fails
+    findings = run_xfercheck(paths=[path], registry={},
+                             data_modules={"seeded"})
+    assert _rules(findings, "xfer-registry")
+
+
+def test_mutation_direction_mismatch(tmp_path):
+    """A site whose primitives cross a direction the registry row does
+    not declare fails — the declaration must cover the code."""
+    path = _tmp_py(tmp_path, """
+        import jax
+
+        def roundtrip(page):
+            return jax.device_put(jax.device_get(page))
+    """)
+    findings = run_xfercheck(
+        paths=[path],
+        registry={"seeded.roundtrip": ("h2d", "control", "stage")},
+        data_modules=set(),
+    )
+    found = _rules(findings, "xfer-registry")
+    assert any("d2h" in f.message and "direction" in f.message
+               for f in found)
+
+
+def test_coercion_heuristic_skips_host_constructions(tmp_path):
+    """np.array/np.asarray over literals, comprehensions, and [x]*n
+    replication are host constructions, not crossings; a coercion of
+    an opaque value IS a potential crossing and needs declaring."""
+    path = _tmp_py(tmp_path, """
+        import numpy as np
+
+        LUT = np.array([1, 2, 3], np.int64)
+
+        def build(vals, cap):
+            return np.array([v is None for v in vals] +
+                            [True] * (cap - len(vals)))
+
+        def pull(x):
+            return np.asarray(x)
+    """)
+    findings = run_xfercheck(paths=[path], registry={},
+                             data_modules=set())
+    found = _rules(findings, "xfer-registry")
+    assert len(found) == 1, [str(f) for f in found]
+    assert "seeded.pull" in found[0].message
+
+
+def test_nested_defs_attribute_to_enclosing_function(tmp_path):
+    """Closures cannot hide a crossing: a primitive inside a nested
+    def attributes to the enclosing top-level function (the concheck
+    convention)."""
+    path = _tmp_py(tmp_path, """
+        import jax
+
+        def outer(pages):
+            def emit(p):
+                return jax.device_get(p)
+            return [emit(p) for p in pages]
+    """)
+    found = _rules(
+        run_xfercheck(paths=[path], registry={}, data_modules=set()),
+        "xfer-registry")
+    assert found and "seeded.outer" in found[0].message
+
+
+# ------------------------------------------------- dynamic contracts
+
+
+@pytest.fixture()
+def tiny_runner():
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runner import LocalRunner
+
+    r = LocalRunner({"tpch": TpchConnector(scale=0.001)},
+                    default_catalog="tpch", page_rows=1 << 12)
+    r.apply_session()
+    return r
+
+
+def test_transfer_counters_reach_explain_analyze(tiny_runner):
+    """The four byte/count gauges ride the QUERY_COUNTERS registry and
+    the float wall rides as a computed entry — one query's result
+    decode alone crosses d2h, so the ledger is non-zero on any run."""
+    plan = tiny_runner.plan(
+        "select count(*), sum(n_nationkey) from nation")
+    _n, _r, stats = tiny_runner.executor.execute_with_stats(plan)
+    ctr = stats["counters"]
+    for name in ("h2d_bytes", "d2h_bytes", "h2d_transfers",
+                 "d2h_transfers", "transfer_wall_s"):
+        assert name in ctr, name
+    assert ctr["d2h_transfers"] >= 1
+    assert ctr["d2h_bytes"] > 0
+    assert ctr["transfer_wall_s"] >= 0.0
+
+
+def test_transfer_gauges_are_per_query(tiny_runner):
+    """Gauges reset at query start — a second query reports its own
+    crossings, not an accumulation."""
+    ex = tiny_runner.executor
+    ex.execute(tiny_runner.plan("select count(*) from nation"))
+    first = ex.d2h_bytes
+    assert first > 0
+    ex.execute(tiny_runner.plan("select count(*) from nation"))
+    assert ex.d2h_bytes == first
+
+
+def test_xfer_spans_when_traced_sum_matches_wall(tiny_runner):
+    """A traced run shows `xfer` spans whose summed wall equals the
+    query's transfer_wall_s (they are the same measurements), with
+    byte attributes attached."""
+    from presto_tpu import obs as OBS
+
+    ex = tiny_runner.executor
+    tr = OBS.QueryTrace("xfer-test")
+    OBS.attach(ex, tr)
+    ex.execute(tiny_runner.plan(
+        "select n_regionkey, count(*) from nation group by "
+        "n_regionkey order by n_regionkey"))
+    spans = [s for s in tr.export() if s["kind"] == "xfer"]
+    assert spans, "traced run produced no xfer spans"
+    assert all(s["name"].startswith(("d2h:", "h2d:")) for s in spans)
+    assert all(s["attrs"].get("bytes", 0) >= 0 for s in spans)
+    span_wall = sum(s["t1"] - s["t0"] for s in spans)
+    assert abs(span_wall - ex.transfer_wall_s) < 1e-6 + \
+        0.01 * ex.transfer_wall_s
+    OBS.finalize(ex, tr)
+
+
+def test_no_xfer_spans_when_untraced(tiny_runner):
+    """Tracing off: crossings still METER (counters move) but record
+    no spans — the `is None` guard, pinned by trace_spans == 0."""
+    ex = tiny_runner.executor
+    assert ex.trace is None
+    ex.execute(tiny_runner.plan("select count(*) from nation"))
+    assert ex.trace_spans == 0
+    assert ex.d2h_transfers >= 1
+
+
+def test_transfer_counters_reach_metrics_and_system_metrics():
+    """The server surfaces: /metrics exposition carries the byte/count
+    gauges plus the transfer_wall_seconds gauge, and system.metrics
+    rows carry the same names plus transfer_wall_ms — overlaid with
+    the exec/xfer.py process totals like the result-cache counters."""
+    from presto_tpu.client import StatementClient
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.server import PrestoTpuServer
+    import urllib.request
+
+    srv = PrestoTpuServer({"tpch": TpchConnector(scale=0.001)},
+                          port=0, page_rows=1 << 12)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        c = StatementClient(server=base)
+        res = c.execute("select count(*) from nation")
+        assert res.error is None
+        with urllib.request.urlopen(f"{base}/metrics",
+                                    timeout=30) as r:
+            text = r.read().decode()
+        for name in ("presto_tpu_h2d_bytes", "presto_tpu_d2h_bytes",
+                     "presto_tpu_h2d_transfers",
+                     "presto_tpu_d2h_transfers",
+                     "presto_tpu_transfer_wall_seconds"):
+            assert re.search(rf"^{name} ", text, re.M), name
+        # the query above decoded rows: the process total is live
+        d2h = int(re.search(r"^presto_tpu_d2h_bytes (\d+)", text,
+                            re.M).group(1))
+        assert d2h > 0
+        res = c.execute("select * from system.metrics")
+        assert res.error is None
+        names = {row[0] for row in res.rows}
+        for name in ("h2d_bytes", "d2h_bytes", "h2d_transfers",
+                     "d2h_transfers", "transfer_wall_ms"):
+            assert name in names, name
+    finally:
+        srv.stop()
+
+
+def test_to_host_and_np_host_meter_only_real_crossings():
+    """Already-host input passes through unmetered (no bytes cross);
+    device input meters its exact byte size — the property that makes
+    host-served cache replays genuinely zero-cost on the ledger."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    base = XFER.process_totals()
+    host = np.arange(16, dtype=np.int64)
+    out = XFER.to_host(host)
+    assert out is host
+    assert XFER.np_host(host) is not None
+    after = XFER.process_totals()
+    assert after["d2h_bytes"] == base["d2h_bytes"]
+
+    dev = jnp.arange(16, dtype=jnp.int64)
+    pulled = XFER.np_host(dev)
+    assert isinstance(pulled, np.ndarray)
+    after2 = XFER.process_totals()
+    assert after2["d2h_bytes"] - after["d2h_bytes"] == 16 * 8
+    assert after2["d2h_transfers"] == after["d2h_transfers"] + 1
+
+    base_h = XFER.process_totals()
+    staged = XFER.to_device(host)
+    assert staged is not None
+    after3 = XFER.process_totals()
+    assert after3["h2d_bytes"] - base_h["h2d_bytes"] == 16 * 8

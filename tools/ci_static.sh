@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
-# Pre-PR static gate (ISSUE 6 + ISSUE 11): the engine-invariant
-# linter, the concurrency soundness pass (lock registry + acquisition
-# graph + blocking-under-lock), and the full plan audit (bench rungs +
-# TPC-H/TPC-DS corpus, strict mode). Pure host Python — nothing
+# Pre-PR static gate (ISSUE 6 + ISSUE 11 + ISSUE 12): the
+# engine-invariant linter, the concurrency soundness pass (lock
+# registry + acquisition graph + blocking-under-lock), the
+# host<->device transfer audit (transfer registry + plane
+# classification + choke-point routing), and the full plan audit
+# (bench rungs + TPC-H/TPC-DS corpus, strict mode). Pure host Python — nothing
 # compiles or touches a device — so the whole gate runs in well under
 # 60 s on the 2-core box (combined budget: <= 30 s for the static
 # rules, the rest for the plan audit). bench.py --prewarm runs the
@@ -18,6 +20,9 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.lint
 
 echo "# ci_static: concurrency soundness (tools/concheck.py)" >&2
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/concheck.py
+
+echo "# ci_static: transfer audit (tools/xfercheck.py)" >&2
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/xfercheck.py
 
 echo "# ci_static: plan audit (tools/plan_audit.py)" >&2
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/plan_audit.py

@@ -89,6 +89,16 @@ _BLOCKING_TAILS = {
                   "behind the accelerator",
     "block_until_ready": "a device fence under a lock serializes "
                          "readers behind the accelerator",
+    # the metered choke points (exec/xfer.py) are still device syncs:
+    # routing a crossing does not make it lock-safe
+    "to_host": "a metered d2h pull under a lock serializes readers "
+               "behind the accelerator (use PageStore.put_host / "
+               "host_pages for already-host pytrees)",
+    "to_device": "a metered h2d stage under a lock serializes readers "
+                 "behind the accelerator",
+    "np_host": "a metered d2h view under a lock serializes readers "
+               "behind the accelerator when the array is "
+               "device-backed",
 }
 _SUBPROCESS_TAILS = ("run", "call", "check_call", "check_output",
                      "Popen")

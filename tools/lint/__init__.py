@@ -266,6 +266,8 @@ _COUNTER_EXEMPT = {
     "peak_memory_bytes": "high-water gauge surfaced as "
                          "peak_device_bytes (computed entry)",
     "compile_wall_s": "float wall surfaced as a computed entry",
+    "transfer_wall_s": "float wall surfaced as a computed entry "
+                       "(exec/xfer.py crossing wall)",
 }
 
 

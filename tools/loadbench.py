@@ -19,7 +19,13 @@ Reported (one JSON line on stdout, like bench.py's driver contract):
       stopwatches would double-count protocol polling),
   cache_hits / cache_misses / cache_hit_rate — from the
       ``presto_tpu_result_cache_*`` counters (the process-shared
-      store's totals).
+      store's totals),
+  h2d_bytes / d2h_bytes / transfer_wall_ms — aggregate host<->device
+      copy tax of the run (ISSUE 12, the ``presto_tpu_h2d_bytes``/
+      ``d2h_bytes``/``transfer_wall_seconds`` process totals from
+      exec/xfer.py, base-subtracted), visible next to QPS/p99 so a
+      serving-path change that re-introduces redundant crossings
+      shows up in the same JSON line that grades its latency.
 
 ``--sanitize`` (ISSUE 11) arms the runtime lock sanitizer
 (presto_tpu/obs/sanitizer.py) before the self-hosted server builds a
@@ -77,6 +83,11 @@ def _scrape_metrics(server: str) -> str:
 def _metric(text: str, name: str) -> int:
     m = re.search(rf"^{re.escape(name)} (\d+)", text, re.M)
     return int(m.group(1)) if m else 0
+
+
+def _metric_f(text: str, name: str) -> float:
+    m = re.search(rf"^{re.escape(name)} ([\d.eE+-]+)", text, re.M)
+    return float(m.group(1)) if m else 0.0
 
 
 def _histo_quantile(text: str, name: str, q: float,
@@ -159,6 +170,9 @@ def run_load(server: str, clients: int, duration_s: float,
     base_hist = _histo_base(pre, hname)
     base_hits = _metric(pre, "presto_tpu_result_cache_hits_total")
     base_miss = _metric(pre, "presto_tpu_result_cache_misses_total")
+    base_h2d = _metric(pre, "presto_tpu_h2d_bytes")
+    base_d2h = _metric(pre, "presto_tpu_d2h_bytes")
+    base_wall = _metric_f(pre, "presto_tpu_transfer_wall_seconds")
 
     t0 = time.time()
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
@@ -190,6 +204,11 @@ def run_load(server: str, clients: int, duration_s: float,
         "cache_hits": hits,
         "cache_misses": misses,
         "cache_hit_rate": round(hits / looked, 3) if looked else 0.0,
+        "h2d_bytes": _metric(post, "presto_tpu_h2d_bytes") - base_h2d,
+        "d2h_bytes": _metric(post, "presto_tpu_d2h_bytes") - base_d2h,
+        "transfer_wall_ms": round(
+            (_metric_f(post, "presto_tpu_transfer_wall_seconds")
+             - base_wall) * 1000, 1),
     }
 
 

@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""xfercheck: the whole-engine static host<->device transfer audit
+(ISSUE 12) — the build-time half of the layer whose runtime half is
+presto_tpu/exec/xfer.py (the metered choke points).
+
+Reference: the Java engine's data plane never leaves the operator tier
+— Pages cross a boundary only at the serialized exchange, and that
+boundary is one audited code path. The TPU build crosses host<->HBM in
+many more places (device_put/device_get, numpy coercions of device
+values, sync fences), so this pass applies the registry discipline of
+QUERY_COUNTERS (PR 6) and LOCK_REGISTRY (PR 11) to transfers:
+
+  xfer-registry  the crossing inventory. Every transfer-primitive call
+                 site (attributed to its enclosing top-level function,
+                 nested defs/closures included — the concheck
+                 convention) must be declared in
+                 exec/xfer.TRANSFER_REGISTRY with a direction
+                 (h2d / d2h / h2d+d2h) that COVERS the primitives
+                 observed at the site, a plane (data / control), and a
+                 non-empty one-line justification. Stale registry rows
+                 fail like stale QUERY_COUNTERS entries.
+  xfer-plane     plane honesty: a `data`-plane row must name a site in
+                 a module listed in exec/xfer.DATA_PLANE_MODULES (the
+                 per-page query path). `control` rows may live
+                 anywhere (setup code exists inside query modules
+                 too).
+  xfer-choke     routing: inside DATA_PLANE_MODULES, RAW primitives
+                 (jax.device_put / jax.device_get / block_until_ready
+                 / numpy coercions / .item() / scalar casts of device
+                 values) must be replaced by the metered choke points
+                 xfer.to_host / xfer.to_device / xfer.np_host — an
+                 unrouted crossing is invisible to the transfer
+                 counters, spans, and the bench ledger. A deliberate
+                 exception carries `# xfercheck: raw-ok - <why>` on
+                 the call line (or the line above). exec/xfer.py
+                 itself is the one exempt module (it IS the routing).
+
+Primitive recognition, chosen safe-but-quiet like concheck's:
+`np.asarray`/`np.array` count only when the argument is not an
+obvious host construction (list/tuple/dict/set/comprehension/literal
+or a list()/sorted()/range()-style call) — a LUT built from Python
+values never crosses. Bare float()/int()/bool() casts count only over
+a `*.num_rows()` call (the engine's known device-scalar producer);
+the general scalar-cast case is statically unresolvable and is
+covered dynamically by routing through the choke points. `jnp.*`
+coercions are trace-time constant embedding, not runtime transfers,
+and are out of scope. `def __array__` on an engine class would be an
+implicit coercion hook and is flagged wherever it appears.
+
+Run: `python tools/xfercheck.py` (exit 1 on findings); tier-1 runs the
+same checks via tests/test_xfercheck.py, and tools/ci_static.sh runs
+them as the fourth static gate next to lint + concheck + plan_audit.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # direct `python tools/xfercheck.py` runs
+    sys.path.insert(0, REPO)
+
+from tools.concheck import _modrel  # noqa: E402
+from tools.lint import (  # noqa: E402
+    Finding,
+    _dotted,
+    _parse,
+    _py_files,
+    _rel,
+)
+
+_RAW_OK = re.compile(r"#\s*xfercheck:\s*raw-ok\s*-\s*\S")
+
+# the metering layer itself: the only module whose raw primitives are
+# the point rather than a leak
+_CHOKE_MODULE = "exec.xfer"
+
+_NP_ROOTS = ("np", "numpy", "_np", "onp")
+_HOST_CALL_TAILS = ("list", "sorted", "range", "len", "tuple", "dict",
+                    "set", "zeros", "ones", "empty", "arange", "full")
+_CHOKE_TAILS = {
+    "to_host": "d2h",
+    "to_device": "h2d",
+    "np_host": "d2h",
+}
+_CHOKE_ROOTS = ("xfer", "XF")
+
+_DIRECTIONS = ("h2d", "d2h", "h2d+d2h")
+_PLANES = ("data", "control")
+
+
+def _host_literal(node: ast.AST) -> bool:
+    """True when the expression is an obvious HOST construction that a
+    numpy coercion cannot turn into a device transfer."""
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set, ast.Dict,
+                         ast.ListComp, ast.SetComp, ast.DictComp,
+                         ast.GeneratorExp, ast.Constant)):
+        return True
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Mult) and (
+                isinstance(node.left, (ast.List, ast.Tuple))
+                or isinstance(node.right, (ast.List, ast.Tuple))):
+            return True  # [x] * n replication is a host construction
+        return _host_literal(node.left) and _host_literal(node.right)
+    if isinstance(node, ast.BoolOp):
+        return all(_host_literal(v) for v in node.values)
+    if isinstance(node, ast.Starred):
+        return _host_literal(node.value)
+    if isinstance(node, ast.Call):
+        tail = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+        return tail in _HOST_CALL_TAILS
+    return False
+
+
+def _primitive_of(call: ast.Call) -> Optional[Tuple[str, bool]]:
+    """(direction-kind, raw?) when ``call`` is a transfer primitive or
+    a choke-point call; None otherwise."""
+    dotted = _dotted(call.func)
+    if not dotted:
+        return None
+    root = dotted.split(".", 1)[0]
+    tail = dotted.rsplit(".", 1)[-1]
+    if tail in _CHOKE_TAILS and root in _CHOKE_ROOTS:
+        return _CHOKE_TAILS[tail], False
+    if tail == "device_put":
+        return "h2d", True
+    if tail == "device_get":
+        return "d2h", True
+    if tail == "block_until_ready":
+        return "d2h", True
+    if tail == "item" and isinstance(call.func, ast.Attribute) and \
+            not call.args and not call.keywords:
+        return "d2h", True
+    if tail in ("asarray", "array") and root in _NP_ROOTS:
+        if call.args and not _host_literal(call.args[0]):
+            return "d2h", True
+        return None
+    if dotted in ("float", "int", "bool") and len(call.args) == 1:
+        a = call.args[0]
+        if isinstance(a, ast.Call) and \
+                (_dotted(a.func) or "").endswith("num_rows"):
+            return "d2h", True
+    return None
+
+
+class _Site:
+    """One registry-granularity site: a top-level function (or the
+    bare module) holding >=1 primitive call."""
+
+    def __init__(self, qual: str, modrel: str, rel: str):
+        self.qual = qual
+        self.modrel = modrel
+        self.rel = rel
+        self.kinds: Set[str] = set()
+        # (line, kind, raw, escaped)
+        self.calls: List[Tuple[int, str, bool, bool]] = []
+
+
+def collect(paths: List[str]) -> Dict[str, _Site]:
+    sites: Dict[str, _Site] = {}
+    for path in paths:
+        modrel = _modrel(path)
+        rel = _rel(path)
+        tree, lines = _parse(path)
+
+        def escaped(line: int) -> bool:
+            ctx = "\n".join(lines[max(line - 2, 0):line])
+            return bool(_RAW_OK.search(ctx))
+
+        def note(qual: str, node: ast.Call) -> None:
+            prim = _primitive_of(node)
+            if prim is None:
+                return
+            kind, raw = prim
+            site = sites.setdefault(qual, _Site(qual, modrel, rel))
+            site.kinds.add(kind)
+            site.calls.append((node.lineno, kind, raw,
+                               escaped(node.lineno)))
+
+        def walk(node: ast.AST, cls: Optional[str],
+                 fn_qual: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    # classes under a function stay attributed to it
+                    walk(child, child.name if fn_qual is None else cls,
+                         fn_qual)
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    if child.name == "__array__" and cls is not None:
+                        # an implicit-coercion hook IS a transfer site
+                        q = f"{modrel}.{cls}.__array__"
+                        site = sites.setdefault(
+                            q, _Site(q, modrel, rel))
+                        site.kinds.add("d2h")
+                        site.calls.append(
+                            (child.lineno, "d2h", True,
+                             escaped(child.lineno)))
+                    if fn_qual is None:
+                        q = (f"{modrel}."
+                             f"{cls + '.' if cls else ''}{child.name}")
+                        walk(child, cls, q)
+                    else:  # nested def: attribute to the enclosing fn
+                        walk(child, cls, fn_qual)
+                    continue
+                if isinstance(child, ast.Call):
+                    note(fn_qual or modrel, child)
+                walk(child, cls, fn_qual)
+
+        walk(tree, None, None)
+    return sites
+
+
+def check_sites(sites: Dict[str, _Site], registry, data_modules,
+                full_sweep: bool) -> List[Finding]:
+    out: List[Finding] = []
+    for qual in sorted(sites):
+        site = sites[qual]
+        line = site.calls[0][0]
+        entry = registry.get(qual)
+        if entry is None:
+            prims = ", ".join(sorted({k for _, k, _, _ in site.calls}))
+            out.append(Finding(
+                "xfer-registry", site.rel, line,
+                f"transfer site {qual!r} ({prims}) is not declared in "
+                f"exec/xfer.TRANSFER_REGISTRY — declare direction, "
+                f"plane (data/control), and a one-line justification "
+                f"(the QUERY_COUNTERS discipline applied to "
+                f"host<->device crossings)"))
+        else:
+            direction, plane, why = (tuple(entry) + ("", "", ""))[:3]
+            if direction not in _DIRECTIONS or plane not in _PLANES \
+                    or not str(why).strip():
+                out.append(Finding(
+                    "xfer-registry", site.rel, line,
+                    f"registry row for {qual!r} is malformed — need "
+                    f"(direction in {_DIRECTIONS}, plane in "
+                    f"{_PLANES}, non-empty justification), got "
+                    f"{entry!r}"))
+            else:
+                covered = (set(direction.split("+"))
+                           if direction != "h2d+d2h"
+                           else {"h2d", "d2h"})
+                # escaped raw calls are asserted non-crossings (or
+                # deliberately raw) — only unescaped primitives must
+                # agree with the declared direction
+                live = {k for _, k, _, esc in site.calls if not esc}
+                missing = live - covered
+                if missing:
+                    out.append(Finding(
+                        "xfer-registry", site.rel, line,
+                        f"registry row for {qual!r} declares "
+                        f"direction {direction!r} but the site also "
+                        f"crosses {'/'.join(sorted(missing))} — "
+                        f"declare the direction that covers every "
+                        f"primitive at the site"))
+                if plane == "data" and site.modrel not in data_modules:
+                    out.append(Finding(
+                        "xfer-plane", site.rel, line,
+                        f"{qual!r} is declared plane='data' but "
+                        f"module {site.modrel!r} is not in "
+                        f"exec/xfer.DATA_PLANE_MODULES — data-plane "
+                        f"crossings live on the per-page query path; "
+                        f"reclassify as 'control' or add the module "
+                        f"to the data plane deliberately"))
+        if site.modrel in data_modules and \
+                site.modrel != _CHOKE_MODULE:
+            for cline, kind, raw, esc in site.calls:
+                if raw and not esc:
+                    out.append(Finding(
+                        "xfer-choke", site.rel, cline,
+                        f"raw {kind} primitive in data-plane module "
+                        f"{site.modrel!r} — route through "
+                        f"xfer.to_host/to_device/np_host so the "
+                        f"crossing is metered (counters, spans, bench "
+                        f"ledger), or annotate "
+                        f"`# xfercheck: raw-ok - <why>`"))
+    if full_sweep:
+        for qual in sorted(set(registry) - set(sites)):
+            out.append(Finding(
+                "xfer-registry", "presto_tpu/exec/xfer.py", 1,
+                f"TRANSFER_REGISTRY declares {qual!r} but no transfer "
+                f"primitive exists at that site (stale entry?)"))
+    return out
+
+
+def run_xfercheck(paths: Optional[List[str]] = None, registry=None,
+                  data_modules=None) -> List[Finding]:
+    full = paths is None
+    if paths is None:
+        paths = _py_files("presto_tpu")
+    if registry is None or data_modules is None:
+        from presto_tpu.exec import xfer as XFER
+
+        registry = (XFER.TRANSFER_REGISTRY if registry is None
+                    else registry)
+        data_modules = (XFER.DATA_PLANE_MODULES if data_modules is None
+                        else data_modules)
+    sites = collect(paths)
+    return check_sites(sites, registry, data_modules, full)
+
+
+def main() -> int:
+    import time
+
+    t0 = time.monotonic()
+    findings = run_xfercheck()
+    for f in findings:
+        print(f)
+    nfiles = len(_py_files("presto_tpu"))
+    print(f"# xfercheck: {len(findings)} finding(s) across {nfiles} "
+          f"files in {time.monotonic() - t0:.1f}s", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
