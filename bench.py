@@ -477,6 +477,12 @@ def group_child(only_names) -> int:
             ex.splits_scanned = 0
             ex.memory_chunked_pipelines = 0
             ex.peak_memory_bytes = 0
+            # device-resident data plane (ISSUE 13): these never pass
+            # through _begin_attempt on the raw pages() drive, so the
+            # per-run reset lives here — recorded values are THIS
+            # run's, not a settle+timed cumulative
+            ex.buffers_donated = 0
+            ex.mesh_local_exchanges = 0
             pages = list(ex.pages(plan))
             drain(pages)
             flags = list(ex._pending_overflow)
@@ -514,6 +520,11 @@ def group_child(only_names) -> int:
                 "h2d_transfers": ex.h2d_transfers,
                 "d2h_transfers": ex.d2h_transfers,
                 "transfer_wall_s": round(ex.transfer_wall_s, 6),
+                # device-resident data plane (ISSUE 13): serde-free
+                # same-process exchange edges + donated-program
+                # invocations on the successful attempt
+                "mesh_local_exchanges": ex.mesh_local_exchanges,
+                "buffers_donated": ex.buffers_donated,
             }
 
         # ---- first (warm-up) run doubles as the BOOST-SETTLE loop:

@@ -157,6 +157,8 @@ ETC_SESSION_KEYS: Dict[str, str] = {
     "stage-scheduler": "stage_scheduler",
     "speculation.enabled": "speculation_enabled",
     "spool-exchange.bytes": "spool_exchange_bytes",
+    "device-exchange.enabled": "device_exchange_enabled",
+    "buffer-donation.enabled": "buffer_donation_enabled",
     "query-trace.enabled": "query_trace_enabled",
     "query-trace.dir": "query_trace_dir",
     "stats-profile.dir": "stats_profile_dir",

@@ -349,11 +349,16 @@ class DistExecutor(Executor):
             if src_dist == REPLICATED:
                 yield from self.pages(node.source)
                 return
+            # compiled collective over the mesh: the exchange never
+            # leaves the device — the same zero-crossing contract the
+            # spooled mesh-local fast path counts (ISSUE 13)
+            self.count_mesh_local()
             fn = self._gather_fn()
             for page in self.pages(node.source):
                 yield fn(page)
             return
         if node.kind == "repartition":
+            self.count_mesh_local()
             if src_dist == REPLICATED:
                 # replicated -> sharded: each device keeps its hash
                 # residues (deterministic disjoint split, no comms)

@@ -9,10 +9,22 @@ outlive the producing task's execution on the worker (PageStore
 host/disk tiers, exec/pagestore.py), so a lost downstream task replays
 from its upstream spools instead of failing the query.
 
-Everything here is host-side numpy on already-device_get pages: the
-partition split happens at the serialization boundary where the page
-has left the device anyway, so the device never pays for the exchange
-(SURVEY §6.8: HTTP shapes survive only at the pod boundary).
+Two partitioning tiers (ISSUE 13). The HOST tier below is numpy on
+already-device_get pages: the split happens at the serialization
+boundary where the page has left the device anyway (SURVEY §6.8: HTTP
+shapes survive only at the pod boundary). The DEVICE tier
+(`device_partition_pages`) computes the SAME splitmix64 value-hash as
+a jitted kernel and compacts each partition to a ladder-bucket
+capacity on device — pages never cross to host at the exchange, and
+the worker spool holds device Pages that materialize to host bytes
+LAZILY (`spool_blob`) only when a replay or a DCN-remote consumer
+actually fetches over HTTP. A Pallas partition-id variant engages only
+on explicit pallas_join_enabled=true (session-distributed, so every
+producer of one exchange resolves it identically — a per-process
+backend auto-probe could disagree across a mixed pool). Parity between
+the tiers is test-pinned per key type incl. the NULL sentinel
+(tests/test_device_exchange.py); skew still rides the boosted-retry
+ladder — a partition overflowing its bucket raises the deferred flag.
 
 Client split (deliberate, not drift): `fetch_spool_blobs` below is the
 WORKER-side exchange client — plain token-dedupe fetch between stage
@@ -44,10 +56,13 @@ import urllib.error
 import urllib.request
 from typing import Iterator, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.exec import shapes as SH
 from presto_tpu.exec import xfer as XF
+from presto_tpu.ops.compact import compact_indices, scatter_column
 from presto_tpu.ops.hashing import xxhash64_host
 from presto_tpu.page import Block, Page
 
@@ -172,6 +187,180 @@ def partition_host_page(
     return out
 
 
+# ------------------------------------------------- device partitioning
+def _mix64_dev(h: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer traced in jnp — bit-identical to the host
+    `_mix64` (uint64 multiplies wrap in XLA exactly like numpy's)."""
+    h = (h ^ (h >> jnp.uint64(30))) * jnp.uint64(_MIX1)
+    h = (h ^ (h >> jnp.uint64(27))) * jnp.uint64(_MIX2)
+    return h ^ (h >> jnp.uint64(31))
+
+
+def _block_value_u64_dev(blk: Block, vh) -> jnp.ndarray:
+    """Traced mirror of `_block_value_u64`: per-row uint64 VALUE
+    encoding of one key block. `vh` is the block's staged dictionary
+    value-hash LUT (device uint64 array) or None."""
+    data = blk.data
+    if isinstance(data, tuple):
+        # long decimal (hi, lo): int64 -> uint64 astype wraps two's-
+        # complement, the same bits .view reinterprets on the host
+        h = jnp.zeros(data[0].shape[0], dtype=jnp.uint64)
+        for a in data:
+            h = h * jnp.uint64(_C31) + a.astype(jnp.int64).astype(
+                jnp.uint64)
+        return h
+    if vh is not None:
+        if vh.shape[0] == 0:
+            return jnp.zeros(data.shape[0], dtype=jnp.uint64)
+        codes = jnp.clip(data.astype(jnp.int64), 0, vh.shape[0] - 1)
+        return vh[codes]
+    if data.dtype == jnp.bool_:
+        return data.astype(jnp.uint64)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        f = data.astype(jnp.float64)
+        f = jnp.where(f == 0.0, 0.0, f)  # -0.0 == +0.0 (SQL equality)
+        bits = jax.lax.bitcast_convert_type(f, jnp.uint64)
+        return jnp.where(jnp.isnan(f), jnp.uint64(_NAN_KEY), bits)
+    return data.astype(jnp.int64).astype(jnp.uint64)
+
+
+def device_row_hash_u64(page: Page, keys: Sequence[int],
+                        dict_luts=()) -> jnp.ndarray:
+    """Traced mirror of `row_hash_u64`: 31*h + mix(col) over splitmix-
+    dispersed column encodings, NULL keys to the fixed sentinel.
+    `dict_luts` aligns with `keys` (device LUT or None per key)."""
+    luts = tuple(dict_luts) or (None,) * len(keys)
+    h = jnp.zeros(page.valid.shape[0], dtype=jnp.uint64)
+    for k, vh in zip(keys, luts):
+        blk = page.block(k)
+        col = _mix64_dev(_block_value_u64_dev(blk, vh))
+        if blk.nulls is not None:
+            col = jnp.where(blk.nulls, jnp.uint64(_NULL_SENTINEL), col)
+        h = h * jnp.uint64(_C31) + col
+    return _mix64_dev(h)
+
+
+def _pallas_part_ids(page: Page, keys: Sequence[int], dict_luts,
+                     nparts: int, *, interpret: bool) -> jnp.ndarray:
+    """Pallas partition-id variant: the 64-bit value encodings split
+    into 32-bit words (Mosaic has no uint64 lanes — the pallas_join
+    discipline) and mix through the fmix32 finalizer inside one VPU
+    kernel. NOT hash-compatible with the splitmix64 tier — partition
+    routing needs only SELF-consistency across one exchange's
+    producers, which is why the gate is the session-distributed
+    pallas_join_enabled=true, never a per-process backend probe."""
+    from jax.experimental import pallas as pl
+
+    from presto_tpu.ops.pallas_join import _mix32, _split64
+
+    luts = tuple(dict_luts) or (None,) * len(keys)
+    los, his = [], []
+    for k, vh in zip(keys, luts):
+        blk = page.block(k)
+        enc = _block_value_u64_dev(blk, vh)
+        if blk.nulls is not None:
+            enc = jnp.where(blk.nulls, jnp.uint64(_NULL_SENTINEL), enc)
+        lo, hi = _split64(enc)
+        los.append(lo)
+        his.append(hi)
+    lo2 = jnp.stack(los)  # [C, N] int32
+    hi2 = jnp.stack(his)
+
+    def kernel(lo_ref, hi_ref, out_ref):
+        acc = jnp.zeros(lo_ref.shape[1:], dtype=jnp.uint32)
+        for c in range(lo_ref.shape[0]):
+            acc = acc * jnp.uint32(31) + _mix32(lo_ref[c], hi_ref[c])
+        acc = _mix32(acc.astype(jnp.int32),
+                     jnp.zeros_like(acc).astype(jnp.int32))
+        out_ref[...] = (acc % jnp.uint32(nparts)).astype(jnp.int32)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(lo2.shape[1:], jnp.int32),
+        interpret=interpret,
+    )(lo2, hi2)
+
+
+def device_partition_pages(
+    ex, page: Page, keys: Sequence[int], nparts: int
+) -> List[Tuple[int, Page]]:
+    """Device-tier `partition_host_page`: ONE jitted program computes
+    every partition assignment and compacts all `nparts` output pages
+    to their ladder-bucket capacity without the page ever crossing to
+    host (ISSUE 13 — the ROOFLINE §11 d2h/h2d exchange pair deletes).
+    Every partition is emitted (empties carry all-False validity) so a
+    replayed task regenerates an identical page sequence. The
+    OR-reduced per-partition overflow flag joins the executor's
+    deferred ladder: skew degrades to a boosted retry, exactly like
+    the host tier's take_rows_host bucket."""
+    cap_in = page.valid.shape[0]
+    if nparts <= 1:
+        return [(0, page)]
+    # host-resident input (a cache replay at the fragment root) stages
+    # through the metered choke point; device pages pass through free
+    page = XF.to_device(page, label="spool-stage")
+    dicts = tuple(page.block(k).dictionary for k in keys)
+    luts = tuple(
+        XF.to_device(_dict_value_hashes(d), label="dict-hash")
+        if d is not None else None
+        for d in dicts
+    )
+    boost = ex._capacity_boost
+    cap = SH.exchange_partition_cap(cap_in, nparts, boost)
+    use_pallas = ex._pallas_exchange_on()
+
+    def body(pg: Page, *vhs):
+        vh_by_key = iter(vhs)
+        full = tuple(next(vh_by_key) if d is not None else None
+                     for d in dicts)
+        if use_pallas:
+            part = _pallas_part_ids(
+                pg, keys, full, nparts,
+                interpret=jax.default_backend() != "tpu")
+        else:
+            h = device_row_hash_u64(pg, keys, full)
+            part = (h % jnp.uint64(nparts)).astype(jnp.int32)
+        outs = []
+        overflow = jnp.asarray(False)
+        for p in range(nparts):
+            mask = pg.valid & (part == p)
+            targets, out_valid, num = compact_indices(mask, cap)
+            blocks = []
+            for blk in pg.blocks:
+                if isinstance(blk.data, tuple):
+                    data = tuple(scatter_column(d, targets, cap)
+                                 for d in blk.data)
+                else:
+                    data = scatter_column(blk.data, targets, cap)
+                nulls = (scatter_column(blk.nulls, targets, cap)
+                         if blk.nulls is not None else None)
+                blocks.append(blk.with_data(data, nulls=nulls))
+            outs.append(Page(blocks=tuple(blocks), valid=out_valid))
+            overflow = overflow | (num > cap)
+        return tuple(outs), overflow
+
+    fn = ex._jit(
+        ("dev_repart", tuple(keys), nparts, cap, cap_in, dicts,
+         use_pallas),
+        body,
+    )
+    outs, overflow = fn(page, *[v for v in luts if v is not None])
+    ex._pending_overflow.append(overflow)
+    return list(enumerate(outs))
+
+
+def spool_blob(page: Page) -> bytes:
+    """Materialize one spooled page to wire bytes — THE lazy host
+    materialization of the device-resident spool tier. Called only
+    when host bytes are actually needed (an HTTP fetch from a
+    DCN-remote consumer or a replay, or spool budget demotion); the
+    d2h is metered at the choke point. Deterministic, so a re-fetch
+    or a replayed prefix serializes byte-identically."""
+    from presto_tpu.dist import serde
+
+    return serde.serialize_page(XF.to_host(page, label="spool-blob"))
+
+
 # ------------------------------------------------------------ client
 class SourceTaskFailed(RuntimeError):
     """The upstream task itself failed (X-Task-Error): deterministic,
@@ -265,21 +454,97 @@ def fetch_spool_blobs(
                 time.sleep(backoff_s * attempt)
 
 
+def local_source_pages(uri: str, task_id: str,
+                       part: int) -> Optional[Iterator[Page]]:
+    """Mesh-local exchange fast path (ISSUE 13): when `uri` names a
+    task runtime in THIS process and the task has finished, return an
+    iterator over its spooled partition Pages — no HTTP, no serde for
+    lazy entries, and no h2d re-stage for device-resident spools.
+    None = not local (or not yet done): the caller falls back to the
+    metered HTTP fetch, which also provides the long-poll wait and
+    the fault-injection surface.
+
+    Race discipline: the released/done checks AND the entry-list
+    snapshot happen under the task lock, so a concurrent ack/release
+    can never yield a silently-empty stream (the HTTP path's 410
+    contract); pages then materialize ONE AT A TIME outside the lock
+    — blob entries whose store was closed mid-iteration raise
+    SourceTaskFailed loudly, and lazy Page entries stay valid by
+    reference regardless of release."""
+    from presto_tpu.server.worker import local_runtime
+
+    rt = local_runtime(uri)
+    if rt is None:
+        return None
+    task = rt.get_task(task_id)
+    if task is None:
+        return None
+    with task.lock:
+        done, err = task.done, task.error
+        spool = task.spool
+        released = task.part_released(part)
+        entries = (
+            list(spool.parts[part]._entries)
+            if (done and not err and not released and spool is not None
+                and part < len(spool.parts))
+            else []
+        )
+    if err:
+        raise SourceTaskFailed(
+            f"upstream task {task_id} on {uri} FAILED: {err}")
+    if released:
+        raise SourceTaskFailed(
+            f"spool partition {part} of task {task_id} on {uri} was "
+            f"already released (acked) — the scheduler consumed it "
+            f"before this fetch")
+    if not done or spool is None:
+        return None
+
+    def gen() -> Iterator[Page]:
+        from presto_tpu.dist import serde
+
+        for entry in entries:
+            if entry[0] == "page":
+                yield entry[1]
+                continue
+            store, i = entry
+            try:
+                blob = store.blob_at(i)
+            except (OSError, IndexError) as e:
+                raise SourceTaskFailed(
+                    f"spool partition {part} of task {task_id} on "
+                    f"{uri} was released (acked) during a mesh-local "
+                    f"read") from e
+            yield serde.deserialize_page(blob)
+
+    return gen()
+
+
 def iter_source_pages(
     spec: dict,
     *,
     retries: int = 3,
     backoff_s: float = 0.1,
     deadline: Optional[float] = None,
+    on_local=None,
 ):
     """Worker-side exchange ingest: yield deserialized pages of one
     RemoteSource edge — partition `spec['partition']` of every
     producer task, in payload order (deterministic, so a re-dispatched
-    consumer regenerates an identical stream from identical spools)."""
+    consumer regenerates an identical stream from identical spools).
+    Same-process producers serve their spooled Pages directly
+    (`local_source_pages`; `on_local` fires once per edge task so the
+    consumer's executor can count mesh_local_exchanges)."""
     from presto_tpu.dist import serde
 
     part = int(spec.get("partition", 0))
     for t in spec["tasks"]:
+        pages = local_source_pages(t["uri"], t["taskId"], part)
+        if pages is not None:
+            if on_local is not None:
+                on_local()
+            yield from pages
+            continue
         for blob in fetch_spool_blobs(
             t["uri"], t["taskId"], part, retries=retries,
             backoff_s=backoff_s, deadline=deadline,

@@ -148,6 +148,16 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "entry)"),
     "d2h_transfers": (
         "gauge", "device->host crossings this query (exec/xfer.py)"),
+    "buffers_donated": (
+        "gauge", "donated-program invocations this attempt "
+        "(fold/topn merge accumulators reusing their input's HBM in "
+        "place via donate_argnums; buffer_donation_enabled)"),
+    "mesh_local_exchanges": (
+        "counter", "exchanges that never left the device/process: "
+        "spooled edges served Pages directly between same-process "
+        "placements (dist/spool.local_source_pages — no HTTP, no "
+        "serde) and DistExecutor collective exchanges compiled onto "
+        "the mesh (all_to_all/all_gather; executor lifetime)"),
     "trace_spans": (
         "gauge", "spans recorded into this query's lifecycle trace "
         "(obs/trace.py; pinned 0 when tracing is off)"),

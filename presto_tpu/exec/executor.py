@@ -111,6 +111,7 @@ def _canonical_join_cols(
                         [universe[v] for v in d.values], np.uint64
                     )
                     codes = jnp.clip(b.data, 0, len(d) - 1)
+                    # xfercheck: raw-ok - trace-time LUT embedding
                     return jnp.asarray(lut)[codes]
 
                 lcols.append(canon(lb, ld))
@@ -200,6 +201,27 @@ class QueryDeadlineExceeded(RuntimeError):
 # keep the executor's historical private names importable.
 _DEVICE_FAULT_MARKERS = FAULTS.DEVICE_FAULT_MARKERS
 _is_device_fault = FAULTS.is_device_fault
+
+
+_donation_warning_filtered = False
+
+
+def _filter_donation_warning() -> None:
+    """One-time (per process) suppression of jax's 'Some donated
+    buffers were not usable' UserWarning: a donated input whose
+    (shape, dtype) matches no output cannot be reused and jax says so
+    per program (e.g. the validity mask of a differently-sized merge
+    output) — expected here, not actionable: donation is best-effort
+    per buffer by design. Guarded so repeated donated-program cache
+    misses never stack duplicate entries onto warnings.filters."""
+    global _donation_warning_filtered
+    if _donation_warning_filtered:
+        return
+    import warnings
+
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable")
+    _donation_warning_filtered = True
 
 
 def page_bytes(page: Page) -> int:
@@ -540,6 +562,31 @@ class Executor:
         # instead of round-tripping device_put -> decode pull
         # (exec/xfer.py)
         self._host_sink_ids: frozenset = frozenset()
+        # ---- device-resident data plane (ISSUE 13). buffer_donation:
+        # thread donate_argnums through _jit for the fold-merge /
+        # topn-merge accumulator programs so a chained merge reuses
+        # its input's HBM in place instead of allocating a fresh
+        # accumulator per step (and a boosted retry's re-run reuses
+        # rungs, not residue — _begin_attempt drops every donated
+        # chain's references). "auto" engages on TPU only (the win is
+        # HBM; donation is free but pointless on CPU) — the
+        # pallas_join_enabled policy; session prop
+        # buffer_donation_enabled forces. buffers_donated counts
+        # donated-program invocations this attempt.
+        self.buffer_donation = "auto"
+        self.buffers_donated = 0
+        # device_exchange: spooled-exchange pages partition on DEVICE
+        # (dist/spool.device_partition_pages) and spool as device
+        # Pages that materialize to host bytes lazily — the ROOFLINE
+        # §11 d2h/h2d exchange pair deletes for mesh-local exchanges.
+        # "auto" = TPU only (the jitted partition programs cost real
+        # CPU compile time for copies CPU barely pays); session prop
+        # device_exchange_enabled forces. mesh_local_exchanges counts
+        # exchange edges served device/host-direct between same-
+        # process placements, skipping serde entirely (executor
+        # lifetime, like the spooled-exchange counters).
+        self.device_exchange = "auto"
+        self.mesh_local_exchanges = 0
 
     # ------------------------------------------------------------ plumbing
     def count_listener_error(self) -> None:
@@ -585,6 +632,14 @@ class Executor:
             node = node.source
             ids.add(id(node))
         return frozenset(ids)
+
+    def count_mesh_local(self) -> None:
+        """Registry-counter sink for the mesh-local exchange fast path
+        (dist/spool.iter_source_pages, the stage scheduler's root
+        drain): one same-process exchange edge served Pages directly —
+        no HTTP, no serde, and zero metered crossings when the spool
+        is device-resident (ISSUE 13)."""
+        self.mesh_local_exchanges += 1
 
     def count_cache_invalidations(self, n: int) -> None:
         """Registry-counter sink for the runner's write-path result-
@@ -684,15 +739,74 @@ class Executor:
             cache.clear()
         cache[key] = node  # keep the ref so id() cannot be reused
 
-    def _jit(self, key, fn, static_argnums=()):
+    @staticmethod
+    def _tristate_on(mode) -> bool:
+        """THE tri-state knob resolution (pallas_join policy): "off"
+        never, "force"/"true" always, "auto" on TPU only. One
+        resolver so the accepted alias sets cannot drift per knob."""
+        if mode in (True, "true", "force"):
+            return True
+        if mode in (False, None, "false", "off", 0):
+            return False
+        return jax.default_backend() == "tpu"
+
+    def _donate_on(self) -> bool:
+        """buffer_donation_enabled: forcing it on CPU is the test
+        path — jax deletes donated inputs on every backend, so
+        use-after-donate bugs fail loudly under tier-1 too; auto is
+        TPU-only (the win is HBM reuse in place)."""
+        return self._tristate_on(self.buffer_donation)
+
+    def _device_exchange_on(self) -> bool:
+        """device_exchange_enabled: spooled-exchange pages partition
+        on device and spool as device Pages (dist/spool.
+        device_partition_pages); auto = TPU only — the partition
+        programs cost real CPU compile time for copies the CPU
+        backend barely pays (ROOFLINE §11)."""
+        return self._tristate_on(self.device_exchange)
+
+    def _pallas_exchange_on(self) -> bool:
+        """Pallas partition-id variant of the device repartition
+        kernel, behind the pallas_join_enabled knob — but engaged
+        ONLY when explicitly forced, never on "auto": the variant's
+        hash is deliberately not splitmix64-compatible, and exchange
+        routing must agree across every producer of one exchange. A
+        per-process backend probe could disagree on a mixed
+        CPU+TPU worker pool and silently mis-route co-partitioned
+        join keys; "true"/"force" is session-distributed to every
+        task payload, so it resolves identically fleet-wide."""
+        return self.pallas_join in (True, "force")
+
+    def _jit(self, key, fn, static_argnums=(), donate_argnums=()):
         """One jit wrapper per CANONICAL program key. Keys name exactly
         the inputs that shape the traced program (the kernel's bound
         args, static sizes, dictionary signatures) and deliberately
         exclude plan-node identity/estimates — two plans that differ
         only in a capacity estimate share one wrapper, and the bucketed
-        static sizes (exec/shapes.py) make their programs identical."""
+        static sizes (exec/shapes.py) make their programs identical.
+
+        ``donate_argnums`` marks args whose buffer the CALLER provably
+        never touches again (fold/topn merge accumulators); when
+        donation resolves on (_donate_on) the program reuses that HBM
+        in place and the invocation counts on buffers_donated. The
+        donated wrapper caches under a salted key so flipping the
+        session property mid-executor can never hand a donating
+        program to a non-donating call site."""
         if not self.use_jit:
             return fn
+        if donate_argnums and self._donate_on():
+            dkey = (key, "donate")
+            if dkey not in self._jit_cache:
+                _filter_donation_warning()
+                jitted = jax.jit(fn, static_argnums=static_argnums,
+                                 donate_argnums=donate_argnums)
+
+                def counted(*a, _j=jitted, **kw):
+                    self.buffers_donated += 1
+                    return _j(*a, **kw)
+
+                self._jit_cache[dkey] = counted
+            return self._jit_cache[dkey]
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(fn, static_argnums=static_argnums)
         return self._jit_cache[key]
@@ -1279,8 +1393,11 @@ class Executor:
                     starts[j] = s.start_row
                     counts[j] = s.row_count
                 try:
+                    # metered h2d: 2xB int64 split descriptors per
+                    # batched launch (exec/xfer.py choke point)
                     page, flags = self._jit_cache[key](
-                        jnp.asarray(starts), jnp.asarray(counts))
+                        XF.to_device(starts, label="batch-starts"),
+                        XF.to_device(counts, label="batch-starts"))
                 except Exception:
                     if i > 0:
                         raise
@@ -1574,6 +1691,10 @@ class Executor:
                     ("topn_merge", node.keys, node.limit,
                      running.capacity, local.capacity),
                     functools.partial(_topn_merge, node.keys, node.limit),
+                    # both the running candidate set and the local
+                    # top-N die at the merge: the chained per-page
+                    # merges reuse one HBM allocation in place
+                    donate_argnums=(0, 1),
                 )
                 running = merge_fn(running, local)
             if running is not None:
@@ -1784,6 +1905,7 @@ class Executor:
         self.program_launches = 0
         self.splits_scanned = 0
         self.memory_chunked_pipelines = 0
+        self.buffers_donated = 0
 
     # -------------------------------------------------- result cache
     def _select_cache_points(self, node: P.PhysicalNode) -> None:
@@ -2370,6 +2492,9 @@ class Executor:
                 collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
+            # the fold accumulator concat is dead after the merge —
+            # donation reuses its HBM for the merged state in place
+            donate_argnums=(0,),
         )
         fold = _FoldBuffer(self, merge_fn, fold_cap, max_iters,
                            2 * fold_cap)
@@ -2411,6 +2536,9 @@ class Executor:
                 extra_types=self._agg_extra_types(node),
             ),
             static_argnums=(1, 2),
+            # the fold's settled state page dies at the final merge —
+            # the fold chain and the finisher share one HBM allocation
+            donate_argnums=(0,),
         )
         fcap = min(
             _next_pow2(node.capacity * self._capacity_boost),
@@ -2542,6 +2670,7 @@ class Executor:
                 extra_types=self._agg_extra_types(node),
             ),
             static_argnums=(1, 2),
+            donate_argnums=(0,),  # per-pass fold state dies here
         )
         nkeys = len(node.group_channels)
         merge_fn = self._jit(
@@ -2554,6 +2683,7 @@ class Executor:
                 collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
+            donate_argnums=(0,),  # fold concat dead after the merge
         )
         src_stream = self._source_stream(node.source)
         for p in range(parts):
@@ -2615,6 +2745,7 @@ class Executor:
                 collect_k=self._collect_k_eff,
             ),
             static_argnums=(1, 2),
+            donate_argnums=(0,),  # fold concat dead after the merge
         )
         final_fn = self._jit(
             ("agg_final", node.group_channels, node.aggregates,
@@ -2627,6 +2758,7 @@ class Executor:
                 extra_types=self._agg_extra_types(node),
             ),
             static_argnums=(1, 2),
+            donate_argnums=(0,),  # per-partition fold state dies here
         )
 
         folds = [
@@ -3857,7 +3989,9 @@ def _state_reduce(st, blk, kind, apply_pre, reducer):
         )
     dic = blk.dictionary
     if dic is not None and kind in (A.MIN, A.MAX) and len(dic):
+        # xfercheck: raw-ok - trace-time LUT embedding
         rank = jnp.asarray(dic.sort_rank().astype(np.int64))
+        # xfercheck: raw-ok - trace-time LUT embedding
         inv = jnp.asarray(np.argsort(dic.sort_rank()).astype(np.int64))
         data = rank[jnp.clip(blk.data, 0, len(dic) - 1)]
         vals, out_nulls = reducer(data, blk.nulls)
@@ -4835,13 +4969,16 @@ def _unnest_page(array_channel, elem_type, with_ordinality,
     idx = jnp.arange(cap * L, dtype=jnp.int64)
     i, k = idx // L, idx % L
     codes = jnp.clip(blk.data.astype(jnp.int64), 0, n - 1)[i]
+    # xfercheck: raw-ok - trace-time LUT embedding
     valid = page.valid[i] & (k < jnp.asarray(lens)[codes])
     if blk.nulls is not None:
         valid = valid & ~blk.nulls[i]
     src = gather_rows(page, i, valid)
     eblock = Block(
+        # xfercheck: raw-ok - trace-time LUT embedding
         data=jnp.asarray(flat)[codes, k],
         type=elem_type,
+        # xfercheck: raw-ok - trace-time LUT embedding
         nulls=jnp.asarray(enull)[codes, k],
         dictionary=edic,
     )

@@ -134,10 +134,24 @@ class BufferPlan:
     rows: int          # ladder-bucketed capacity
     row_bytes: int
     chunked: bool = False   # a governed rewrite resized/partitioned it
+    # buffer donation (ISSUE 13): the executor's merge-accumulator
+    # programs take this buffer via donate_argnums, so the merge input
+    # and output SHARE one allocation — the donated input must not
+    # double-count against the concurrent-footprint model
+    donated: bool = False
 
     @property
     def bytes(self) -> int:
         return self.rows * self.row_bytes
+
+    @property
+    def live_bytes(self) -> int:
+        """Contribution to the concurrent-footprint model: a donated
+        accumulator holds ONE allocation across the merge chain (the
+        in-place reuse donate_argnums buys), where the non-donated
+        path holds the dying input alongside the fresh output — half
+        the undonated model's 2x charge."""
+        return self.bytes // 2 if self.donated else self.bytes
 
 
 @dataclasses.dataclass
@@ -150,8 +164,10 @@ class AuditReport:
     def peak_bytes(self) -> int:
         """Model pipeline peak: the sum of the two largest concurrent
         buffers plus one page share — a deliberate over- rather than
-        under-estimate (streaming keeps most buffers dead)."""
-        sizes = sorted((b.bytes for b in self.buffers), reverse=True)
+        under-estimate (streaming keeps most buffers dead). Donated
+        accumulators count at live_bytes (in-place reuse)."""
+        sizes = sorted((b.live_bytes for b in self.buffers),
+                       reverse=True)
         return sum(sizes[:2]) + (sizes[2] if len(sizes) > 2 else 0) // 2
 
     @property
@@ -189,11 +205,13 @@ def audit(ex, node) -> AuditReport:
 
     budget = ex._budget()
     fault = ex._fault_rows()
+    donate = ex._donate_on()
     buffers: List[BufferPlan] = []
 
-    def add(label, rows, row_b, chunked=False):
+    def add(label, rows, row_b, chunked=False, donated=False):
         buffers.append(BufferPlan(label, SH.bucket(rows), max(row_b, 1),
-                                  chunked=chunked))
+                                  chunked=chunked,
+                                  donated=donated and donate))
 
     def emit_cap(n) -> Optional[int]:
         """Upper bound on the page capacity a subtree can EMIT — the
@@ -217,6 +235,10 @@ def audit(ex, node) -> AuditReport:
         if isinstance(n, P.TopN):
             return SH.bucket(max(n.limit, 8))
         return None
+
+    # (TopN running-merge buffers are donated too — executor
+    # topn_merge site — but TopN never reaches add(): its candidate
+    # set is bounded by the limit bucket, noise next to real buffers)
 
     def walk(n):
         if isinstance(n, P.TableScan):
@@ -291,9 +313,13 @@ def audit(ex, node) -> AuditReport:
                     fault and max(fault >> 2, 8192),
                     BUILD_SHARE_DIV,
                 )
+                # the fold accumulator is a donated merge input when
+                # buffer donation is on — the chained merges reuse
+                # one allocation in place (executor agg_merge sites)
                 add("agg state", min(cap, state_cap) if state_cap
                     else cap, row_b,
-                    chunked=bool(state_cap and cap > state_cap))
+                    chunked=bool(state_cap and cap > state_cap),
+                    donated=True)
             walk(n.source)
             return
         if isinstance(n, (P.Sort, P.Window, P.MarkDistinct)):
@@ -340,6 +366,8 @@ def render(report: AuditReport) -> str:
             flag = "  ** OVER BUDGET **"
         elif b.chunked:
             flag = "  [chunked]"
+        elif b.donated:
+            flag = "  [donated]"
         lines.append(
             f"  {b.label:<38} {b.rows:>10} rows x {b.row_bytes:>4} B "
             f"= {b.bytes / 1e6:>10.2f} MB{flag}"

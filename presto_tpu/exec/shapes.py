@@ -67,6 +67,20 @@ def chunk_bucket(total: int, parts: int, floor: int = 1024) -> int:
     return bucket(max(total // max(parts, 1) * 2, floor))
 
 
+def exchange_partition_cap(capacity: int, nparts: int,
+                           boost: int) -> int:
+    """Landing capacity of ONE partition page the device repartition
+    kernel compacts to (dist/spool.device_partition_pages): the grace-
+    chunk sizing scaled by the overflow-retry boost, never past the
+    source page's own bucket. Boost is a ladder power of two, so a
+    skewed key distribution re-enters exactly BOOST_STEP rungs up —
+    the exchange shares the shapes contract of every other buffer."""
+    if nparts <= 1:
+        return bucket(capacity)
+    return min(bucket(capacity),
+               chunk_bucket(capacity, nparts) * bucket(boost, 1))
+
+
 # ------------------------------------------------ device-memory model
 # The axon XLA:TPU runtime faults kernels touching >=~4M-row buffers
 # (bisected round 4; the reason max_join_build_rows and

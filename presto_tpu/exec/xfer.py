@@ -79,7 +79,34 @@ TRANSFER_REGISTRY: Dict[str, Tuple[str, str, str]] = {
         "d2h", "data",
         "array-granularity d2h view: numpy coercion of one (possibly "
         "device) array, metered only when bytes actually cross"),
+    # ---- page construction (the host-values -> device ingest edge)
+    "page.Page.from_arrays": (
+        "h2d", "data",
+        "page construction stages the validity mask onto the device "
+        "(column blocks stage via _encode_column) — the ingest "
+        "boundary of Values/memory/test pages"),
+    "page._encode_column": (
+        "h2d", "data",
+        "encoded column data/null arrays stage host values onto the "
+        "device at page construction"),
     # ---- executor data plane
+    "exec.executor.Executor._fused_stream": (
+        "h2d", "data",
+        "split-batched fused scans stage 2xB int64 split descriptors "
+        "per batched launch (start/count vectors, not page data)"),
+    "exec.executor._canonical_join_cols": (
+        "h2d", "control",
+        "dictionary-universe remap LUT embedded at trace time "
+        "(escaped raw-ok: constant folding, sized by dictionary "
+        "cardinality)"),
+    "exec.executor._state_reduce": (
+        "h2d", "control",
+        "dictionary sort-rank LUTs embedded at trace time for min/max "
+        "over dictionary columns (escaped raw-ok)"),
+    "exec.executor._unnest_page": (
+        "h2d", "control",
+        "array-element flattening LUTs embedded at trace time "
+        "(escaped raw-ok)"),
     "exec.executor.Executor.pages": (
         "d2h", "data",
         "EXPLAIN ANALYZE row accounting of HOST-served pages reads "
@@ -142,6 +169,17 @@ TRANSFER_REGISTRY: Dict[str, Tuple[str, str, str]] = {
         "d2h", "data",
         "partition split reads the validity mask of already-host "
         "pages"),
+    "dist.spool.device_partition_pages": (
+        "h2d", "data",
+        "device-tier exchange partitioning: a host-resident input "
+        "(cache replay) stages through the choke point, dictionary "
+        "value-hash LUTs stage per distinct dictionary — device "
+        "pages pass through free (ISSUE 13)"),
+    "dist.spool.spool_blob": (
+        "d2h", "data",
+        "LAZY spool materialization: device-resident exchange pages "
+        "serialize to wire bytes only when an HTTP fetch (DCN-remote "
+        "consumer or replay) or budget demotion needs host bytes"),
     # ---- worker task runtime (the one real d2h of the exchange)
     "server.worker.TaskRuntime._run_task": (
         "d2h", "data",
@@ -169,6 +207,31 @@ TRANSFER_REGISTRY: Dict[str, Tuple[str, str, str]] = {
         "d2h", "control",
         "forced-completion fence for honest timing (bench, "
         "stats_drain): reads ONE element of the last leaf"),
+    # ---- trace-time LUT embedding (jnp coercions of host arrays in
+    # kernel builders: constant folding sized by dictionary/identity
+    # cardinality, never by query data volume)
+    "ops.agg._minmax_identity": (
+        "h2d", "control",
+        "min/max identity scalar embedded at trace time"),
+    "ops.compact.concat_all": (
+        "h2d", "control",
+        "dictionary-code remap LUTs staged when concatenated pages "
+        "carry differing dictionaries — sized by dictionary "
+        "cardinality, not row count"),
+    "ops.keys.equality_encoding": (
+        "h2d", "control",
+        "dictionary value-identity LUT embedded at trace time"),
+    "ops.keys.order_encoding_parts": (
+        "h2d", "control",
+        "dictionary sort-rank LUT embedded at trace time"),
+    "ops.window._one_function": (
+        "h2d", "control",
+        "dictionary sort-rank LUTs + window identity scalars embedded "
+        "at trace time"),
+    "connectors.tpch.TpchConnector._gen_nation_at": (
+        "h2d", "control",
+        "nation->region map (25 entries) embedded into the generator "
+        "at trace time"),
     # ---- expression evaluation
     "expr.eval._const_val": (
         "d2h", "control",

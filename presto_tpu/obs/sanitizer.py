@@ -90,6 +90,9 @@ LOCK_REGISTRY: Dict[str, str] = {
     "server.resource_groups.ResourceGroupManager._lock":
         "admission queues/slots/memory per resource-group path "
         "(Condition-fronted: acquire blocks on it)",
+    "server.worker._runtimes_lock":
+        "the same-process placement registry (uri -> TaskRuntime) the "
+        "mesh-local exchange fast path reads",
     "server.worker.TaskRuntime._fault_lock":
         "fault-injection overlay + the drop/kill call counters",
     "server.worker.TaskRuntime._tasks_lock":

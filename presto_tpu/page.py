@@ -249,7 +249,13 @@ class Page:
             blocks.append(_encode_column(col, typ, cap, dic))
         valid = np.zeros(cap, dtype=bool)
         valid[:n] = True
-        return Page(blocks=tuple(blocks), valid=jnp.asarray(valid))
+        # metered h2d boundary (exec/xfer.py, lazy import like
+        # to_pylist): page construction from host values is a real
+        # device staging the transfer ledger must see
+        from presto_tpu.exec import xfer as XF
+
+        return Page(blocks=tuple(blocks),
+                    valid=XF.to_device(valid, label="page-build"))
 
     def to_pylist(self) -> List[tuple]:
         """Materialize selected rows as Python tuples (test/client boundary).
@@ -298,11 +304,17 @@ def _encode_column(
                     f"value {v!r} not in supplied dictionary"
                 )
             codes[i] = code
-        data = jnp.asarray(codes)
+    # metered h2d boundary (exec/xfer.py): every encoded column stages
+    # host values onto the device — the ingest crossing the transfer
+    # ledger must see (lazy import; page loads before the exec package)
+    from presto_tpu.exec import xfer as XF
+
+    if typ.is_dictionary_encoded:
         return Block(
-            data=data,
+            data=XF.to_device(codes, label="page-build"),
             type=typ,
-            nulls=jnp.asarray(null_mask) if has_nulls else None,
+            nulls=(XF.to_device(null_mask, label="page-build")
+                   if has_nulls else None),
             dictionary=dictionary,
         )
 
@@ -316,9 +328,11 @@ def _encode_column(
             lo[i] = np.int64((u & ((1 << 64) - 1)) - (1 << 64) if (u >> 63) & 1 else u & ((1 << 64) - 1))
             hi[i] = np.int64((int(v) >> 64))
         return Block(
-            data=(jnp.asarray(hi), jnp.asarray(lo)),
+            data=(XF.to_device(hi, label="page-build"),
+                  XF.to_device(lo, label="page-build")),
             type=typ,
-            nulls=jnp.asarray(null_mask) if has_nulls else None,
+            nulls=(XF.to_device(null_mask, label="page-build")
+                   if has_nulls else None),
         )
 
     np_dtype = typ.numpy_dtype
@@ -327,9 +341,10 @@ def _encode_column(
         if v is not None:
             arr[i] = v
     return Block(
-        data=jnp.asarray(arr),
+        data=XF.to_device(arr, label="page-build"),
         type=typ,
-        nulls=jnp.asarray(null_mask) if has_nulls else None,
+        nulls=(XF.to_device(null_mask, label="page-build")
+               if has_nulls else None),
     )
 
 

@@ -249,6 +249,14 @@ class LocalRunner:
         pj = self.session.get("pallas_join_enabled")
         ex.pallas_join = {"auto": "auto", "true": "force",
                           "false": "off"}[pj]
+        # device-resident data plane (ISSUE 13): on-device exchange
+        # partitioning + lazy spools, and buffer donation for the
+        # merge-accumulator programs — both tri-state, auto = TPU
+        # only (the pallas_join policy; executors resolve)
+        ex.device_exchange = self.session.get(
+            "device_exchange_enabled")
+        ex.buffer_donation = self.session.get(
+            "buffer_donation_enabled")
         # only an EXPLICIT session override wins over the constructor's
         # page_rows (the property default must not clobber
         # LocalRunner(page_rows=...) users); restore the constructor

@@ -1027,9 +1027,24 @@ class PrestoTpuServer:
         self._thread.start()
         if self.failure_detector:
             self.failure_detector.start()
+        if self.task_runtime is not None:
+            # coordinator+worker single process: register the embedded
+            # runtime so same-process consumers and the stage-DAG root
+            # drain take its spooled Pages directly (mesh-local
+            # exchange fast path, server/worker registry)
+            from presto_tpu.server.worker import register_local_runtime
+
+            register_local_runtime(
+                f"http://127.0.0.1:{self.port}", self.task_runtime)
         return self.port
 
     def stop(self) -> None:
+        if self.task_runtime is not None:
+            from presto_tpu.server.worker import (
+                unregister_local_runtime,
+            )
+
+            unregister_local_runtime(f"http://127.0.0.1:{self.port}")
         if self.failure_detector:
             self.failure_detector.stop()
         if self._httpd:

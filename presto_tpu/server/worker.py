@@ -76,9 +76,16 @@ from presto_tpu.session import Session
 
 
 class _PartitionSpool:
-    """One partition's spooled blobs: host-tier PageStore while the
-    task's resident budget lasts, disk-tier PageStore past it (the
-    FileSingleStreamSpiller analog for exchange pages)."""
+    """One partition's spooled output: host-tier PageStore blobs while
+    the task's resident budget lasts, disk-tier PageStore past it (the
+    FileSingleStreamSpiller analog for exchange pages) — plus, on the
+    device-exchange tier (ISSUE 13), LAZY entries holding the
+    partitioned Page itself (device- or host-resident): same-process
+    consumers take the Page with no serde at all, and wire bytes
+    materialize only when an HTTP fetch (a DCN-remote consumer or a
+    replay) actually needs them (dist/spool.spool_blob, metered d2h).
+    Entries are (store, index) for materialized blobs and
+    ("page", Page, est_bytes) for lazy ones."""
 
     def __init__(self, spill_dir: Optional[str] = None):
         from presto_tpu.exec.pagestore import PageStore
@@ -86,7 +93,8 @@ class _PartitionSpool:
         self._host = PageStore(tier="host")
         self._disk: Optional[PageStore] = None
         self._spill_dir = spill_dir
-        self._entries: List = []  # (store, index) per token
+        self._entries: List = []  # (store, index) | ("page", p, est)
+        self._page_bytes = 0
         self.released = False
 
     def put(self, blob: bytes, to_disk: bool) -> None:
@@ -102,8 +110,25 @@ class _PartitionSpool:
         store.put_bytes(blob)
         self._entries.append((store, store.page_count - 1))
 
+    def put_page(self, page, est_bytes: int) -> None:
+        """Spool one partitioned Page WITHOUT serializing (the device-
+        resident tier). est_bytes is the static page footprint — the
+        resident-budget accounting the blob tier does by len(blob)."""
+        self._entries.append(("page", page, est_bytes))
+        self._page_bytes += est_bytes
+
     def blob(self, token: int) -> bytes:
-        store, i = self._entries[token]
+        entry = self._entries[token]
+        if entry[0] == "page":
+            # lazy host materialization: deterministic serialization,
+            # so a token re-fetch or a verified replay prefix reads
+            # byte-identical wire data (no caching — re-fetches are
+            # the rare retry path, and an uncached serialize keeps the
+            # entry list free of cross-thread mutation)
+            from presto_tpu.dist import spool as SPOOL
+
+            return SPOOL.spool_blob(entry[1])
+        store, i = entry
         return store.blob_at(i)
 
     @property
@@ -112,14 +137,15 @@ class _PartitionSpool:
 
     @property
     def bytes(self) -> int:
-        return self._host.bytes + (self._disk.bytes if self._disk
-                                   else 0)
+        return (self._host.bytes + self._page_bytes
+                + (self._disk.bytes if self._disk else 0))
 
     def close(self) -> None:
         self._host.close()
         if self._disk is not None:
             self._disk.close()
-        self._entries = []
+        self._entries = []  # drops lazy Page refs -> frees HBM
+        self._page_bytes = 0
         self.released = True
 
 
@@ -142,6 +168,24 @@ class _TaskSpool:
             self.host_bytes += len(blob)
         self.parts[p].put(blob, to_disk)
 
+    def put_page(self, p: int, page) -> None:
+        """Device-exchange tier: spool the partitioned Page itself.
+        The spool_exchange_bytes budget bounds RESIDENT bytes across
+        tiers — a page past it materializes eagerly (spool_blob) and
+        rides the existing blob demotion to disk, so device-resident
+        spools can never hold more HBM than the knob allows."""
+        from presto_tpu.exec.executor import page_bytes
+
+        est = page_bytes(page)
+        if self.host_budget > 0 and self.host_bytes + est > \
+                self.host_budget:
+            from presto_tpu.dist import spool as SPOOL
+
+            self.put(p, SPOOL.spool_blob(page))
+            return
+        self.host_bytes += est
+        self.parts[p].put_page(page, est)
+
     @property
     def page_count(self) -> int:
         return sum(p.count for p in self.parts)
@@ -159,6 +203,35 @@ class _TaskSpool:
     def close(self) -> None:
         for p in self.parts:
             p.close()
+
+
+# --------------------------------------------------------------------
+# Same-process placement registry (ISSUE 13): uri -> TaskRuntime for
+# every task runtime served from THIS process (in-process WorkerServer
+# threads, the coordinator's embedded worker_tasks runtime). The
+# mesh-local exchange fast path — dist/spool.iter_source_pages and the
+# stage scheduler's root drain — looks placements up here and takes
+# spooled Pages directly (no HTTP, no serde, no h2d re-stage for
+# device-resident spools). Subprocess workers never appear: the
+# registry is per-process by construction, so a remote placement
+# always falls back to the metered HTTP + lazy-materialization path.
+_runtimes_lock = make_lock("server.worker._runtimes_lock")
+_LOCAL_RUNTIMES: Dict[str, "TaskRuntime"] = {}
+
+
+def register_local_runtime(uri: str, rt: "TaskRuntime") -> None:
+    with _runtimes_lock:
+        _LOCAL_RUNTIMES[uri] = rt
+
+
+def unregister_local_runtime(uri: str) -> None:
+    with _runtimes_lock:
+        _LOCAL_RUNTIMES.pop(uri, None)
+
+
+def local_runtime(uri: str) -> Optional["TaskRuntime"]:
+    with _runtimes_lock:
+        return _LOCAL_RUNTIMES.get(uri)
 
 
 class _Task:
@@ -518,9 +591,19 @@ def route_task_get(app, path: str, query: str):
                 elif task.done:
                     return (204, [("X-Done", "1")], _JSON_CT, b"")
             if entry is not None:
-                store, i = entry
                 try:
-                    blob = store.blob_at(i)
+                    if entry[0] == "page":
+                        # device-resident spool entry: lazy host
+                        # materialization happens HERE, outside the
+                        # task lock (a d2h + serialize under the lock
+                        # would serialize every other consumer — the
+                        # concheck blocking-under-lock rule)
+                        from presto_tpu.dist import spool as SPOOL
+
+                        blob = SPOOL.spool_blob(entry[1])
+                    else:
+                        store, i = entry
+                        blob = store.blob_at(i)
                 except (OSError, IndexError):
                     # raced a concurrent ack/release of this partition
                     return _jresp(
@@ -901,6 +984,9 @@ class TaskRuntime:
                         lambda spec=spec: SPOOL.iter_source_pages(
                             spec, retries=3, backoff_s=backoff,
                             deadline=ex.query_deadline,
+                            # mesh-local fast path: a same-process
+                            # producer's spool serves Pages directly
+                            on_local=ex.count_mesh_local,
                         )
                     )
 
@@ -938,7 +1024,22 @@ class TaskRuntime:
                         spill_dir=session.get("spill_path") or None,
                     )
 
+                dev_exchange = ex._device_exchange_on()
+
                 def emit(page) -> int:
+                    if dev_exchange:
+                        # device tier (ISSUE 13): partition + compact
+                        # ON DEVICE (dist/spool.device_partition_pages
+                        # — one jitted program, skew joins the boosted
+                        # ladder) and spool the partition Pages
+                        # themselves; host bytes materialize lazily
+                        # only for HTTP (remote/replay) fetches. The
+                        # ROOFLINE §11 d2h-at-emit term deletes here.
+                        pp = SPOOL.device_partition_pages(
+                            ex, page, out_keys, max(nparts, 1))
+                        for p, part_page in pp:
+                            state["spool"].put_page(p, part_page)
+                        return len(pp)
                     host = XF.to_host(page, label="task-emit")
                     n = 0
                     for p, part_page in SPOOL.partition_host_page(
@@ -1012,9 +1113,16 @@ class WorkerServer(TaskRuntime):
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        # same-process placement registry: consumers in THIS process
+        # take spooled Pages directly (mesh-local exchange fast path)
+        register_local_runtime(f"http://127.0.0.1:{self.port}", self)
         return self.port
 
     def stop(self) -> None:
+        # unregister FIRST: a stopped worker must look remote-and-dead
+        # to local consumers (the forced-fallback replay path), never
+        # serve stale spools through the fast path
+        unregister_local_runtime(f"http://127.0.0.1:{self.port}")
         self._httpd.shutdown()
 
 

@@ -324,8 +324,33 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "it spill to disk-tier PageStore files instead of host "
             "RAM (0 = never spill to disk; the spooled shuffle tier "
             "that makes non-leaf task replay and mid-query rejoin "
-            "scheduler policies)",
+            "scheduler policies). On the device-exchange tier the "
+            "same budget bounds device-RESIDENT spool bytes — a page "
+            "past it materializes to host eagerly",
             int, 1 << 30,
+        ),
+        PropertyMetadata(
+            "device_exchange_enabled",
+            "partition spooled-exchange pages ON DEVICE "
+            "(dist/spool.device_partition_pages: jitted splitmix64 "
+            "radix partition + ladder-bucket compaction) and spool "
+            "device Pages that materialize to host bytes lazily — "
+            "mesh-local exchanges then complete with zero h2d/d2h; "
+            "auto = on when running on TPU, off elsewhere (the "
+            "partition programs cost real CPU compile time for "
+            "copies the CPU backend barely pays)",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
+        ),
+        PropertyMetadata(
+            "buffer_donation_enabled",
+            "thread donate_argnums through the jit wrapper for the "
+            "fold/topn merge accumulator programs so chained merges "
+            "and the overflow-retry ladder reuse HBM in place "
+            "instead of reallocating per step (buffers_donated "
+            "counter); auto = on when running on TPU, off elsewhere",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
         ),
         PropertyMetadata(
             "query_trace_enabled",

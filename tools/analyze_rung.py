@@ -103,15 +103,17 @@ def main() -> int:
           f"evictions={ctr.get('result_cache_evictions', 0)} "
           f"invalidations={ctr.get('result_cache_invalidations', 0)}",
           file=sys.stderr)
-    # transfer ledger (ISSUE 12, exec/xfer.py): the rung's measured
-    # host<->device copy tax — the per-rung baseline ROADMAP item 6's
-    # device-resident work (Pallas repartition, buffer donation) will
-    # be graded against
+    # transfer ledger (ISSUE 12/13, exec/xfer.py): the rung's measured
+    # host<->device copy tax, plus the device-resident data plane's
+    # two deltas — mesh-local exchange edges (serde skipped, zero
+    # crossings when device-resident) and donated-program invocations
     print(f"# transfer ledger: h2d_bytes={ctr.get('h2d_bytes', 0)} "
           f"d2h_bytes={ctr.get('d2h_bytes', 0)} "
           f"h2d_transfers={ctr.get('h2d_transfers', 0)} "
           f"d2h_transfers={ctr.get('d2h_transfers', 0)} "
-          f"transfer_wall_s={ctr.get('transfer_wall_s', 0.0)}",
+          f"transfer_wall_s={ctr.get('transfer_wall_s', 0.0)} "
+          f"mesh_local_exchanges={ctr.get('mesh_local_exchanges', 0)} "
+          f"buffers_donated={ctr.get('buffers_donated', 0)}",
           file=sys.stderr)
     print(f"# analyzed wall (incl. per-page drain overhead): {total:.2f}s")
     return 0

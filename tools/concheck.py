@@ -99,6 +99,12 @@ _BLOCKING_TAILS = {
     "np_host": "a metered d2h view under a lock serializes readers "
                "behind the accelerator when the array is "
                "device-backed",
+    # lazy spool materialization (dist/spool.spool_blob) is a d2h pull
+    # PLUS serialization: the device-sync helper ISSUE 13 added to the
+    # exchange plane — never under a task/registry lock
+    "spool_blob": "lazy spool materialization (d2h + serialize) under "
+                  "a lock stalls every consumer and status poll "
+                  "behind the accelerator",
 }
 _SUBPROCESS_TAILS = ("run", "call", "check_call", "check_output",
                      "Popen")

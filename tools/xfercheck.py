@@ -42,9 +42,13 @@ or a list()/sorted()/range()-style call) — a LUT built from Python
 values never crosses. Bare float()/int()/bool() casts count only over
 a `*.num_rows()` call (the engine's known device-scalar producer);
 the general scalar-cast case is statically unresolvable and is
-covered dynamically by routing through the choke points. `jnp.*`
-coercions are trace-time constant embedding, not runtime transfers,
-and are out of scope. `def __array__` on an engine class would be an
+covered dynamically by routing through the choke points.
+`jnp.asarray`/`jnp.array` of a non-literal argument counts as an h2d
+primitive (ISSUE 13 closed this gap — a jnp coercion of a HOST array
+is an undeclared device_put): sites inside traced kernel builders
+escape with raw-ok and declare plane `control` (trace-time constant
+embedding), driver-level sites route through the choke points like
+any other crossing. `def __array__` on an engine class would be an
 implicit coercion hook and is flagged wherever it appears.
 
 Run: `python tools/xfercheck.py` (exit 1 on findings); tier-1 runs the
@@ -80,6 +84,11 @@ _RAW_OK = re.compile(r"#\s*xfercheck:\s*raw-ok\s*-\s*\S")
 _CHOKE_MODULE = "exec.xfer"
 
 _NP_ROOTS = ("np", "numpy", "_np", "onp")
+# jnp.asarray/jnp.array of a HOST array is an h2d staging the gate
+# must see (ISSUE 13 closed this gap): inside traced code it is
+# trace-time embedding (sites escape with raw-ok / declare plane
+# `control`), but at driver level it is a real, unmetered device_put
+_JNP_ROOTS = ("jnp",)
 _HOST_CALL_TAILS = ("list", "sorted", "range", "len", "tuple", "dict",
                     "set", "zeros", "ones", "empty", "arange", "full")
 _CHOKE_TAILS = {
@@ -138,6 +147,10 @@ def _primitive_of(call: ast.Call) -> Optional[Tuple[str, bool]]:
     if tail in ("asarray", "array") and root in _NP_ROOTS:
         if call.args and not _host_literal(call.args[0]):
             return "d2h", True
+        return None
+    if tail in ("asarray", "array") and root in _JNP_ROOTS:
+        if call.args and not _host_literal(call.args[0]):
+            return "h2d", True
         return None
     if dotted in ("float", "int", "bool") and len(call.args) == 1:
         a = call.args[0]
