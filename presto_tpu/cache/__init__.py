@@ -25,15 +25,26 @@ runner's write path. Governed by session properties
 ``result_cache_ttl_ms``; observable via the four ``result_cache_*``
 registry counters (exec/counters.py) and ``cache`` spans in the trace
 plane (obs/).
+
+Streaming extension (ISSUE 14): entries over APPEND-ONLY stream
+connectors (connectors/stream.py) whose scans are pinned to an offset
+carry that offset as a WATERMARK — a write to the stream ADVANCES the
+log past them without touching their content, so the append path
+reclaims only live-head (unwatermarked) entries
+(``ResultCache.advance_tables``) and an IVM refresh replaces a view's
+watermarked entry in place ("advance on write" instead of "discard on
+write"; streaming/ivm.py).
 """
 
 from presto_tpu.cache.rules import (  # noqa: F401
     RESULT_AFFECTING_PROPS,
     VOLATILE_FUNCTIONS,
+    append_only_tables,
     cacheable,
     scan_tables,
     select_cache_points,
     snapshot_tokens,
+    stream_watermark,
     subtree_key,
     uncacheable_reason,
 )

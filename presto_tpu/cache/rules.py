@@ -146,6 +146,40 @@ def snapshot_of(conn, table: str) -> Optional[str]:
     return None if v is None else str(v)
 
 
+def stream_watermark(tables, catalogs) -> Optional[int]:
+    """Offset watermark for a cache entry whose scans include append-
+    only stream tables (connectors/stream.py): the max PINNED offset
+    when every stream scan is offset-pinned (a StreamWindowConnector
+    reader), None otherwise — None for non-stream entries AND for
+    live-head stream scans, whose keys embed the MOVING offset token
+    and are reclaimed by the store's append-path advance
+    (store.advance_tables). A watermark on the entry is what lets a
+    reader pinned at offset N keep hitting its prefix entry while the
+    log grows past N — the monotone-offset-token fix: the token
+    identifies the prefix, the append only extends the suffix."""
+    marks = []
+    for catalog, table in tables:
+        conn = catalogs.get(catalog)
+        if conn is None or not getattr(conn, "append_only", False):
+            continue
+        pin = getattr(conn, "pinned_offset", None)
+        off = pin(table) if pin is not None else None
+        if off is None:
+            return None  # live-head scan: offset-keyed, reclaimable
+        marks.append(int(off))
+    return max(marks) if marks else None
+
+
+def append_only_tables(tables, catalogs) -> FrozenSet[Tuple[str, str]]:
+    """The subset of (catalog, table) pairs whose connector is an
+    append-only stream — the tables whose writes ADVANCE cache
+    entries (store.advance_tables) instead of discarding them."""
+    return frozenset(
+        (c, t) for c, t in tables
+        if getattr(catalogs.get(c), "append_only", False)
+    )
+
+
 def snapshot_tokens(tables, catalogs) -> Optional[Tuple]:
     """Sorted ((catalog, table, version), ...) for a table set; None
     when any table has no snapshot (the whole key is then unbuildable

@@ -47,11 +47,11 @@ _DISK_BUDGET_FACTOR = 4
 
 class _Entry:
     __slots__ = ("key", "kind", "nbytes", "tables", "created",
-                 "store", "payload")
+                 "store", "payload", "watermark")
 
     def __init__(self, key: str, kind: str, nbytes: int,
                  tables: FrozenSet[Tuple[str, str]], created: float,
-                 store=None, payload=None):
+                 store=None, payload=None, watermark=None):
         self.key = key
         self.kind = kind          # "pages" | "rows"
         self.nbytes = nbytes
@@ -59,6 +59,12 @@ class _Entry:
         self.created = created
         self.store = store        # PageStore (pages kind)
         self.payload = payload    # (names, rows, types) (rows kind)
+        # append-log offset this entry's content covers (ISSUE 14):
+        # None for ordinary entries AND for live-head stream scans
+        # (offset-keyed, reclaimed by advance_tables); an int for
+        # PINNED-prefix readers and IVM view results, which a stream
+        # append extends rather than invalidates
+        self.watermark = watermark
 
     @property
     def on_disk(self) -> bool:
@@ -161,12 +167,14 @@ class ResultCache:
             self.hits += 1
             return list(e.store.host_pages())
 
-    def put_pages(self, key: str, pages, tables) -> int:
+    def put_pages(self, key: str, pages, tables,
+                  watermark: Optional[int] = None) -> int:
         """Publish one fragment's completed page stream. ``pages`` may
         be device or host pytrees (PageStore.put stages host-side
         either way — callers publish AFTER the attempt completes, so
-        the D2H read happens off the deferred-sync hot path). Returns
-        the number of entries evicted to admit it."""
+        the D2H read happens off the deferred-sync hot path).
+        ``watermark`` marks a pinned-prefix stream entry (see _Entry).
+        Returns the number of entries evicted to admit it."""
         from presto_tpu.exec.pagestore import PageStore
 
         store = PageStore(tier="host")
@@ -179,7 +187,7 @@ class ResultCache:
             self._drop_locked(key)
             self._entries[key] = _Entry(
                 key, "pages", store.bytes, frozenset(tables),
-                time.monotonic(), store=store,
+                time.monotonic(), store=store, watermark=watermark,
             )
             return self._maintain_locked()
 
@@ -197,7 +205,11 @@ class ResultCache:
             names, rows, types = e.payload
             return list(names), list(rows), list(types)
 
-    def put_rows(self, key: str, names, rows, types, tables) -> int:
+    def put_rows(self, key: str, names, rows, types, tables,
+                 watermark: Optional[int] = None) -> int:
+        """Publish (or ADVANCE — re-putting a watermarked key replaces
+        payload and watermark in place, the IVM refresh contract) one
+        statement/view row set."""
         nbytes = _rows_bytes(names, rows, types)
         with self._lock:
             if nbytes > self.budget_bytes:
@@ -207,8 +219,36 @@ class ResultCache:
                 key, "rows", nbytes, frozenset(tables),
                 time.monotonic(),
                 payload=(list(names), list(rows), list(types)),
+                watermark=watermark,
             )
             return self._maintain_locked()
+
+    def entry_watermark(self, key: str) -> Optional[int]:
+        """The offset watermark riding on one entry (None when the
+        entry is absent or unwatermarked) — introspection for the
+        advance-on-write contract."""
+        with self._lock:
+            e = self._entries.get(key)
+            return None if e is None else e.watermark
+
+    def advance_tables(self, tables) -> int:
+        """Append-path reclaim for append-only stream tables (ISSUE
+        14 — "advance on write"): entries keyed to the LIVE log head
+        became structurally unreachable the moment the offset moved,
+        so drop them now (counted as invalidations, the PR-10 eager-
+        reclaim behavior); entries carrying an offset WATERMARK
+        (pinned-prefix readers, IVM view results) still describe
+        exactly the prefix they were built from — an append only
+        extends the suffix — and are KEPT. Returns the dropped
+        count."""
+        tset = set(tables)
+        with self._lock:
+            doomed = [k for k, e in self._entries.items()
+                      if e.tables & tset and e.watermark is None]
+            for k in doomed:
+                self._drop_locked(k)
+            self.invalidations += len(doomed)
+            return len(doomed)
 
     # --------------------------------------------------- invalidation
     def invalidate_tables(self, tables) -> int:

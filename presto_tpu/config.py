@@ -76,8 +76,13 @@ def _builtin_factories() -> Dict[str, Callable]:
 
         return BlackholeConnector()
 
+    def stream(props):
+        from presto_tpu.connectors.stream import StreamConnector
+
+        return StreamConnector()
+
     return {"tpch": tpch, "tpcds": tpcds, "memory": memory,
-            "blackhole": blackhole}
+            "blackhole": blackhole, "stream": stream}
 
 
 def load_catalogs(etc_dir: str) -> Dict[str, object]:
@@ -165,6 +170,9 @@ ETC_SESSION_KEYS: Dict[str, str] = {
     "result-cache.enabled": "result_cache_enabled",
     "result-cache.bytes": "result_cache_bytes",
     "result-cache.ttl-ms": "result_cache_ttl_ms",
+    "ivm.enabled": "ivm_enabled",
+    "stream-tail.enabled": "stream_tail_enabled",
+    "stream-poll.ms": "stream_poll_ms",
 }
 
 # consumed structurally by server_from_etc (constructor args /

@@ -1,0 +1,395 @@
+"""Append-log stream connector: the engine's streaming-source column.
+
+Reference: PAPER.md §1 lists streaming sources (Kafka) among the
+reference's connectors. The TPU translation keeps the part that
+matters to the engine — an APPEND-ONLY log whose read position is a
+monotone offset — and drops the broker: rows append to a host-RAM
+column log, the log's ``snapshot_version`` IS its offset, and readers
+choose between three composable views of the same data:
+
+  - a FULL scan (``pages``/``splits``): the log looks like any other
+    table, so every existing operator, cache path, and oracle harness
+    composes unchanged;
+  - a DELTA scan (``scan_from(offset)``): only the pages appended
+    since ``offset`` — the O(new rows) input of an incremental view
+    refresh (streaming/ivm.py);
+  - a PINNED window (``StreamWindowConnector``): a fixed ``[lo, hi)``
+    row range presented AS the table, whose snapshot token carries the
+    PINNED offset instead of the live head — so a result-cache entry
+    built at offset N keeps hitting for a reader pinned at N while
+    the log keeps growing (cache/rules.stream_watermark + the store's
+    advance-on-append reclaim).
+
+Offsets are row counts: ``append(table, rows)`` extends the columns
+under the connector's condition and wakes every ``wait_for_offset``
+long-poller (the tailing /v1/statement cursors). Appends never rewrite
+existing rows, and dictionary codes are assigned in FIRST-SEEN order
+and only ever appended — the encoded prefix of the log is immutable,
+which is what makes pinned-offset replays byte-stable.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import (
+    ColumnSchema,
+    Connector,
+    Split,
+    TableSchema,
+)
+from presto_tpu.obs.sanitizer import (
+    make_condition,
+    register_owner,
+)
+from presto_tpu.page import Dictionary, Page
+
+
+class _StreamTable:
+    """One append-only log: per-column Python value lists plus
+    first-seen-order dictionary value lists for encoded columns.
+    Mutated only under the owning connector's condition; the prefix
+    below the published offset is immutable."""
+
+    def __init__(self, schema: TableSchema):
+        self.schema = schema
+        self.columns: List[list] = [[] for _ in schema.columns]
+        # encoded columns: value list in FIRST-SEEN order (append-only
+        # — codes for already-appended rows never change), with a
+        # persistent membership set so an append costs O(batch), not
+        # O(total distinct values)
+        self.dict_values: Dict[str, list] = {
+            c.name: [] for c in schema.columns
+            if c.type.is_dictionary_encoded
+        }
+        self._dict_seen: Dict[str, set] = {
+            name: set() for name in self.dict_values
+        }
+        self.offset = 0  # rows appended so far == snapshot offset
+        self.appends = 0
+
+    def extend(self, rows: Sequence[tuple]) -> None:
+        # validate the WHOLE batch before mutating anything: a
+        # mid-batch failure must never leave orphan rows below the
+        # published offset (the prefix is immutable by contract)
+        for r in rows:
+            if len(r) != len(self.columns):
+                raise ValueError(
+                    f"row arity {len(r)} != schema arity "
+                    f"{len(self.columns)} for stream "
+                    f"{self.schema.name!r}"
+                )
+        for r in rows:
+            for col, v in zip(self.columns, r):
+                col.append(v)
+        for name, seen in self._dict_seen.items():
+            idx = self.schema.column_index(name)
+            vals = self.dict_values[name]
+            for v in self.columns[idx][self.offset:]:
+                if v is not None and v not in seen:
+                    vals.append(v)
+                    seen.add(v)
+        self.offset += len(rows)
+        self.appends += 1
+
+
+class StreamConnector(Connector):
+    """See module docstring. ``append_only`` marks the connector for
+    the cache plane (runner._invalidate_caches advances instead of
+    discarding) and the tailing-cursor statement path."""
+
+    name = "stream"
+    append_only = True
+
+    # lock discipline (tools/lint `locks` rule): the table map is
+    # shared between appender threads, scan readers, and tail pollers
+    _shared_attrs = ("_tables",)
+
+    def __init__(self):
+        self._tables: Dict[str, _StreamTable] = {}
+        # one condition for the whole connector: appends notify every
+        # tailing long-poller (per-table conditions would force the
+        # registry to grow per CREATE, for no contention win at this
+        # fan-in)
+        self._cv = make_condition(
+            "connectors.stream.StreamConnector._cv")
+        register_owner(self, lock_attrs=("_cv",))
+
+    # ------------------------------------------------------------ write
+    def create_table(
+        self,
+        name: str,
+        column_names: Sequence[str],
+        column_types: Sequence[T.SqlType],
+        rows: List[tuple],
+        *,
+        replace: bool = False,
+    ) -> int:
+        """CTAS entry (runner write path): a new log seeded with
+        ``rows`` at offset len(rows). ``replace`` restarts the log —
+        offsets restart too, so replace is a DDL event, not an append
+        (pinned readers of the old log are invalidated by the runner's
+        write path, same as DROP)."""
+        schema = TableSchema(
+            name,
+            tuple(
+                ColumnSchema(n, t)
+                for n, t in zip(column_names, column_types)
+            ),
+        )
+        with self._cv:
+            if name in self._tables and not replace:
+                raise ValueError(f"stream already exists: {name}")
+            t = _StreamTable(schema)
+            t.extend(list(rows))
+            self._tables[name] = t
+            self._cv.notify_all()
+        return len(rows)
+
+    def insert(self, name: str, rows: List[tuple]) -> int:
+        """INSERT INTO == append (the runner's write path)."""
+        self.append(name, rows)
+        return len(rows)
+
+    def append(self, table: str, rows: Sequence[tuple]) -> int:
+        """THE log write: extend the columns, advance the offset,
+        wake every tailing long-poller. Returns the new offset."""
+        with self._cv:
+            t = self._tables.get(table)
+            if t is None:
+                raise KeyError(f"no stream {table!r}")
+            t.extend(list(rows))
+            self._cv.notify_all()
+            return t.offset
+
+    def drop_table(self, name: str) -> None:
+        with self._cv:
+            if name not in self._tables:
+                raise KeyError(f"no stream {name!r}")
+            del self._tables[name]
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------- read
+    def tables(self) -> List[str]:
+        with self._cv:
+            return sorted(self._tables)
+
+    def table_schema(self, table: str) -> TableSchema:
+        t = self._tables.get(table)
+        if t is None:
+            raise KeyError(f"no stream {table!r}")
+        return t.schema
+
+    def row_count(self, table: str) -> int:
+        return self.offset(table)
+
+    def offset(self, table: str) -> int:
+        """The log's current offset (== rows appended). THE monotone
+        value snapshot_version, delta scans, IVM watermarks, and tail
+        cursors all key on."""
+        with self._cv:
+            t = self._tables.get(table)
+            return t.offset if t is not None else 0
+
+    def appends_seen(self, table: str) -> int:
+        with self._cv:
+            t = self._tables.get(table)
+            return t.appends if t is not None else 0
+
+    def snapshot_version(self, table: str) -> Optional[str]:
+        """``off:<offset>`` — monotone by construction. A write moves
+        it forward (never sideways), which is what lets the cache
+        plane ADVANCE entries over this connector instead of
+        discarding them (cache/store.advance_tables)."""
+        with self._cv:
+            t = self._tables.get(table)
+            if t is None:
+                return None
+            return f"off:{t.offset}"
+
+    def pinned_offset(self, table: str) -> Optional[int]:
+        """None: a bare StreamConnector scan reads the LIVE log head
+        (its cache entries key to the moving offset token and are
+        reclaimed on append). StreamWindowConnector overrides with its
+        pinned upper bound — the cache/rules.stream_watermark probe."""
+        return None
+
+    def wait_for_offset(self, table: str, min_offset: int,
+                        timeout_s: float) -> int:
+        """Long-poll until the log advances PAST ``min_offset`` (or
+        the timeout lapses); returns the current offset either way.
+        The tailing-cursor poll primitive — Condition.wait releases
+        the connector lock, so appenders are never blocked by
+        pollers."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        with self._cv:
+            while True:
+                t = self._tables.get(table)
+                cur = t.offset if t is not None else 0
+                if cur > min_offset:
+                    return cur
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return cur
+                self._cv.wait(remaining)
+
+    # ------------------------------------------------------ page plane
+    def _snapshot_slice(self, table: str, lo: int, hi: int,
+                        columns: Optional[Sequence[str]]):
+        """(names, value slices, types, dictionaries) for rows
+        [lo, hi) — taken under the condition so a concurrent append
+        can never tear a slice (the prefix itself is immutable)."""
+        with self._cv:
+            t = self._tables.get(table)
+            if t is None:
+                raise KeyError(f"no stream {table!r}")
+            names = (
+                tuple(columns) if columns is not None
+                else tuple(t.schema.column_names())
+            )
+            cols, types, dicts = [], [], []
+            for nm in names:
+                idx = t.schema.column_index(nm)
+                cols.append(list(t.columns[idx][lo:hi]))
+                types.append(t.schema.columns[idx].type)
+                dv = t.dict_values.get(nm)
+                dicts.append(
+                    Dictionary(list(dv)) if dv is not None else None
+                )
+            return names, cols, types, dicts
+
+    def page_for_split(
+        self, split: Split, columns: Optional[Sequence[str]] = None
+    ) -> Page:
+        lo = split.start_row
+        _names, cols, types, dicts = self._snapshot_slice(
+            split.table, lo, lo + split.row_count, columns
+        )
+        return Page.from_arrays(cols, types, dictionaries=dicts)
+
+    def scan_from(
+        self,
+        table: str,
+        offset: int,
+        columns: Optional[Sequence[str]] = None,
+        target_rows: int = 1 << 20,
+    ):
+        """Delta pages: only the rows appended since ``offset``, up to
+        the offset observed at call time (appends racing the scan show
+        up in the NEXT delta). The incremental-refresh input plane."""
+        hi = self.offset(table)
+        lo = min(max(int(offset), 0), hi)
+        start = lo
+        while start < hi:
+            n = min(target_rows, hi - start)
+            yield self.page_for_split(
+                Split(table, start, n), columns
+            )
+            start += n
+
+    def host_rows(self, table: str, target_rows: int = 1 << 20):
+        """Row tuples for the sqlite oracle (tests/oracle.py)."""
+        hi = self.offset(table)
+        with self._cv:
+            t = self._tables[table]
+            return list(zip(*[c[:hi] for c in t.columns])) \
+                if t.columns and hi else []
+
+
+class StreamWindowConnector:
+    """A PINNED ``[lo, hi)`` row window of one stream table, presented
+    AS the table: splits/pages/row_count cover exactly the window, and
+    the snapshot token carries the pinned range instead of the live
+    offset — so two readers pinned at the same range share cache
+    entries FOREVER, no matter how far the log has advanced (the
+    monotone-offset-token fix, ISSUE 14 satellite). The range is
+    mutable via ``set_range`` so one wrapper (and one executor whose
+    catalogs hold it) serves every refresh of a view: delta refreshes
+    pin [watermark, head), full recomputes pin [0, head).
+
+    Non-window tables delegate to the inner connector untouched."""
+
+    append_only = True
+
+    def __init__(self, inner, table: str, lo: int = 0,
+                 hi: Optional[int] = None):
+        self._inner = inner
+        self._table = table
+        self._lo = int(lo)
+        self._hi = int(hi if hi is not None
+                       else inner.offset(table) if table in
+                       inner.tables() else 0)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def set_range(self, lo: int, hi: int) -> None:
+        self._lo, self._hi = int(lo), int(hi)
+
+    def snapshot_version(self, table: str) -> Optional[str]:
+        if table != self._table:
+            return self._inner.snapshot_version(table)
+        # the PINNED token: stable while the log advances — monotone
+        # offsets make "the prefix I asked for" a permanent identity
+        return f"off:{self._hi}@{self._lo}"
+
+    def pinned_offset(self, table: str) -> Optional[int]:
+        if table != self._table:
+            inner = getattr(self._inner, "pinned_offset", None)
+            return inner(table) if inner is not None else None
+        return self._hi
+
+    def row_count(self, table: str) -> int:
+        if table != self._table:
+            return self._inner.row_count(table)
+        return max(self._hi - self._lo, 0)
+
+    def offset(self, table: str) -> int:
+        if table != self._table:
+            return self._inner.offset(table)
+        return self._hi
+
+    def splits(self, table: str, target_rows: int) -> List[Split]:
+        if table != self._table:
+            return self._inner.splits(table, target_rows)
+        # the base chopper over THIS wrapper's windowed row_count
+        # (page_for_split shifts the ranges into the pinned window)
+        return Connector.splits(self, table, target_rows)
+
+    def page_for_split(
+        self, split: Split, columns: Optional[Sequence[str]] = None
+    ) -> Page:
+        if split.table != self._table:
+            return self._inner.page_for_split(split, columns)
+        shifted = Split(split.table, split.start_row + self._lo,
+                        split.row_count)
+        return self._inner.page_for_split(shifted, columns)
+
+    def prune_splits(self, table, splits, constraint):
+        if table != self._table:
+            return self._inner.prune_splits(table, splits, constraint)
+        return splits  # advisory; the residual Filter re-applies
+
+    def pages(
+        self,
+        table: str,
+        columns: Optional[Sequence[str]] = None,
+        target_rows: int = 1 << 20,
+        constraint=None,
+    ):
+        # must re-implement (not delegate): the inner pages() would
+        # use the inner splits() and bypass the window
+        splits = self.splits(table, target_rows)
+        if constraint:
+            splits = self.prune_splits(table, splits, constraint)
+        for split in splits:
+            if split.row_count:
+                yield self.page_for_split(split, columns)
+
+    def host_rows(self, table: str, target_rows: int = 1 << 20):
+        if table != self._table:
+            return self._inner.host_rows(table, target_rows)
+        rows = self._inner.host_rows(table, target_rows)
+        return rows[self._lo:self._hi]

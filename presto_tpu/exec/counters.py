@@ -158,6 +158,27 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "placements (dist/spool.local_source_pages — no HTTP, no "
         "serde) and DistExecutor collective exchanges compiled onto "
         "the mesh (all_to_all/all_gather; executor lifetime)"),
+    "delta_pages_folded": (
+        "counter", "delta partial-state pages folded into persisted "
+        "materialized-view state by incremental refreshes "
+        "(streaming/ivm.py — the O(new rows) refresh input; executor "
+        "lifetime)"),
+    "ivm_refreshes": (
+        "counter", "incremental materialized-view refreshes completed "
+        "(delta fold through the partial-agg kernels + finalize; "
+        "streaming/ivm.py)"),
+    "ivm_full_recomputes": (
+        "counter", "view refreshes that fell back to a FULL recompute "
+        "(non-IVM-safe plan shape or ivm_enabled=false) — the loud, "
+        "counted degradation path, never a silent wrong answer"),
+    "cursor_polls": (
+        "counter", "tailing /v1/statement cursor polls served "
+        "(stream_tail_enabled; each poll long-polls the append log "
+        "and emits only delta-derived rows)"),
+    "stream_appends_seen": (
+        "counter", "append batches observed on append-only stream "
+        "connectors: the runner's INSERT advance path plus tail "
+        "polls that saw the log offset move"),
     "trace_spans": (
         "gauge", "spans recorded into this query's lifecycle trace "
         "(obs/trace.py; pinned 0 when tracing is off)"),

@@ -587,6 +587,24 @@ class Executor:
         # lifetime, like the spooled-exchange counters).
         self.device_exchange = "auto"
         self.mesh_local_exchanges = 0
+        # ---- streaming subsystem (ISSUE 14, presto_tpu/streaming/ +
+        # connectors/stream.py): lifetime counters mirrored onto the
+        # executor so every surface (EXPLAIN ANALYZE, /metrics,
+        # system.metrics, analyze_rung, loadbench) renders refresh
+        # activity. delta_pages_folded = delta partial-state pages an
+        # IVM refresh folded into persisted view state (O(new rows)
+        # work); ivm_refreshes = incremental refreshes completed;
+        # ivm_full_recomputes = refreshes that fell back to a full
+        # recompute (non-IVM-safe plan or ivm_enabled=false — loud,
+        # never silent); cursor_polls = tailing /v1/statement cursor
+        # polls served; stream_appends_seen = append batches the
+        # engine observed on append-only stream connectors (write
+        # path + tail polls that saw the offset advance).
+        self.delta_pages_folded = 0
+        self.ivm_refreshes = 0
+        self.ivm_full_recomputes = 0
+        self.cursor_polls = 0
+        self.stream_appends_seen = 0
 
     # ------------------------------------------------------------ plumbing
     def count_listener_error(self) -> None:
@@ -647,6 +665,39 @@ class Executor:
         as count_listener_error: the increment lives on the executor
         so every counter surface renders it."""
         self.result_cache_invalidations += n
+
+    # The four streaming sinks below may be hit from CONCURRENT
+    # threads (tail-cursor polls on protocol handler threads, the
+    # loadbench writer pool) sharing one bootstrap executor: the
+    # increments are plain GIL-guarded adds, so a lost increment
+    # under contention is an acceptable METRIC error, never a
+    # correctness one — the exec/xfer.py process-totals stance.
+    def count_ivm_refresh(self, full: bool = False) -> None:
+        """Registry-counter sink for streaming/ivm.refresh: one
+        incremental refresh completed, or — ``full`` — one loud
+        full-recompute fallback (non-IVM-safe plan, or ivm_enabled
+        off)."""
+        if full:
+            self.ivm_full_recomputes += 1
+        else:
+            self.ivm_refreshes += 1
+
+    def count_delta_pages(self, n: int) -> None:
+        """Registry-counter sink for the IVM delta fold: ``n`` delta
+        partial-state pages folded into persisted view state this
+        refresh (streaming/ivm.refresh)."""
+        self.delta_pages_folded += n
+
+    def count_cursor_poll(self) -> None:
+        """Registry-counter sink for the tailing /v1/statement cursor
+        plane (server/http_server.TailCursor.poll)."""
+        self.cursor_polls += 1
+
+    def count_stream_append(self) -> None:
+        """Registry-counter sink for append batches observed on
+        append-only stream connectors: the runner's INSERT advance
+        path and tail polls that saw the offset move."""
+        self.stream_appends_seen += 1
 
     def _trace_operators(self, tr, att_span) -> None:
         """Emit per-plan-node operator spans from the successful
@@ -1926,9 +1977,12 @@ class Executor:
             return
         from presto_tpu.cache import select_cache_points
 
+        from presto_tpu.cache.rules import stream_watermark
+
         salt = f"k{self.collect_k}.p{self.page_rows}"
         self._cache_points = {
-            i: (f"{key}:{salt}", n, tables)
+            i: (f"{key}:{salt}", n, tables,
+                stream_watermark(tables, self.catalogs))
             for i, (key, n, tables) in select_cache_points(
                 node, self.catalogs,
                 root_only=type(self).__name__ != "Executor",
@@ -1945,7 +1999,7 @@ class Executor:
         An abandoned stream (downstream Limit stopped consuming) never
         reaches the staging append, so partial page sets cannot be
         published."""
-        key, _node_ref, tables = entry
+        key, _node_ref, tables, watermark = entry
         tr = self.trace
         t0 = tr.now() if tr is not None else 0.0
         host_pages = self.result_cache.get_pages(key)
@@ -1998,7 +2052,7 @@ class Executor:
                 yield page
         finally:
             self._cache_inflight.discard(id(node))
-        self._cache_pending.append((key, collected, tables))
+        self._cache_pending.append((key, collected, tables, watermark))
 
     def _publish_cache_pending(self) -> None:
         """Publish the attempt's completed cache streams — called by
@@ -2010,9 +2064,9 @@ class Executor:
         cache = self.result_cache
         if cache is None:
             return
-        for key, pages, tables in pending:
+        for key, pages, tables, watermark in pending:
             self.result_cache_evictions += cache.put_pages(
-                key, pages, tables
+                key, pages, tables, watermark=watermark
             )
 
     def _overflow_flagged(self) -> bool:
@@ -2361,6 +2415,104 @@ class Executor:
         out, overflow = fn(merged, fcap, 64 * self._capacity_boost)
         self._pending_overflow.append(overflow)
         yield out
+
+    # ------------------------------------------------ IVM kernel plane
+    def ivm_delta_states(self, partial_node: P.Aggregation) -> List:
+        """Run a view's partial-step aggregation over the delta
+        window (the executor's catalogs hold the pinned
+        StreamWindowConnector) and return HOST copies of its
+        partial-state pages — the O(new rows) half of an incremental
+        view refresh (streaming/ivm.py). Rides stream_fragment's
+        overflow ladder, the fused scan→partial-agg path where the
+        chain fuses, and the same canonical jit-cache entries a cold
+        single-step run compiles."""
+        return self.stream_fragment(
+            partial_node,
+            emit=lambda p: XF.to_host(p, label="ivm-delta"),
+        )
+
+    def ivm_fold_finalize(self, node: P.Aggregation, state_pages,
+                          cap_hint: Optional[int] = None):
+        """Merge partial-state pages (host pytrees: the persisted
+        settled state plus this refresh's delta states) into ONE
+        settled partial state and finalize it — the other half of an
+        IVM refresh. Reuses the exact agg_merge / agg_final kernels
+        (and canonical jit keys) the single-step aggregation path
+        compiles, under a local boost ladder: a state overflow re-
+        stages and retries at the next shapes.py rung, same escape as
+        every other capacity decision. Returns
+        ``(settled_host_state_page, final_host_page)`` — the settled
+        state is pulled to host BEFORE finalization because the
+        final-step program donates its input buffer on TPU.
+
+        ``cap_hint`` (the view's OBSERVED group cardinality from its
+        last finalize) sizes the settled state tightly: the planner's
+        capacity estimate derives from the LOG's row count and would
+        pin an ever-growing state page to O(log) slots — the refresh
+        must stay O(delta) + O(groups), so the state compacts to the
+        observed cardinality and true growth overflows onto the boost
+        ladder like every other capacity decision."""
+        if not state_pages:
+            raise ValueError("ivm_fold_finalize needs >=1 state page")
+        in_types = self._agg_in_types(node)
+        layouts = [
+            S.state_layout(s.function, t)
+            for s, t in zip(node.aggregates, in_types)
+        ]
+        layouts_t = tuple(tuple(l) for l in layouts)
+        nkeys = len(node.group_channels)
+        boost = 1
+        for _ in range(6):
+            max_iters = 64 * boost
+            collect_k = self.collect_k * boost
+            merge_fn = self._jit(
+                ("agg_merge", node.aggregates, layouts_t, nkeys,
+                 collect_k),
+                functools.partial(
+                    _merge_partials_page, node.aggregates, layouts_t,
+                    nkeys, collect_k=collect_k,
+                ),
+                static_argnums=(1, 2),
+                donate_argnums=(0,),
+            )
+            final_fn = self._jit(
+                ("agg_final", node.group_channels, node.aggregates,
+                 layouts_t, tuple(in_types),
+                 self._agg_extra_types(node), collect_k),
+                functools.partial(
+                    _final_agg_page, node.group_channels,
+                    node.aggregates, layouts_t, tuple(in_types),
+                    collect_k=collect_k,
+                    extra_types=self._agg_extra_types(node),
+                ),
+                static_argnums=(1, 2),
+                donate_argnums=(0,),
+            )
+            # re-stage per attempt: the merge program donates its
+            # concat input, so a boosted retry must rebuild it
+            staged = [XF.to_device(p, label="ivm-state")
+                      for p in state_pages]
+            merged = (concat_all(staged) if len(staged) > 1
+                      else staged[0])
+            self._account_page(merged)
+            base = (cap_hint if cap_hint else node.capacity)
+            cap = _next_pow2(max(base, 8) * boost)
+            mcap = min(cap, _next_pow2(merged.capacity))
+            settled, ovf = merge_fn(merged, mcap, max_iters)
+            if bool(ovf):
+                boost = SH.next_boost(boost)
+                continue
+            # host copy FIRST: final_fn donates the settled buffer
+            settled_host = XF.to_host(settled, label="ivm-state")
+            fcap = min(cap, _next_pow2(settled.capacity))
+            final, ovf = final_fn(settled, fcap, max_iters)
+            if bool(ovf):
+                boost = SH.next_boost(boost)
+                continue
+            return settled_host, XF.to_host(final, label="ivm-final")
+        raise RuntimeError(
+            "IVM state fold overflow persisted after 6 boosted retries"
+        )
 
     def _exec_aggregation(self, node: P.Aggregation) -> Iterator[Page]:
         if node.step == "partial":

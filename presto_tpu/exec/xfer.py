@@ -126,6 +126,17 @@ TRANSFER_REGISTRY: Dict[str, Tuple[str, str, str]] = {
         "device consumers (h2d); root-sink hits serve host pages "
         "directly — zero crossings — and read row counts host-side "
         "for the stats plane (d2h on device pages only)"),
+    "exec.executor.Executor.ivm_delta_states": (
+        "d2h", "data",
+        "IVM refresh delta fold: partial-state pages of the delta "
+        "window pull to host for persistence as view state "
+        "(streaming/ivm.py; O(new rows) per refresh)"),
+    "exec.executor.Executor.ivm_fold_finalize": (
+        "h2d+d2h", "data",
+        "IVM state merge/finalize: persisted host state pages "
+        "re-stage for the agg_merge/agg_final kernels (h2d), the "
+        "settled state and finalized result pull back for "
+        "persistence and row decode (d2h)"),
     "exec.pagestore.PageStore.put": (
         "d2h", "data",
         "host/disk spill tiers pull materialized pages off the device "

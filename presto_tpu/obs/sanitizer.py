@@ -64,6 +64,9 @@ LOCK_REGISTRY: Dict[str, str] = {
         "accounting, LRU order, tallies",
     "cache.store._shared_lock":
         "creation of THE per-process shared ResultCache instance",
+    "connectors.stream.StreamConnector._cv":
+        "the append-log table map + offset advance; appends "
+        "notify_all so tailing long-pollers (wait_for_offset) wake",
     "compilecache._lock":
         "process-wide XLA compile/cache counters fed by jax.monitoring "
         "listeners",
@@ -101,6 +104,21 @@ LOCK_REGISTRY: Dict[str, str] = {
     "server.worker._Task.lock":
         "one task's result buffers and lifecycle flags (executor "
         "thread vs fetch/status/cancel handlers)",
+    "server.http_server.TailCursor._cv":
+        "one tailing cursor's emitted rows / token spans / poll "
+        "serialization flag (concurrent protocol GETs on one "
+        "cursor); the poll's query execution runs UNLOCKED behind "
+        "the _polling flag",
+    "streaming.ivm.IvmRegistry._lock":
+        "the materialized-view registry (register/lookup by name "
+        "and by statement shape fingerprint)",
+    "streaming.ivm.MaterializedView._cv":
+        "one view's persisted state/watermark/last-result "
+        "publication + refresh serialization flag; the refresh "
+        "itself (delta scan, fold, finalize) runs UNLOCKED behind "
+        "_refreshing so concurrent tailers coalesce",
+    "streaming.ivm._shared_lock":
+        "creation of THE per-process shared IvmRegistry instance",
 }
 
 THREAD_REGISTRY: Dict[str, str] = {

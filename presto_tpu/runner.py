@@ -545,7 +545,13 @@ class LocalRunner:
                     column_types=["bigint"],
                 )
             n = conn.insert(table, rows)
-            self._invalidate_caches(cat, table)
+            # append-only stream connectors ADVANCE instead of
+            # invalidate: watermarked (pinned-prefix / IVM) entries
+            # stay servable, live-head entries reclaim (ISSUE 14)
+            self._invalidate_caches(
+                cat, table,
+                append=getattr(conn, "append_only", False),
+            )
             return QueryResult(["rows"], [(n,)], update_type="INSERT",
                                column_types=["bigint"])
         if isinstance(stmt, N.Explain):
@@ -652,20 +658,33 @@ class LocalRunner:
         ))
         return f"stmt:{fp}", frozenset(tables)
 
-    def _invalidate_caches(self, catalog: str, table: str) -> None:
+    def _invalidate_caches(self, catalog: str, table: str,
+                           append: bool = False) -> None:
         """THE write-path invalidation hub: after any DML/CTAS/DROP
         through this runner, (a) eagerly reclaim result-cache entries
         that read the written table (their keys are already
         unreachable — snapshot_version moved — this frees the bytes
         now), and (b) drop a wrapping page cache's stale lists
         (connectors/cached.py registers via invalidate()/drop_cache()).
-        Counted on the result_cache_invalidations registry counter."""
+        Counted on the result_cache_invalidations registry counter.
+
+        ``append`` (INSERT into an append-only stream, ISSUE 14)
+        switches (a) to the ADVANCE model: only live-head entries
+        reclaim — watermarked pinned-prefix and IVM-view entries
+        still describe exactly the prefix they cover and survive the
+        write (cache/store.advance_tables)."""
         from presto_tpu.cache import shared_cache_if_exists
 
         n = 0
         rc = shared_cache_if_exists()
         if rc is not None:
-            n += rc.invalidate_tables({(catalog, table)})
+            if append:
+                n += rc.advance_tables({(catalog, table)})
+            else:
+                n += rc.invalidate_tables({(catalog, table)})
+        if append:
+            # streaming observability: the engine saw one append batch
+            self.executor.count_stream_append()
         conn = self.catalogs.get(catalog)
         inv = getattr(conn, "invalidate", None)
         if inv is not None:

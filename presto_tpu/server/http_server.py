@@ -33,6 +33,10 @@ from presto_tpu.obs.sanitizer import (
 from presto_tpu.session import SYSTEM_SESSION_PROPERTIES, Session
 
 _PAGE_ROWS = 4096  # rows per protocol fetch (client paging granularity)
+# tailing cursors retain only this many recent token spans' rows —
+# the retry horizon; a never-finishing cursor must not hold every row
+# it ever emitted (clients only ever re-fetch their latest token)
+_TAIL_RETAIN_SPANS = 8
 
 
 class _Query:
@@ -62,6 +66,9 @@ class _Query:
         # read off the runner's executor (see QueryManager.query_info)
         self.trace = None
         self.runner = None
+        # tailing cursor (ISSUE 14): non-None turns this query into a
+        # never-finishing stream cursor served by _tail_results
+        self.tail: Optional["TailCursor"] = None
 
     def _finish_clock(self) -> None:
         if self.finished_at is None:
@@ -82,6 +89,305 @@ class _Query:
             "error": self.error,
             "rowCount": len(self.rows),
         }
+
+
+class TailCursor:
+    """One tailing /v1/statement cursor over an append-only stream
+    (ISSUE 14): the statement's nextUri never terminates — each poll
+    long-polls the log (StreamConnector.wait_for_offset) and emits
+    ONLY rows derived from new offsets. Three poll strategies, chosen
+    once at creation from the planned statement:
+
+      view       the statement IS a registered materialized view
+                 (shape-fingerprint match, streaming/ivm.py): polls
+                 ride the incremental refresh — O(new rows) fold —
+                 and emit the multiset delta of the refreshed result
+                 vs the previously emitted snapshot (changed/new
+                 aggregate rows, the live-dashboard diff);
+      delta      a pure per-row pipeline (Output → Filter/Project* →
+                 stream scan): polls execute the plan over the pinned
+                 [last, head) window only — exactly the new rows, no
+                 recompute, no diff;
+      recompute  anything else over a stream: polls re-execute the
+                 statement and emit the multiset delta — degraded
+                 (O(full) per poll) but never wrong, the same
+                 loud-fallback stance as non-IVM-safe views.
+
+    Concurrency: protocol GETs may race on one cursor. State mutates
+    only under ``_cv``; the poll's query execution runs UNLOCKED
+    behind the ``_polling`` flag (concurrent pollers wait, then read
+    the freshly appended span) so no blocking work ever happens under
+    an engine lock. Token paging is span-addressed: token t re-serves
+    its recorded row span verbatim (retry-safe), the first fresh
+    token takes everything new."""
+
+    # lock discipline (tools/lint `locks` rule)
+    _shared_attrs = ("columns", "types", "rows", "error", "closed",
+                     "_polling", "_spans", "last_rows", "last_offset",
+                     "_base", "_span_base", "resource_group")
+
+    def __init__(self, runner, plan, streams, sink):
+        from presto_tpu.streaming import ivm as IVM
+
+        self.runner = runner
+        self.plan = plan
+        # every append-only table the statement scans: polls wake on
+        # ANY of them advancing (view/delta modes have exactly one by
+        # construction; recompute mode may join several streams)
+        self.streams = list(streams)
+        self.catalog, self.table = self.streams[0]
+        self.conn = runner.catalogs[self.catalog]
+        self.sink = sink  # bootstrap executor: registry counters
+        self.poll_ms = int(runner.session.get("stream_poll_ms"))
+        reg = IVM.shared_registry_if_exists()
+        self.view = reg.match(plan) if reg is not None else None
+        self.window = None
+        self.executor = None
+        if self.view is not None:
+            self.mode = "view"
+        elif self._delta_shape(plan):
+            self.mode = "delta"
+            self.executor, self.window = IVM.windowed_executor(
+                runner.catalogs, self.catalog, self.table,
+                like=runner.executor,
+            )
+        else:
+            self.mode = "recompute"
+        self.columns: Optional[List[Dict]] = None
+        self.types: List[str] = []
+        # emitted rows, trimmed to the retry horizon: _base is the
+        # ABSOLUTE index of rows[0] — a never-finishing cursor must
+        # not retain every row it ever emitted (spans older than
+        # _TAIL_RETAIN_SPANS tokens are beyond any client retry)
+        self.rows: List[tuple] = []
+        self._base = 0
+        # recent token spans only (ABSOLUTE (lo, hi) row indices);
+        # _span_base counts the spans trimmed off the front — an idle
+        # cursor heartbeats one span per poll forever, so the span
+        # list is bounded exactly like the rows it addresses
+        self._spans: List[tuple] = []
+        self._span_base = 0
+        self.last_rows: List[tuple] = []  # last full result (diff)
+        # SUM of offsets across all scanned streams (single-stream
+        # cursors: just that table's offset)
+        self.last_offset = 0
+        self.error: Optional[Dict] = None
+        self.closed = False
+        self._polling = False
+        # resource-group admission slot (start_tail admits a tailing
+        # statement through the same queue gate as submit(); close
+        # releases it) — the manager reference rides along so close
+        # can release without reaching back into the server
+        self.resource_group = None
+        self._rg_manager = None
+        self._cv = make_condition(
+            "server.http_server.TailCursor._cv")
+        register_owner(self, lock_attrs=("_cv",))
+
+    def _delta_shape(self, plan) -> bool:
+        """True for Output → (Filter|Project)* → TableScan of THE
+        stream table — the shape whose delta-window execution equals
+        the delta of its results."""
+        from presto_tpu.exec import plan as P
+
+        node = plan
+        if not isinstance(node, P.Output):
+            return False
+        node = node.source
+        while isinstance(node, (P.Filter, P.Project)):
+            node = node.source
+        return (isinstance(node, P.TableScan)
+                and node.catalog == self.catalog
+                and node.table == self.table)
+
+    # ------------------------------------------------------- polling
+    def _offsets_total(self) -> int:
+        return sum(self.runner.catalogs[c].offset(t)
+                   for c, t in self.streams)
+
+    def _wait_any(self, timeout_s: float) -> int:
+        """Long-poll until ANY scanned stream advances past the last
+        observed offsets (or the timeout lapses); returns the summed
+        offset. Single-stream cursors ride the connector's condition;
+        multi-stream recompute cursors poll in slices (appends to
+        EITHER side of a stream join must produce rows)."""
+        base = self.last_offset
+        if len(self.streams) == 1:
+            c, t = self.streams[0]
+            self.runner.catalogs[c].wait_for_offset(t, base, timeout_s)
+            return self._offsets_total()
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while True:
+            total = self._offsets_total()
+            remaining = deadline - time.monotonic()
+            if total > base or remaining <= 0:
+                return total
+            time.sleep(min(0.05, remaining))
+
+    def poll(self, timeout_s: float) -> None:
+        """Advance the cursor: wait for new offsets (up to
+        ``timeout_s``), compute the delta-derived rows, append them.
+        Serialized by the ``_polling`` flag; a failure closes the
+        cursor with an error body (the protocol's FAILED contract —
+        never a dropped connection)."""
+        with self._cv:
+            while self._polling and not self.closed:
+                self._cv.wait(0.05)
+            if self.closed:
+                return
+            self._polling = True
+        new_rows: List[tuple] = []
+        full: Optional[List[tuple]] = None
+        err = None
+        cols = None
+        types = None
+        offset = None
+        try:
+            new_rows, full, cols, types, offset = self._compute(
+                timeout_s)
+        except Exception as e:  # noqa: BLE001 - the protocol surfaces
+            # every tail failure as an error body on the cursor
+            err = {"message": str(e)[:2000],
+                   "errorName": type(e).__name__}
+        with self._cv:
+            self._polling = False
+            if err is not None:
+                self.error = err
+                self.closed = True
+            else:
+                if cols is not None and self.columns is None:
+                    self.columns = cols
+                    self.types = types or []
+                if full is not None:
+                    self.last_rows = full
+                if offset is not None:
+                    self.last_offset = offset
+                self.rows.extend(new_rows)
+            self._cv.notify_all()
+
+    def _compute(self, timeout_s: float):
+        """(delta rows, full result or None, columns or None, types,
+        new offset). Runs UNLOCKED — see class docstring."""
+        from presto_tpu.streaming import ivm as IVM
+
+        initial = self.columns is None
+        if initial:
+            hi = self._offsets_total()
+        else:
+            hi = self._wait_any(timeout_s)
+        self.sink.count_cursor_poll()
+        if not initial and hi <= self.last_offset:
+            return [], None, None, None, None  # quiet poll
+        if not initial:
+            # the log moved under a tailing cursor: one observed batch
+            self.sink.count_stream_append()
+        if self.mode == "view":
+            names, rows, types = IVM.refresh(
+                self.view, session=self.runner.session,
+                sink=self.sink)
+            delta = rows if initial else _multiset_delta(
+                rows, self.last_rows)
+            cols = [{"name": n, "type": t}
+                    for n, t in zip(names, types)]
+            return delta, list(rows), cols, types, hi
+        if self.mode == "delta":
+            ex = self.executor
+            self.window.set_range(
+                0 if initial else self.last_offset, hi)
+            names, rows = ex.execute(self.plan)
+            types = [str(t) for t in ex.output_types(self.plan)]
+            cols = [{"name": n, "type": t}
+                    for n, t in zip(names or [], types)]
+            return rows, None, cols, types, hi
+        # recompute: full statement re-execution + multiset diff —
+        # degraded loudly (every poll is a real run), never wrong
+        ex = self.runner.executor
+        names, rows = ex.execute(self.plan)
+        types = [str(t) for t in ex.output_types(self.plan)]
+        cols = [{"name": n, "type": t}
+                for n, t in zip(names or [], types)]
+        delta = rows if initial else _multiset_delta(
+            rows, self.last_rows)
+        return delta, list(rows), cols, types, hi
+
+    # ------------------------------------------------- token paging
+    def take_span(self, token: int):
+        """JSON rows for ``token``: a RECENT token re-serves its exact
+        recorded span (retry-safe); the next fresh token takes every
+        row emitted since the previous span. None for tokens further
+        ahead or already trimmed past the retry horizon (protocol
+        clients only ever retry their latest token)."""
+        with self._cv:
+            idx = token - self._span_base
+            if idx < 0:
+                return None  # trimmed: beyond the retry horizon
+            if idx < len(self._spans):
+                lo, hi = self._spans[idx]
+            elif idx == len(self._spans):
+                lo = self._spans[-1][1] if self._spans else self._base
+                hi = self._base + len(self.rows)
+                self._spans.append((lo, hi))
+                # bound the never-finishing cursor's memory: spans AND
+                # the rows they address drop past the retry horizon
+                # (spans keep ABSOLUTE indices; _base/_span_base track
+                # what rows[0]/_spans[0] correspond to)
+                if len(self._spans) > _TAIL_RETAIN_SPANS:
+                    drop = len(self._spans) - _TAIL_RETAIN_SPANS
+                    floor = self._spans[drop][0]
+                    del self._spans[:drop]
+                    self._span_base += drop
+                    if floor > self._base:
+                        del self.rows[:floor - self._base]
+                        self._base = floor
+            else:
+                return None
+            types = self.types
+            return [_json_row(r, types)
+                    for r in self.rows[lo - self._base:hi - self._base]]
+
+    def spans_served(self) -> int:
+        with self._cv:
+            return self._span_base + len(self._spans)
+
+    def close(self) -> None:
+        """Stop the cursor and RELEASE its heavy engine state (the
+        dedicated runner/executor, delta window, diff snapshot) and
+        its resource-group admission slot — the _Query record stays
+        in the manager registry like any finished query, but a closed
+        cursor must not pin an Executor. The already-emitted row tail
+        stays servable for the final page. Waits out an in-flight
+        poll (bounded by the poll timeout) so the engine refs are
+        never nulled under a running query."""
+        with self._cv:
+            self.closed = True
+            self._cv.notify_all()
+            while self._polling:
+                self._cv.wait(0.05)
+            self.last_rows = []
+            group, self.resource_group = self.resource_group, None
+        if group is not None and self._rg_manager is not None:
+            self._rg_manager.cancel_queued(group)
+        self.runner = None
+        self.executor = None
+        self.window = None
+        self.view = None
+
+
+def _multiset_delta(new_rows, old_rows):
+    """Rows of ``new_rows`` not covered by ``old_rows`` as a multiset
+    (repr-keyed: rows may carry unhashable nested values) — the
+    changed/new rows a dashboard diff emits per refresh."""
+    import collections
+
+    old = collections.Counter(map(repr, old_rows))
+    out = []
+    for r in new_rows:
+        k = repr(r)
+        if old[k] > 0:
+            old[k] -= 1
+        else:
+            out.append(r)
+    return out
 
 
 class MemoryArbiter:
@@ -219,6 +525,22 @@ class QueryManager:
 
     def get(self, qid: str) -> Optional[_Query]:
         return self._queries.get(qid)
+
+    def register_tail(self, sql: str, session: Session,
+                      cursor: TailCursor) -> _Query:
+        """Register a tailing cursor as a RUNNING query: it appears
+        in /v1/query and system.runtime_queries like any statement,
+        but no execution thread is spawned — polls ride the protocol
+        GET handlers (TailCursor.poll serializes them)."""
+        with self._lock:
+            self._seq += 1
+            qid = time.strftime("%Y%m%d_%H%M%S") + \
+                f"_{self._seq:05d}_{uuid.uuid4().hex[:5]}"
+            q = _Query(qid, sql, session)
+            q.tail = cursor
+            q.state = "RUNNING"
+            self._queries[qid] = q
+        return q
 
     def cancel(self, qid: str) -> bool:
         q = self._queries.get(qid)
@@ -626,9 +948,19 @@ class _Handler(BaseHTTPRequestHandler):
         from presto_tpu.security import AccessDeniedError
 
         try:
-            q = self.app.manager.submit(
-                sql, self._session_from_headers()
-            )
+            session = self._session_from_headers()
+            # tailing-cursor mode (ISSUE 14): the stream_tail_enabled
+            # session property (set per request via X-Presto-Session —
+            # the protocol's per-request flag — or via SET SESSION)
+            # turns a query over an append-only stream table into a
+            # never-finishing cursor; non-tailable statements fall
+            # through to the normal submit path
+            if bool(session.get("stream_tail_enabled")):
+                q = self.app.start_tail(sql, session)
+                if q is not None:
+                    self._send_json(self._tail_results(q, 0))
+                    return
+            q = self.app.manager.submit(sql, session)
         except QueryQueueFullError as e:
             self._send_json({
                 "error": {"message": str(e),
@@ -662,6 +994,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({"error": "no such query"}, 404)
                 return
             token = int(parts[3])
+            if q.tail is not None:
+                # tailing cursor: the poll IS the long-poll (it waits
+                # on the append log, not on query completion)
+                self._send_json(self._tail_results(q, token))
+                return
             # long-poll up to ~1s for progress (reference client behavior)
             q.done.wait(timeout=1.0)
             headers = {}
@@ -725,12 +1062,66 @@ class _Handler(BaseHTTPRequestHandler):
             return
         parts = [p for p in urlparse(self.path).path.split("/") if p]
         if parts[:2] == ["v1", "statement"] and len(parts) >= 3:
+            q = self.app.manager.get(parts[2])
             ok = self.app.manager.cancel(parts[2])
+            if q is not None and q.tail is not None:
+                # stop tailing: wake blocked pollers, final GET then
+                # serves the remaining rows with no nextUri
+                q.tail.close()
             self._send_json({"cancelled": ok})
             return
         self._send_json({"error": "not found"}, 404)
 
     # --------------------------------------------------------- protocol
+    def _tail_results(self, q: _Query, token: int) -> Dict:
+        """Protocol page for a tailing cursor (ISSUE 14): a fresh
+        token first POLLS (long-polling the append log up to
+        stream_poll_ms), then serves the rows the poll derived from
+        new offsets; known tokens re-serve their recorded span.
+        nextUri persists until the cursor is cancelled/closed — empty
+        pages with a fresh nextUri are the idle-tail heartbeat."""
+        cur = q.tail
+        base = f"http://{self.headers.get('Host', 'localhost')}"
+        out: Dict = {
+            "id": q.id,
+            "infoUri": f"{base}/v1/query/{q.id}",
+            "stats": {
+                "state": q.state,
+                "queued": False,
+                "elapsedTimeMillis": q.info()["elapsedTimeMillis"],
+                "tail": True,
+            },
+        }
+        fresh = token >= cur.spans_served()
+        if fresh and not q.cancelled and not cur.closed:
+            cur.poll(cur.poll_ms / 1000.0)
+        if cur.error is not None:
+            q.error = cur.error
+            q.state = "FAILED"
+            q._finish_clock()
+            q.done.set()
+            out["stats"]["state"] = "FAILED"
+            out["error"] = cur.error
+            return out
+        chunk = cur.take_span(token)
+        if chunk is None:
+            out["error"] = {
+                "message": f"unknown result token {token}",
+                "errorName": "INVALID_TOKEN",
+            }
+            return out
+        if cur.columns is not None:
+            out["columns"] = cur.columns
+        if chunk:
+            out["data"] = chunk
+        done = (q.cancelled or cur.closed) and \
+            token + 1 >= cur.spans_served()
+        if done:
+            out["stats"]["state"] = q.state
+        else:
+            out["nextUri"] = f"{base}/v1/statement/{q.id}/{token + 1}"
+        return out
+
     def _results(self, q: _Query, token: int) -> Dict:
         base = f"http://{self.headers.get('Host', 'localhost')}"
         out: Dict = {
@@ -1016,6 +1407,78 @@ class PrestoTpuServer:
         sys_conn.register(
             "metrics", [("name", V), ("value", B)], metrics,
         )
+
+    def start_tail(self, sql: str,
+                   session: Session) -> Optional[_Query]:
+        """Register a tailing cursor for ``sql`` when it is tailable
+        (ISSUE 14): a plain query, local engine, scanning at least
+        one append-only stream table. None otherwise — the statement
+        then runs the normal protocol path (which also surfaces its
+        parse/plan/access errors with the ordinary error body).
+        Tailing statements pass the SAME resource-group queue gate as
+        submitted ones (QueryQueueFullError surfaces as 429); the
+        slot releases when the cursor closes."""
+        rg = self.manager.resource_groups
+        group = rg.admit(session.user) if rg is not None else None
+        cursor = self.make_tail_cursor(sql, session)
+        if cursor is None:
+            if group is not None:
+                rg.cancel_queued(group)
+            return None
+        with cursor._cv:
+            cursor.resource_group = group
+        cursor._rg_manager = rg
+        return self.manager.register_tail(sql, session, cursor)
+
+    def make_tail_cursor(self, sql: str,
+                         session: Session) -> Optional[TailCursor]:
+        if self._mesh is not None:
+            return None  # tail cursors ride the local executor
+        # cheap pre-check before ANY planning work: a deployment with
+        # no append-only catalog can never tail — a session that left
+        # stream_tail_enabled on must not pay a throwaway runner and
+        # a second planning pass per ordinary statement
+        if not any(getattr(c, "append_only", False)
+                   for c in self.catalogs.values()):
+            return None
+        from presto_tpu.runner import LocalRunner
+        from presto_tpu.sql import ast_nodes as N
+        from presto_tpu.sql.parser import parse
+
+        try:
+            stmt = parse(sql)
+        except Exception:  # noqa: BLE001 - not tailable; the normal
+            return None    # path surfaces the parse error properly
+        if not isinstance(stmt, N.Query):
+            return None  # DDL/SET/EXPLAIN/... never tail
+        # dedicated runner (the concurrent-path shape): cursor polls
+        # run on protocol handler threads and must never race the
+        # serial bootstrap runner's queries
+        r = LocalRunner(
+            self.catalogs, default_catalog=self._default_catalog,
+            page_rows=self._page_rows, session=session,
+        )
+        r.executor._jit_cache = self._shared_jit_cache
+        r.views = self._runner.views
+        r.prepared = self._runner.prepared
+        r.access_control = self._runner.access_control
+        try:
+            r.access_control.check_can_execute_query(
+                session.user, sql)
+            r.apply_session()
+            plan = r._plan_statement_query(stmt)
+        except Exception:  # noqa: BLE001 - not tailable; the normal
+            return None    # path surfaces plan/access errors properly
+        from presto_tpu.cache.rules import scan_tables
+
+        streams = [
+            (c, t) for c, t in sorted(scan_tables(plan))
+            if getattr(r.catalogs.get(c), "append_only", False)
+        ]
+        if not streams:
+            return None  # nothing appends: a plain finite statement
+        return TailCursor(r, plan, streams,
+                          sink=self._runner.executor)
 
     def start(self) -> int:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)

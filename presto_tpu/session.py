@@ -417,6 +417,39 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0,
         ),
         PropertyMetadata(
+            "ivm_enabled",
+            "maintain registered materialized views incrementally "
+            "(streaming/ivm.py): a refresh folds ONLY the pages "
+            "appended since the view's offset watermark through the "
+            "partial-aggregation kernels into persisted settled "
+            "state — O(new rows) instead of a full recompute. false "
+            "forces full recomputes (counted loudly on "
+            "ivm_full_recomputes; results identical either way). "
+            "Non-IVM-safe view shapes always recompute in full",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "stream_tail_enabled",
+            "turn /v1/statement into a TAILING cursor for queries "
+            "over append-only stream tables (connectors/stream.py): "
+            "nextUri never terminates — each poll long-polls the log "
+            "and emits only rows derived from new offsets, riding "
+            "the incremental-view-maintenance path when the "
+            "statement matches a registered view's shape. Set per "
+            "request via the X-Presto-Session header (the protocol's "
+            "per-request flag) or session-wide via SET SESSION; "
+            "DELETE the statement to stop tailing",
+            bool, False,
+        ),
+        PropertyMetadata(
+            "stream_poll_ms",
+            "long-poll interval in milliseconds for tailing "
+            "/v1/statement cursors: a poll with no new offsets "
+            "returns an empty page (with a fresh nextUri) after this "
+            "long; an append wakes waiting pollers immediately",
+            int, 1000,
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

@@ -1,0 +1,15 @@
+"""Streaming subsystem (ISSUE 14): incremental view maintenance over
+append-log connectors. See streaming/ivm.py for the refresh engine and
+connectors/stream.py for the log itself; the tailing /v1/statement
+cursors live in server/http_server.py."""
+
+from presto_tpu.streaming.ivm import (  # noqa: F401
+    IvmRegistry,
+    MaterializedView,
+    ivm_unsafe_reason,
+    refresh,
+    shared_registry,
+    shared_registry_if_exists,
+    view_shape_fingerprint,
+    windowed_executor,
+)

@@ -212,6 +212,119 @@ def run_load(server: str, clients: int, duration_s: float,
     }
 
 
+def run_append_load(writers: int, readers: int, duration_s: float,
+                    rows_per_append: int, seed: int = 0) -> dict:
+    """Mixed streaming mode (ISSUE 14): ``writers`` threads advance an
+    append-log stream while ``readers`` threads refresh a registered
+    materialized view through the IVM path (streaming/ivm.py). Refresh
+    walls are measured per reader call (p50/p99) and the registry
+    counters (``ivm_refreshes`` / ``ivm_full_recomputes`` /
+    ``delta_pages_folded`` / ``stream_appends_seen``) come off the
+    shared counter-sink executor — the same numbers EXPLAIN ANALYZE,
+    /metrics, and system.metrics would render. This is also the
+    appender x tailer concurrency harness: run with ``--sanitize`` to
+    race the instrumented stream/view/cache locks deliberately."""
+    from presto_tpu import types as T
+    from presto_tpu.connectors.stream import StreamConnector
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.streaming import ivm as IVM
+
+    rng = random.Random(seed)
+    conn = StreamConnector()
+    conn.create_table(
+        "events", ["k", "v"], [T.BIGINT, T.DOUBLE],
+        [(rng.randrange(64), rng.random() * 100.0)
+         for _ in range(4 * rows_per_append)],
+    )
+    runner = LocalRunner({"stream": conn}, default_catalog="stream",
+                         page_rows=1 << 13)
+    view = IVM.IvmRegistry().register(
+        runner, "dash",
+        "select k, count(*), sum(v) from events group by k order by k",
+    )
+    sink = runner.executor
+    # settle + compile off the timed path (the bench --prewarm stance)
+    IVM.refresh(view, session=runner.session, sink=sink)
+
+    stop_at = time.time() + duration_s
+    lock = threading.Lock()
+    tally = {"appends": 0, "rows_appended": 0, "refreshes": 0,
+             "errors": 0}
+    walls: list = []
+
+    def writer(idx: int) -> None:
+        wrng = random.Random(seed * 1000 + idx)
+        while time.time() < stop_at:
+            batch = [(wrng.randrange(64), wrng.random() * 100.0)
+                     for _ in range(rows_per_append)]
+            try:
+                conn.append("events", batch)
+            except Exception:  # noqa: BLE001 - a load generator
+                with lock:     # counts failures, it never crashes
+                    tally["errors"] += 1
+                continue
+            sink.count_stream_append()
+            with lock:
+                tally["appends"] += 1
+                tally["rows_appended"] += len(batch)
+            time.sleep(0.01)  # pace: leave the readers CPU to fold
+
+    def reader(idx: int) -> None:
+        while time.time() < stop_at:
+            conn.wait_for_offset(
+                "events", view.settled_offset(), 0.2)
+            t0 = time.perf_counter()
+            try:
+                IVM.refresh(view, session=runner.session, sink=sink)
+            except Exception:  # noqa: BLE001 - a load generator
+                with lock:     # counts failures, it never crashes
+                    tally["errors"] += 1
+                continue
+            wall = time.perf_counter() - t0
+            with lock:
+                tally["refreshes"] += 1
+                walls.append(wall)
+
+    threads = (
+        [threading.Thread(target=writer, args=(i,), daemon=True)
+         for i in range(writers)]
+        + [threading.Thread(target=reader, args=(i,), daemon=True)
+           for i in range(readers)]
+    )
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s * 4 + 60)
+    wall = time.time() - t0
+
+    walls.sort()
+
+    def pct(q: float) -> float:
+        if not walls:
+            return 0.0
+        return walls[min(int(q * len(walls)), len(walls) - 1)]
+
+    return {
+        "mode": "append-writers",
+        "writers": writers,
+        "readers": readers,
+        "duration_s": round(wall, 2),
+        "appends": tally["appends"],
+        "rows_appended": tally["rows_appended"],
+        "refreshes": tally["refreshes"],
+        "errors": tally["errors"],
+        "refresh_p50_ms": round(pct(0.50) * 1000, 2),
+        "refresh_p99_ms": round(pct(0.99) * 1000, 2),
+        "ivm_refreshes": sink.ivm_refreshes,
+        "ivm_full_recomputes": sink.ivm_full_recomputes,
+        "delta_pages_folded": sink.delta_pages_folded,
+        "stream_appends_seen": sink.stream_appends_seen,
+        "final_offset": conn.offset("events"),
+        "view_watermark": view.settled_offset(),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--server", default=None,
@@ -230,6 +343,14 @@ def main() -> int:
                     help="arm the runtime lock sanitizer over the "
                          "self-hosted server and fail on any "
                          "violation (concurrency soundness gate)")
+    ap.add_argument("--append-writers", type=int, default=0,
+                    help="mixed STREAMING mode (ISSUE 14): this many "
+                         "writer threads append to a stream while "
+                         "--clients reader threads refresh a "
+                         "registered materialized view incrementally; "
+                         "records refresh p50/p99 + the ivm_* "
+                         "registry counters")
+    ap.add_argument("--rows-per-append", type=int, default=512)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -244,6 +365,19 @@ def main() -> int:
         if args.server is not None:
             print("# --sanitize instruments THIS process only; the "
                   "external server runs unsanitized", file=sys.stderr)
+
+    if args.append_writers > 0:
+        out = run_append_load(
+            args.append_writers, args.clients, args.duration,
+            args.rows_per_append, seed=args.seed,
+        )
+        if san is not None:
+            out["sanitizer_violations"] = san.violation_count()
+            if out["sanitizer_violations"]:
+                print(san.report(), file=sys.stderr)
+        print(json.dumps(out, sort_keys=True))
+        return 1 if out["errors"] or out.get(
+            "sanitizer_violations") else 0
 
     srv = None
     server = args.server
