@@ -13,8 +13,12 @@ even on a warm persistent compile cache, nearly zero host CPU). So
 bench.py is a pure HOST-side orchestrator — it never imports jax — and
 runs each phase as a bounded subprocess holding the chip exclusively:
 
-  1. --group-child r1,r2,...: ONE child per (suite, sf, props) group so
-     rungs sharing generators/programs pay the tunnel load once.
+  1. --group-child <rung>: ONE child PER RUNG (round 15 — per-rung
+     isolation, so a slow/hanging rung can only lose itself), each
+     preceded by a bounded per-rung --prewarm child that pays the
+     compile bill into the persistent cache off the timed path (and
+     whose strict plan-check/HBM-audit verdict VETOES timing a plan
+     the model says faults).
      Timing protocol (round-4 discovery): on axon block_until_ready
      returns at DISPATCH — it does not wait for the device. Honest
      wall-clock = dispatch + a one-element device->host read that
@@ -171,30 +175,18 @@ def _run_child(args, timeout, env=None):
 # --------------------------------------------------------- orchestrator
 
 
-# Rungs that run SOLO (their own group child) even when they share a
-# (suite, sf, props) runner with faster rungs: a slow/hanging join rung
-# must only be able to time out ITSELF. BENCH_r05 lost the entire
-# headline group — every rung valid:false — because q5_sf1 burned the
-# shared group cap before q1/q6/q3 could decode+validate. q3_sf1 joins
-# it: its measured r05 compile bill alone was 338s.
-SOLO_RUNGS = {"q5_sf1", "q3_sf1"}
-
-
 def _groups():
-    """RUNGS grouped by (suite, sf, props) preserving ladder order —
-    each group is one subprocess so rungs sharing a runner pay the
-    tunnel program-load bill once. SOLO_RUNGS get a group of their own
-    (isolation beats sharing the program-load bill for rungs that have
-    blown group deadlines before)."""
-    out, index = [], {}
-    for rung in RUNGS:
-        name, suite, qid, sf, props = rung
-        key = ("solo", name) if name in SOLO_RUNGS else (suite, sf, props)
-        if key not in index:
-            index[key] = len(out)
-            out.append([])
-        out[index[key]].append(rung)
-    return out
+    """ONE GROUP PER RUNG (ISSUE 15 satellite, ROADMAP item 2
+    remainder): every rung times and validates inside its OWN
+    subprocess under its own budget, so a slow or hanging rung can
+    only ever lose itself — the BENCH_r03/r04 rc=124 failure mode
+    (one shared-group timeout zeroing every rung's certification,
+    repeated by r05's headline group) becomes structurally
+    impossible. The shared program-load bill the old (suite, sf,
+    props) grouping amortized is paid instead by the per-rung
+    --prewarm child into the PERSISTENT compile cache, off the timed
+    path, so the timing child loads executables from disk."""
+    return [[rung] for rung in RUNGS]
 
 
 def _group_cap(group) -> int:
@@ -256,6 +248,68 @@ def main() -> int:
                 print(f"# group {names}: SKIPPED (budget)",
                       file=sys.stderr)
                 continue
+            # ---- per-rung prewarm child (ISSUE 15 satellite): pay
+            # the compile bill into the persistent cache OFF the
+            # timed path — bounded on its own, so a hung compile
+            # costs the rung its prewarm, never its timing budget.
+            # Also runs the strict plan check + static HBM audit, so
+            # a rung that would fault surfaces here. Skipped when the
+            # remaining budget could not fund prewarm AND timing.
+            pre_cap = min(_group_cap(group),
+                          remaining - _group_cap(group) * 0.5)
+            if pre_cap >= 60 and not os.environ.get(
+                    "BENCH_NO_PREWARM"):
+                t0 = time.time()
+                pinfo, perr = _run_child(
+                    [sys.executable, __file__, "--prewarm",
+                     ",".join(names)],
+                    timeout=pre_cap,
+                )
+                # the prewarm child prints its JSON even when it
+                # exits nonzero — its audit VERDICTS, not just its
+                # parseability, decide whether timing may proceed
+                vetoed = set()
+                if pinfo is not None:
+                    vetoed = (set(pinfo.get("hbm_audit_failed") or ())
+                              | set(pinfo.get("plan_check_failed")
+                                    or ()))
+                details = _read_details()
+                for n in names:
+                    r = details["rungs"].setdefault(n, {})
+                    r["prewarm_s"] = round(time.time() - t0, 1)
+                    if pinfo is None:
+                        r["prewarm_error"] = perr
+                    elif n in vetoed:
+                        r["prewarm_error"] = (
+                            "static audit failed (see prewarm child "
+                            "output): plan-check/HBM verdict vetoes "
+                            "timing")
+                        r["time_error"] = (
+                            "skipped: prewarm audit veto — launching "
+                            "a plan the model says faults is the "
+                            "hang the audit exists to prevent")
+                    else:
+                        r.pop("prewarm_error", None)
+                _write_details(details)
+                print(f"# prewarm {names}: "
+                      f"{round(time.time() - t0, 1)}s"
+                      + (f" VETOED {sorted(vetoed)}" if vetoed else
+                         ("" if pinfo is not None
+                          else f" FAILED: {perr[:120]}")),
+                      file=sys.stderr)
+                if vetoed:
+                    # do NOT launch the timing child on a plan the
+                    # static audit refused to execute
+                    continue
+                remaining = timing_deadline - time.time()
+                if remaining < 90:
+                    details = _read_details()
+                    for n in names:
+                        details["rungs"].setdefault(n, {})[
+                            "time_error"] = ("skipped: bench budget "
+                                             "exhausted after prewarm")
+                    _write_details(details)
+                    continue
             cap = min(_group_cap(group), remaining)
             info, err = _run_child(
                 [sys.executable, __file__, "--group-child",
@@ -483,6 +537,11 @@ def group_child(only_names) -> int:
             # run's, not a settle+timed cumulative
             ex.buffers_donated = 0
             ex.mesh_local_exchanges = 0
+            ex.adaptive_replans = 0
+            ex.adaptive_dist_flips = 0
+            ex.adaptive_capacity_seeds = 0
+            ex.adaptive_replan_rejected = 0
+            ex.skew_preempted = 0
             pages = list(ex.pages(plan))
             drain(pages)
             flags = list(ex._pending_overflow)
@@ -525,6 +584,17 @@ def group_child(only_names) -> int:
                 # invocations on the successful attempt
                 "mesh_local_exchanges": ex.mesh_local_exchanges,
                 "buffers_donated": ex.buffers_donated,
+                # adaptive execution (ISSUE 15): re-plans applied at
+                # stage boundaries (0 on the local pages() drive —
+                # nonzero only when a rung runs the DCN stage
+                # scheduler; recorded so BENCH_DETAILS carries the
+                # full counter surface either way)
+                "adaptive_replans": ex.adaptive_replans,
+                "adaptive_dist_flips": ex.adaptive_dist_flips,
+                "adaptive_capacity_seeds": ex.adaptive_capacity_seeds,
+                "adaptive_replan_rejected":
+                    ex.adaptive_replan_rejected,
+                "skew_preempted": ex.skew_preempted,
             }
 
         # ---- first (warm-up) run doubles as the BOOST-SETTLE loop:

@@ -1,0 +1,55 @@
+"""Adaptive execution (ISSUE 15 / ROADMAP item 3): runtime
+re-planning at spooled-exchange stage boundaries.
+
+Reference: the Presto-era "adaptive query execution" direction — the
+engine plans once from connector estimates (AddExchanges /
+DetermineJoinDistributionType consult static stats), and every
+misestimate is paid for at runtime as overflow-ladder re-runs,
+capacity boosts, and skew discovered via failed attempts. The spooled
+stage DAG (PR 7) creates exactly the barrier adaptive engines exploit:
+every upstream stage's output is fully materialized on the producing
+workers BEFORE the consumer stage dispatches, so at each stage
+boundary the coordinator holds EXACT per-partition row/byte counts
+(the spool-stats plane, server/worker._TaskSpool.part_stats) and the
+not-yet-dispatched suffix of the DAG is still just data.
+
+The Replanner re-optimizes that suffix:
+
+  (a) DISTRIBUTION FLIPS — a repartitioned build side whose observed
+      bytes fit one chip's broadcast share is re-read broadcast-style
+      (every partition of every producer task; their union is the
+      full build) and the sibling not-yet-dispatched repartition
+      producer degrades to a passthrough edge, skipping its per-page
+      hashing and P-way compaction entirely;
+  (b) JOIN RE-ORDER — when both sides of a downstream join are
+      observed, the smaller side becomes the build (inner joins swap
+      sides behind a channel-restoring Project);
+  (c) CAPACITY RE-SEEDING — downstream Aggregation capacities
+      re-bucket onto the shapes.py ladder from observed input
+      cardinality and RemoteSource leaves are stamped with
+      est_rows, so first runs start at the settled bucket instead of
+      climbing the boost ladder (the first-run analog of the PR-9
+      observed-stats profiles, which only help the SECOND run);
+  (d) SKEW PRE-ENGAGEMENT — a hot partition in the spool histogram
+      pre-engages the position-chunked join rebalance on the consumer
+      (skew_preempted) instead of discovering the hot key by
+      overflowing a buffer.
+
+Every mutated DAG re-verifies through plan_check.verify_dag before
+anything dispatches; a failed re-verify rolls the mutation back and
+the static plan runs (adaptive_replan_rejected — loud, never wrong).
+Re-plans are bounded per query by `adaptive_max_replans`, and the
+whole path is gated by the tri-state `adaptive_execution` session
+property (auto = on under the stage scheduler). Mutated capacities
+are ladder values, so re-planned fragments share the existing
+program cache (jit-key material stays canonical).
+"""
+
+from presto_tpu.adaptive.replanner import (  # noqa: F401
+    ReplanOutcome,
+    Replanner,
+)
+from presto_tpu.adaptive.stats import (  # noqa: F401
+    StageStats,
+    stats_from_statuses,
+)

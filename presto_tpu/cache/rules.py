@@ -215,7 +215,7 @@ def _worth_caching(node: P.PhysicalNode) -> bool:
 
 
 def select_cache_points(root: P.PhysicalNode, catalogs, *,
-                        root_only: bool = False) -> Dict[int, tuple]:
+                        allow=None) -> Dict[int, tuple]:
     """Choose the subtrees whose page streams this query caches:
     the MAXIMAL cacheable subtrees that contain at least one
     materializing operator. A fully cacheable plan gets exactly one
@@ -224,16 +224,20 @@ def select_cache_points(root: P.PhysicalNode, catalogs, *,
     {id(subnode): (key, subnode, tables)} — node references are held
     in the values so ids stay stable for the query's lifetime.
 
-    ``root_only`` restricts selection to the whole plan (the
-    distributed executor's mid-plan pages are mesh-sharded global
-    arrays a host replay could not reproduce; its root output is
-    ordinary decodable pages)."""
+    ``allow`` (optional predicate) gates which subtrees may become
+    points at all — the distributed executor passes its distribution
+    test so only REPLICATED subtrees cache (their pages are ordinary
+    single-stream Pages a host replay can reproduce; mesh-SHARDED
+    mid-plan pages could not — the ISSUE 15 mesh-path residency
+    rule, replacing the old all-or-root restriction)."""
     points: Dict[int, tuple] = {}
 
     def consider(node) -> bool:
         """True when ``node`` was made a cache point (callers then
         skip its subtree)."""
         if not _worth_caching(node):
+            return False
+        if allow is not None and not allow(node):
             return False
         if uncacheable_reason(node, catalogs) is None:
             keyed = subtree_key(node, catalogs)
@@ -243,7 +247,7 @@ def select_cache_points(root: P.PhysicalNode, catalogs, *,
                 return True
         return False
 
-    if consider(root) or root_only:
+    if consider(root):
         return points
 
     def descend(node):

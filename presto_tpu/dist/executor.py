@@ -155,6 +155,50 @@ class DistExecutor(Executor):
         children = node.children()
         return self.dist(children[0]) if children else REPLICATED
 
+    # --------------------------------------------- cache residency
+    def _cache_subtree_ok(self, node: P.PhysicalNode) -> bool:
+        """Mesh-path cache residency (ISSUE 15 satellite, ROADMAP
+        item 6 remainder): only REPLICATED subtrees may become cache
+        points — their pages are ordinary replicated arrays a host
+        replay can reproduce, so a mesh query with an uncacheable
+        root (volatile filter, system branch) still caches its
+        expensive gathered interior instead of nothing at all.
+        Sharded subtrees' pages are mesh-sharded global arrays; a
+        host replay could not rebuild their shard layout."""
+        return self.dist(node) == REPLICATED
+
+    def _sink_chain_ids(self, node) -> frozenset:
+        """Mesh host-sink chain: besides the Output pass-through, a
+        gather/broadcast Exchange over an already-REPLICATED source
+        is a verbatim pass-through on this executor
+        (_exec_exchange yields self.pages(source) unchanged), so a
+        cache point below it can serve host pages straight to result
+        decode — the mesh-local handoff applied to replays, with
+        ZERO h2d/d2h crossings on the hit (transfer-ledger pinned in
+        tests/test_result_cache.py)."""
+        ids = {id(node)}
+        while True:
+            if isinstance(node, P.Output):
+                node = node.source
+            elif (isinstance(node, P.Exchange)
+                    and node.kind in ("gather", "broadcast")
+                    and self.dist(node.source) == REPLICATED):
+                node = node.source
+            else:
+                break
+            ids.add(id(node))
+        return frozenset(ids)
+
+    def _stage_replay(self, page: Page) -> Page:
+        """Replayed host pages commit as mesh-REPLICATED arrays (not
+        device-0 singletons): consumers above a replicated cache
+        point may be shard_map programs with replicated in_specs
+        (residue repartition, broadcast joins) that require a
+        consistent placement across every mesh device."""
+        return XF.to_device(
+            page, spec=NamedSharding(self.mesh, PS()),
+            label="cache-replay")
+
     # ------------------------------------------------------------- pages
     def _pages_impl(self, node: P.PhysicalNode) -> Iterator[Page]:
         if isinstance(node, P.Exchange):

@@ -235,7 +235,11 @@ class Fragment:
     output_kind: how the consumer ingests this fragment's output —
         "gather"/"broadcast" consumers read every producer task's whole
         spool; "repartition" producers spool P hash partitions and
-        consumer task t reads partition t of every producer task.
+        consumer task t reads partition t of every producer task;
+        "passthrough" (adaptive-only, ISSUE 15: the degrade of a
+        repartition producer under a broadcast-flipped join) spools
+        ONE partition per task and consumer task t reads producer
+        task t's whole spool — a disjoint split with no hashing.
     output_keys: partition channels for a repartition edge.
     sharded: run one task per pooled worker (leaf scans split
         round-robin on split_table; repartition consumers read their
@@ -256,17 +260,40 @@ class Fragment:
 @dataclasses.dataclass
 class StageDag:
     """Topologically ordered fragments plus the coordinator-side root
-    plan (RemoteSource leaves referencing the final fragments)."""
+    plan (RemoteSource leaves referencing the final fragments).
+
+    The two adaptive-execution fields (ISSUE 15) start empty and are
+    written only by presto_tpu/adaptive/ between stage dispatches:
+
+    reads: (consumer_fid, producer_fid) -> "broadcast" overrides HOW a
+        consumer ingests an edge whose producer ALREADY spooled — a
+        repartition spool read broadcast-style drains every partition
+        of every producer task (their union is the full output), the
+        runtime half of a partitioned->broadcast distribution flip.
+        -1 as consumer_fid addresses the coordinator root fragment.
+    hints: fid -> payload hints for not-yet-dispatched fragments
+        (currently {"skew": True} pre-engages the position-chunked
+        join rebalance on the consumer of a skewed exchange).
+    """
 
     fragments: List[Fragment]
     root: P.PhysicalNode
     root_inputs: Tuple[int, ...]
+    reads: Dict[Tuple[int, int], str] = dataclasses.field(
+        default_factory=dict)
+    hints: Dict[int, Dict] = dataclasses.field(default_factory=dict)
 
     def fragment(self, fid: int) -> Fragment:
         return self.fragments[fid]
 
     def consumers(self, fid: int) -> List[int]:
         return [f.fid for f in self.fragments if fid in f.inputs]
+
+    def read_kind(self, consumer_fid: int, producer_fid: int) -> str:
+        """Effective ingest mode of one edge: the producer's spooled
+        output_kind unless an adaptive read override redirects it."""
+        override = self.reads.get((consumer_fid, producer_fid))
+        return override or self.fragments[producer_fid].output_kind
 
 
 def stage_key(fid: int) -> str:

@@ -85,6 +85,10 @@ class _SchedTask:
     spec: Optional[_Placement] = None  # speculation copy in flight
     spec_count: int = 0
     span: object = None  # obs trace span for this LOGICAL task
+    # spool-stats plane (ISSUE 15): the final status body's
+    # per-partition row/byte counts, kept for the stage-boundary
+    # re-planner's coordinator-side summation
+    status: Optional[Dict] = None
 
 
 class _NodeDown(RuntimeError):
@@ -121,6 +125,15 @@ class StageScheduler:
         self.tasks: Dict[int, List[_SchedTask]] = {}
         self._root_done = False
         self._ntasks: Dict[int, int] = {}
+        # adaptive execution (ISSUE 15): partition count each
+        # dispatched producer actually spooled (broadcast reads of a
+        # flipped edge must name every spooled partition), worker-side
+        # boost/skew tallies settled onto the coordinator counters
+        # AFTER the root execute (which resets per-query gauges)
+        self._spooled_parts: Dict[int, int] = {}
+        self._worker_boosts = 0
+        self._worker_skew = 0
+        self.replanner = None  # set by run() when adaptive is on
 
     # ------------------------------------------------------ plumbing
     def _retry_attempts(self) -> int:
@@ -177,26 +190,49 @@ class StageScheduler:
             payload["outputPartitions"] = self._consumer_tasks(t.fid)
             payload["outputKeys"] = list(frag.output_keys)
         else:
+            # gather / broadcast / adaptive passthrough: ONE spool
+            # partition per task (a passthrough consumer reads its
+            # same-index producer task's whole spool)
             payload["outputPartitions"] = 1
+        self._spooled_parts.setdefault(
+            t.fid, int(payload["outputPartitions"]))
+        if self.dag.hints.get(t.fid, {}).get("skew"):
+            # adaptive skew pre-engagement (ISSUE 15): the upstream
+            # spool histogram showed a hot partition — the worker's
+            # executor starts in the position-chunked rebalance mode
+            payload["skewHint"] = True
         if frag.inputs:
             # sources rebuilt from CURRENT placements at every
             # (re)dispatch — a replayed consumer reads the replacement
             # spools, not the dead node's
-            payload["sources"] = {
-                stage_key(u): {
-                    "partition": (
-                        t.index
-                        if self.dag.fragment(u).output_kind
-                        == "repartition" else 0
-                    ),
-                    "tasks": [
-                        {"uri": ut.placement.uri,
-                         "taskId": ut.placement.task_id}
-                        for ut in self.tasks[u]
-                    ],
-                }
-                for u in frag.inputs
-            }
+            payload["sources"] = {}
+            for u in frag.inputs:
+                read = self.dag.read_kind(t.fid, u)
+                tasks = [
+                    {"uri": ut.placement.uri,
+                     "taskId": ut.placement.task_id}
+                    for ut in self.tasks[u]
+                ]
+                spec: Dict = {"tasks": tasks}
+                up_kind = self.dag.fragment(u).output_kind
+                if up_kind == "repartition" and read == "broadcast":
+                    # adaptive dist flip: the producer ALREADY spooled
+                    # P hash partitions; draining every one of them
+                    # from every producer task is exactly the full
+                    # build a broadcast spool would have held
+                    spec["partitions"] = list(range(
+                        self._spooled_parts.get(u) or 1))
+                elif up_kind == "passthrough":
+                    # consumer task t reads producer task t only —
+                    # task counts agree (both stages shard over the
+                    # same pool; verify_dag pins sharded-ness)
+                    spec["partition"] = 0
+                    spec["tasks"] = [tasks[t.index]]
+                elif up_kind == "repartition":
+                    spec["partition"] = t.index
+                else:
+                    spec["partition"] = 0
+                payload["sources"][stage_key(u)] = spec
         return payload
 
     def _post(self, uri: str, payload: Dict) -> None:
@@ -223,6 +259,71 @@ class StageScheduler:
     def _delete(self, pl: _Placement) -> None:
         self.coord._release_task(pl.uri, pl.task_id)
 
+    # ----------------------------------------------------- adaptive
+    def _adaptive_on(self) -> bool:
+        """adaptive_execution resolution: "auto" = ON under the stage
+        scheduler (this IS the stage-boundary barrier adaptive
+        engines need — there is nowhere cheaper to re-plan), "false"
+        kills the path, "true" forces (same as auto here)."""
+        mode = self.coord.runner.session.get("adaptive_execution")
+        return mode != "false"
+
+    def _make_replanner(self):
+        from presto_tpu.adaptive import Replanner
+
+        opts = self.coord.runner._session_dist_options()
+        return Replanner(
+            self.ex, self.dag,
+            broadcast_rows=opts.get("broadcast_rows"),
+            broadcast_bytes=opts.get("broadcast_bytes"),
+            max_replans=int(self.coord.runner.session.get(
+                "adaptive_max_replans")),
+        )
+
+    def _stage_stats(self, fid: int):
+        from presto_tpu.adaptive import stats_from_statuses
+
+        bodies = [t.status for t in self.tasks[fid]
+                  if t.status is not None]
+        if len(bodies) != len(self.tasks[fid]):
+            return None
+        return stats_from_statuses(fid, bodies)
+
+    def _maybe_replan(self, fid: int, dispatched) -> None:
+        """The stage-boundary barrier: the just-completed stage's
+        exact spool stats feed the re-planner, which may mutate the
+        not-yet-dispatched DAG suffix (re-verified, or rolled back
+        and counted). Mutated fragments re-serialize so every later
+        dispatch ships the re-planned tree."""
+        rp = self.replanner
+        st = self._stage_stats(fid)
+        if st is not None:
+            rp.observe(st)
+        tr = self.trace
+        t0 = tr.now() if tr is not None else 0.0
+        outcome = rp.replan(dispatched)
+        if outcome is None:
+            return
+        if outcome.rejected:
+            self.ex.adaptive_replan_rejected += 1
+        else:
+            self.ex.adaptive_replans += 1
+            self.ex.adaptive_dist_flips += outcome.dist_flips
+            self.ex.adaptive_capacity_seeds += outcome.capacity_seeds
+            for mfid in outcome.mutated_fids:
+                self._frag_blob[mfid] = plan_serde.dumps(
+                    clip_for_shipping(self.dag.fragment(mfid).root))
+        if tr is not None:
+            tr.complete(
+                "replan", f"stage{fid}", t0, tr.now(),
+                rejected=outcome.rejected,
+                flips=outcome.dist_flips,
+                seeds=outcome.capacity_seeds,
+                skew_hints=outcome.skew_hints,
+                reason=outcome.reason[:120],
+            )
+            self.ex.trace_spans += 1
+
     # -------------------------------------------------- run the DAG
     def run(self) -> list:
         """Execute the DAG; returns the materialized row list."""
@@ -237,17 +338,29 @@ class StageScheduler:
                            base_id=f"{self.qid}.f{f.fid}.t{i}")
                 for i in range(self._ntasks[f.fid])
             ]
+        if self._adaptive_on():
+            self.replanner = self._make_replanner()
+        dispatched: set = set()
         try:
             for f in dag.fragments:
                 self._run_stage(f.fid)
+                dispatched.add(f.fid)
                 if self.stage_hook is not None:
                     self.stage_hook(f.fid)
+                if self.replanner is not None:
+                    self._maybe_replan(f.fid, dispatched)
             # coordinator-side root fragment over the final stages
             for fid in dag.root_inputs:
                 ex.remote_sources[stage_key(fid)] = \
                     self._root_supplier(fid)
             _, rows = ex.execute(dag.root)
             self._root_done = True
+            # settle worker-side ladder outcomes onto the coordinator
+            # gauges AFTER execute() (which resets them): EXPLAIN
+            # ANALYZE / system.metrics then show the QUERY's total
+            # boost retries, stage tasks included
+            ex.capacity_boost_retries += self._worker_boosts
+            ex.skew_preempted += self._worker_skew
             return rows
         finally:
             for fid in dag.root_inputs:
@@ -398,10 +511,19 @@ class StageScheduler:
     def _complete(self, t: _SchedTask, st: Dict) -> None:
         t.done = True
         t.wall = time.monotonic() - t.dispatched_at
+        # spool-stats plane: the LAST status body wins (a replay
+        # re-publishes identical stats — deterministic spools)
+        t.status = st
         if not t.counted:
             t.counted = True
             self.ex.spooled_exchange_pages += int(
                 st.get("spooledPages") or 0)
+            # worker-side executor outcomes, settled onto the
+            # coordinator's registry counters after the root execute
+            # (ISSUE 15: "first-run overflow boosts driven to zero"
+            # must be measurable where EXPLAIN ANALYZE reads)
+            self._worker_boosts += int(st.get("boostRetries") or 0)
+            self._worker_skew += int(st.get("skewPreempted") or 0)
         # cross-node timeline assembly: the worker's queue/run/attempt
         # spans (offsets from ITS task creation) nest into this task's
         # coordinator-side window, clamped so clock/queue skew can
@@ -588,9 +710,10 @@ class StageScheduler:
 
         frag = self.dag.fragment(fid)
         for u in frag.inputs:
-            up = self.dag.fragment(u)
-            parts = (range(len(self.tasks[fid]))
-                     if up.output_kind == "repartition" else (0,))
+            # every partition the producer actually spooled (recorded
+            # at dispatch) — correct for repartition, gather, and the
+            # adaptive passthrough / broadcast-read modes alike
+            parts = range(self._spooled_parts.get(u) or 1)
             for ut in self.tasks[u]:
                 if ut.placement is None:
                     continue

@@ -282,7 +282,8 @@ def _pallas_part_ids(page: Page, keys: Sequence[int], dict_luts,
 
 
 def device_partition_pages(
-    ex, page: Page, keys: Sequence[int], nparts: int
+    ex, page: Page, keys: Sequence[int], nparts: int,
+    with_counts: bool = False,
 ) -> List[Tuple[int, Page]]:
     """Device-tier `partition_host_page`: ONE jitted program computes
     every partition assignment and compacts all `nparts` output pages
@@ -292,9 +293,23 @@ def device_partition_pages(
     replayed task regenerates an identical page sequence. The
     OR-reduced per-partition overflow flag joins the executor's
     deferred ladder: skew degrades to a boosted retry, exactly like
-    the host tier's take_rows_host bucket."""
+    the host tier's take_rows_host bucket.
+
+    ``with_counts=True`` (the spool-stats plane, ISSUE 15) also
+    returns the exact per-partition row counts — computed INSIDE the
+    same program (the compaction already counts them) and pulled as
+    one nparts-long vector through the metered choke point, so the
+    stats cost is a handful of d2h bytes per page, never a second
+    kernel or a whole-mask pull. Return shape then is
+    ``(pairs, counts_np)``."""
     cap_in = page.valid.shape[0]
     if nparts <= 1:
+        if with_counts:
+            v = page.valid
+            n = (int(XF.np_host(page.num_rows(), label="spool-stats"))
+                 if isinstance(v, jax.Array)
+                 else int(XF.np_host(v).sum()))
+            return [(0, page)], np.asarray([n], dtype=np.int64)
         return [(0, page)]
     # host-resident input (a cache replay at the fragment root) stages
     # through the metered choke point; device pages pass through free
@@ -321,6 +336,7 @@ def device_partition_pages(
             h = device_row_hash_u64(pg, keys, full)
             part = (h % jnp.uint64(nparts)).astype(jnp.int32)
         outs = []
+        nums = []
         overflow = jnp.asarray(False)
         for p in range(nparts):
             mask = pg.valid & (part == p)
@@ -336,15 +352,28 @@ def device_partition_pages(
                          if blk.nulls is not None else None)
                 blocks.append(blk.with_data(data, nulls=nulls))
             outs.append(Page(blocks=tuple(blocks), valid=out_valid))
+            nums.append(num)
             overflow = overflow | (num > cap)
+        if with_counts:
+            return tuple(outs), jnp.stack(nums), overflow
         return tuple(outs), overflow
 
     fn = ex._jit(
         ("dev_repart", tuple(keys), nparts, cap, cap_in, dicts,
-         use_pallas),
+         use_pallas, with_counts),
         body,
     )
-    outs, overflow = fn(page, *[v for v in luts if v is not None])
+    out = fn(page, *[v for v in luts if v is not None])
+    if with_counts:
+        outs, nums, overflow = out
+        ex._pending_overflow.append(overflow)
+        counts = XF.np_host(nums, label="spool-stats").astype(np.int64)
+        # counts are EXACT published rows: an overflowing partition
+        # never publishes (the deferred flag re-runs the attempt and
+        # on_attempt resets the spool), so clamping to the landing cap
+        # only guards the transient pre-retry value
+        return list(enumerate(outs)), np.minimum(counts, cap)
+    outs, overflow = out
     ex._pending_overflow.append(overflow)
     return list(enumerate(outs))
 
@@ -534,22 +563,31 @@ def iter_source_pages(
     consumer regenerates an identical stream from identical spools).
     Same-process producers serve their spooled Pages directly
     (`local_source_pages`; `on_local` fires once per edge task so the
-    consumer's executor can count mesh_local_exchanges)."""
+    consumer's executor can count mesh_local_exchanges).
+
+    An adaptive BROADCAST READ of a repartitioned spool (ISSUE 15)
+    passes ``spec['partitions']`` — an explicit partition list; the
+    consumer drains every listed partition of every producer task
+    (their union is the full producer output, so a join build flipped
+    to broadcast after its producer already spooled P hash partitions
+    reads exactly the rows a broadcast spool would have held)."""
     from presto_tpu.dist import serde
 
-    part = int(spec.get("partition", 0))
+    parts = [int(p) for p in (spec.get("partitions")
+                              or (spec.get("partition", 0),))]
     for t in spec["tasks"]:
-        pages = local_source_pages(t["uri"], t["taskId"], part)
-        if pages is not None:
-            if on_local is not None:
-                on_local()
-            yield from pages
-            continue
-        for blob in fetch_spool_blobs(
-            t["uri"], t["taskId"], part, retries=retries,
-            backoff_s=backoff_s, deadline=deadline,
-        ):
-            yield serde.deserialize_page(blob)
+        for part in parts:
+            pages = local_source_pages(t["uri"], t["taskId"], part)
+            if pages is not None:
+                if on_local is not None:
+                    on_local()
+                yield from pages
+                continue
+            for blob in fetch_spool_blobs(
+                t["uri"], t["taskId"], part, retries=retries,
+                backoff_s=backoff_s, deadline=deadline,
+            ):
+                yield serde.deserialize_page(blob)
 
 
 def ack_spool(uri: str, task_id: str, part: int,

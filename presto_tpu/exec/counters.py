@@ -179,6 +179,35 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "counter", "append batches observed on append-only stream "
         "connectors: the runner's INSERT advance path plus tail "
         "polls that saw the log offset move"),
+    "adaptive_replans": (
+        "counter", "stage-boundary re-plans applied by the adaptive "
+        "executor (presto_tpu/adaptive/): the not-yet-dispatched "
+        "suffix of a stage DAG was re-optimized from exact spool "
+        "stats and re-verified before dispatch (coordinator "
+        "lifetime)"),
+    "adaptive_dist_flips": (
+        "counter", "join distributions flipped at runtime by the "
+        "adaptive re-planner (partitioned -> broadcast reads of a "
+        "small observed build, repartition producers degraded to "
+        "passthrough) — the AddExchanges decision re-made on "
+        "measured bytes"),
+    "adaptive_capacity_seeds": (
+        "counter", "downstream fragment capacities re-bucketed onto "
+        "the shapes.py ladder from observed exchange cardinality "
+        "(aggregation capacities, RemoteSource est_rows stamps) so "
+        "first runs start at the settled bucket instead of climbing "
+        "the boost ladder"),
+    "adaptive_replan_rejected": (
+        "counter", "adaptive re-plans DISCARDED because the mutated "
+        "DAG failed plan_check.verify_dag (or the per-query "
+        "adaptive_max_replans bound was hit) — the static plan runs "
+        "instead, counted loudly, never a silent wrong answer"),
+    "skew_preempted": (
+        "counter", "grace-join passes that started in the skew-"
+        "rebalanced position-chunking mode on their FIRST attempt "
+        "because the adaptive re-planner saw a hot partition in the "
+        "upstream spool histogram (vs discovering it via an overflow "
+        "retry; worker counts mirror onto the coordinator)"),
     "trace_spans": (
         "gauge", "spans recorded into this query's lifecycle trace "
         "(obs/trace.py; pinned 0 when tracing is off)"),

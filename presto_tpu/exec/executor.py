@@ -325,6 +325,19 @@ class Executor:
         # session property); skew_chunks_used is observability.
         self.join_skew_rebalance = True
         self.skew_chunks_used = 0
+        # Adaptive execution (ISSUE 15, presto_tpu/adaptive/): the
+        # stage-boundary re-planner's counters live on the COORDINATOR
+        # executor (the scheduler increments them); skew_preengaged is
+        # the worker-side hint — observed per-partition skew in an
+        # upstream spool pre-engages the position-chunked rebalance at
+        # boost 1 instead of discovering the hot key via an overflow
+        # retry (skew_preempted counts those engagements).
+        self.adaptive_replans = 0
+        self.adaptive_dist_flips = 0
+        self.adaptive_capacity_seeds = 0
+        self.adaptive_replan_rejected = 0
+        self.skew_preengaged = False
+        self.skew_preempted = 0
         # Hard per-pass row cap for join builds (session property
         # max_join_build_rows): partitions a join whenever the build-side
         # row estimate exceeds it, independent of the byte threshold.
@@ -1961,10 +1974,11 @@ class Executor:
     # -------------------------------------------------- result cache
     def _select_cache_points(self, node: P.PhysicalNode) -> None:
         """Per-query cache-point selection (cache/rules.py): maximal
-        cacheable subtrees containing a materializing operator.
-        Subclasses (the distributed executor) restrict to the root —
-        their mid-plan pages are mesh-sharded global arrays a host
-        replay could not reproduce.
+        cacheable subtrees containing a materializing operator,
+        gated by _cache_subtree_ok — the distributed executor allows
+        only REPLICATED subtrees (mesh-sharded mid-plan pages cannot
+        host-replay; replicated interiors can, the ISSUE 15 mesh
+        residency rule).
 
         Keys are salted with the EXECUTOR config that can change a
         successful subtree's output without appearing in the plan:
@@ -1985,9 +1999,16 @@ class Executor:
                 stream_watermark(tables, self.catalogs))
             for i, (key, n, tables) in select_cache_points(
                 node, self.catalogs,
-                root_only=type(self).__name__ != "Executor",
+                allow=self._cache_subtree_ok,
             ).items()
         }
+
+    def _cache_subtree_ok(self, node: P.PhysicalNode) -> bool:
+        """Whether a subtree's page stream may become a cache point.
+        The base executor's pages are always ordinary single-stream
+        Pages — everything is allowed; the DistExecutor narrows to
+        replicated subtrees (mesh-sharded pages cannot host-replay)."""
+        return True
 
     def _cached_pages(self, node: P.PhysicalNode,
                       entry) -> Iterator[Page]:
@@ -2025,8 +2046,7 @@ class Executor:
             # counter-pinned in tests/test_result_cache.py)
             serve_host = id(node) in self._host_sink_ids
             for hp in host_pages:
-                dp = hp if serve_host else XF.to_device(
-                    hp, label="cache-replay")
+                dp = hp if serve_host else self._stage_replay(hp)
                 self._account_page(dp)
                 if st is not None:
                     st.pages += 1
@@ -2053,6 +2073,12 @@ class Executor:
         finally:
             self._cache_inflight.discard(id(node))
         self._cache_pending.append((key, collected, tables, watermark))
+
+    def _stage_replay(self, page: Page) -> Page:
+        """Re-stage one replayed host page for a DEVICE consumer —
+        overridable so the DistExecutor can commit replays as
+        properly mesh-replicated arrays instead of device-0 pages."""
+        return XF.to_device(page, label="cache-replay")
 
     def _publish_cache_pending(self) -> None:
         """Publish the attempt's completed cache streams — called by
@@ -3014,6 +3040,12 @@ class Executor:
             # expansion factor unknown statically; modest heuristic
             return self.estimate_rows(node.source) * 4
         if isinstance(node, P.RemoteSource):
+            # adaptive execution (ISSUE 15): an OBSERVED exchange row
+            # count stamped by the stage-boundary re-planner beats any
+            # static estimate — downstream grace partitioning and
+            # governor shares then size from measured cardinality
+            if node.est_rows is not None:
+                return max(int(node.est_rows), 1)
             # fragment edge: estimate from the producer's root when it
             # rides along (origin) — a conservative over-estimate (the
             # FULL producer output; a repartition consumer sees ~1/N),
@@ -3628,9 +3660,15 @@ class Executor:
         left_stream = self._source_stream(node.left)
         rebalance = (
             self.join_skew_rebalance
-            and self._capacity_boost > 1
+            and (self._capacity_boost > 1 or self.skew_preengaged)
             and node.join_type == "inner"
         )
+        if rebalance and self._capacity_boost == 1:
+            # adaptive pre-engagement (ISSUE 15): the stage-boundary
+            # re-planner saw a hot partition in the upstream spool
+            # histogram, so the rebalanced chunking starts on the
+            # FIRST attempt instead of being discovered via overflow
+            self.skew_preempted += 1
         for p in range(parts):
             pj = jnp.uint64(p)
             if rebalance:

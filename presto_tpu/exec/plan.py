@@ -311,11 +311,22 @@ class RemoteSource(PhysicalNode):
     The executor resolves ``key`` in its ``remote_sources`` registry to
     a callable yielding deserialized pages. ``origin`` carries the
     remote fragment's root (e.g. the partial-step aggregation) so the
-    consuming final step can recover original input types."""
+    consuming final step can recover original input types.
+
+    ``est_rows`` is the adaptive-execution stats channel (ISSUE 15):
+    when the producing stage has already COMPLETED and spooled, the
+    runtime re-planner stamps the exact observed row count here so
+    every downstream sizing decision (estimate_rows -> join grace
+    partitioning, membudget shares, broadcast flips) runs on measured
+    cardinality instead of connector guesses. None = not yet observed
+    (estimate from ``origin`` as before). The value itself never
+    reaches a jit key — capacities derived from it quantize onto the
+    shapes.py ladder first."""
 
     types: Tuple[T.SqlType, ...]
     key: str
     origin: Optional[PhysicalNode] = None
+    est_rows: Optional[int] = None
 
     def children(self):
         return ()

@@ -602,6 +602,13 @@ def check_task_payload(payload: dict) -> None:
         if int((spec or {}).get("partition", 0)) < 0:
             bad.append(f"source {key!r} names a negative spool "
                        f"partition")
+        plist = (spec or {}).get("partitions")
+        if plist is not None and (
+            not plist or any(int(p) < 0 for p in plist)
+        ):
+            bad.append(f"source {key!r} carries an empty/negative "
+                       f"adaptive partition list — a broadcast read "
+                       f"must name every spooled partition")
     if payload.get("splitMode") == "hash":
         cols = payload.get("partitionColumns")
         if not cols or not isinstance(cols, dict) or not all(
@@ -648,10 +655,19 @@ def verify_dag(ex, dag, strict: bool = False) -> None:
       - a join whose BOTH children arrive via repartition edges must
         be co-partitioned on exactly its join keys, or matching rows
         land in different partitions (the fragment-edge analog of the
-        in-plan exchange-partitioning check).
+        in-plan exchange-partitioning check) — unless an adaptive
+        read override (dag.reads) drains one side broadcast-style,
+        in which case co-location is no longer load-bearing;
+      - a "passthrough" edge (the adaptive degrade of a repartition
+        producer under a broadcast-flipped join) requires BOTH ends
+        sharded: consumer task t reads producer task t's whole
+        spool, which is a disjoint split only when task counts agree
+        (the scheduler shards both over the same pool).
     """
     from presto_tpu.dist.fragmenter import stage_key
 
+    read_kind = getattr(dag, "read_kind",
+                        lambda c, p: dag.fragments[p].output_kind)
     violations: List[str] = []
     by_key = {stage_key(f.fid): f for f in dag.fragments}
     for frag in dag.fragments:
@@ -662,6 +678,19 @@ def verify_dag(ex, dag, strict: bool = False) -> None:
                 f"stage {frag.fid}: {v}" for v in e.violations
             )
             continue
+        if frag.output_kind == "passthrough":
+            if not frag.sharded:
+                violations.append(
+                    f"stage {frag.fid}: passthrough output on an "
+                    f"un-sharded fragment — a single producer task "
+                    f"cannot feed every consumer task its own "
+                    f"disjoint share")
+            for c in dag.consumers(frag.fid):
+                if not dag.fragments[c].sharded:
+                    violations.append(
+                        f"stage {frag.fid}: passthrough edge into "
+                        f"un-sharded consumer stage {c} — task "
+                        f"counts cannot agree")
         if frag.output_kind == "repartition":
             try:
                 out = ex.output_types(frag.root)
@@ -688,7 +717,7 @@ def verify_dag(ex, dag, strict: bool = False) -> None:
                         f"dictionary-coded channel — codes are "
                         f"producer-local, rows would not co-locate")
 
-    def check_edges(plan, where):
+    def check_edges(plan, where, consumer_fid):
         def walk(n):
             if isinstance(n, P.RemoteSource) and \
                     n.key.startswith("stage"):
@@ -719,8 +748,10 @@ def verify_dag(ex, dag, strict: bool = False) -> None:
                 lf = by_key.get(lsrc.key) if lsrc is not None else None
                 rf = by_key.get(rsrc.key) if rsrc is not None else None
                 if lf is not None and rf is not None and \
-                        lf.output_kind == "repartition" and \
-                        rf.output_kind == "repartition":
+                        read_kind(consumer_fid, lf.fid) \
+                        == "repartition" and \
+                        read_kind(consumer_fid, rf.fid) \
+                        == "repartition":
                     if tuple(lf.output_keys) != tuple(n.left_keys) or \
                             tuple(rf.output_keys) != tuple(
                                 n.right_keys):
@@ -739,7 +770,7 @@ def verify_dag(ex, dag, strict: bool = False) -> None:
         walk(plan)
 
     for frag in dag.fragments:
-        check_edges(frag.root, f"stage {frag.fid}")
-    check_edges(dag.root, "coordinator fragment")
+        check_edges(frag.root, f"stage {frag.fid}", frag.fid)
+    check_edges(dag.root, "coordinator fragment", -1)
     if violations:
         raise PlanCheckError(violations)

@@ -121,11 +121,22 @@ TRANSFER_REGISTRY: Dict[str, Tuple[str, str, str]] = {
         "grace-join skew rebalance reads per-piece row counts (host "
         "decision point, admissible on the boosted retry path)"),
     "exec.executor.Executor._cached_pages": (
-        "h2d+d2h", "data",
-        "result-cache fragment replay: stored host pages re-stage for "
-        "device consumers (h2d); root-sink hits serve host pages "
-        "directly — zero crossings — and read row counts host-side "
-        "for the stats plane (d2h on device pages only)"),
+        "d2h", "data",
+        "result-cache fragment replay accounting: host-sink hits "
+        "serve host pages directly — zero crossings — and read row "
+        "counts host-side for the stats plane (d2h on device pages "
+        "only); re-staging for device consumers lives in "
+        "_stage_replay"),
+    "exec.executor.Executor._stage_replay": (
+        "h2d", "data",
+        "result-cache replay re-stage: stored host pages stage onto "
+        "the device for consumers above a non-sink cache point"),
+    "dist.executor.DistExecutor._stage_replay": (
+        "h2d", "data",
+        "mesh-path cache replay re-stage: replayed host pages commit "
+        "as mesh-REPLICATED arrays (shard_map consumers with "
+        "replicated in_specs need a consistent placement across "
+        "every device)"),
     "exec.executor.Executor.ivm_delta_states": (
         "d2h", "data",
         "IVM refresh delta fold: partial-state pages of the delta "
@@ -181,11 +192,13 @@ TRANSFER_REGISTRY: Dict[str, Tuple[str, str, str]] = {
         "partition split reads the validity mask of already-host "
         "pages"),
     "dist.spool.device_partition_pages": (
-        "h2d", "data",
+        "h2d+d2h", "data",
         "device-tier exchange partitioning: a host-resident input "
         "(cache replay) stages through the choke point, dictionary "
         "value-hash LUTs stage per distinct dictionary — device "
-        "pages pass through free (ISSUE 13)"),
+        "pages pass through free (ISSUE 13); the spool-stats plane "
+        "(ISSUE 15) pulls the nparts-long per-partition row-count "
+        "vector back per page"),
     "dist.spool.spool_blob": (
         "d2h", "data",
         "LAZY spool materialization: device-resident exchange pages "

@@ -62,6 +62,12 @@ SPAN_KINDS: Dict[str, str] = {
              "fault-tolerance paths' trace annotation",
     "speculate": "one straggler-speculation copy dispatched (attrs: "
                  "uri); win/loss lands on the task span",
+    "replan": "one adaptive re-plan evaluated at a stage boundary "
+              "(presto_tpu/adaptive/): attrs carry the flip/seed/"
+              "skew-hint counts, or rejected=true with the "
+              "verify_dag reason when the mutation rolled back — "
+              "the interval is the stats-summation + re-verify wall "
+              "the ROOFLINE §13 cost model prices",
     "xfer": "one metered host<->device crossing (exec/xfer.py choke "
             "points): d2h:<label> pulls pages/arrays to host (spill, "
             "exchange serialization, result decode), h2d:<label> "

@@ -64,7 +64,7 @@ import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from presto_tpu.connectors.split_filter import SplitFilterConnector
 from presto_tpu.dist import serde
@@ -96,8 +96,16 @@ class _PartitionSpool:
         self._entries: List = []  # (store, index) | ("page", p, est)
         self._page_bytes = 0
         self.released = False
+        # spool-stats plane (ISSUE 15): EXACT rows/bytes published
+        # into this partition, accumulated at put time and MONOTONE —
+        # they survive release/close so the coordinator's adaptive
+        # re-planner reads stable numbers whenever it asks, and a
+        # replayed task re-accumulates identical values (the spool
+        # content is deterministic)
+        self.stat_rows = 0
+        self.stat_bytes = 0
 
-    def put(self, blob: bytes, to_disk: bool) -> None:
+    def put(self, blob: bytes, to_disk: bool, rows: int = 0) -> None:
         from presto_tpu.exec.pagestore import PageStore
 
         if to_disk:
@@ -109,13 +117,17 @@ class _PartitionSpool:
             store = self._host
         store.put_bytes(blob)
         self._entries.append((store, store.page_count - 1))
+        self.stat_rows += int(rows)
+        self.stat_bytes += len(blob)
 
-    def put_page(self, page, est_bytes: int) -> None:
+    def put_page(self, page, est_bytes: int, rows: int = 0) -> None:
         """Spool one partitioned Page WITHOUT serializing (the device-
         resident tier). est_bytes is the static page footprint — the
         resident-budget accounting the blob tier does by len(blob)."""
         self._entries.append(("page", page, est_bytes))
         self._page_bytes += est_bytes
+        self.stat_rows += int(rows)
+        self.stat_bytes += int(est_bytes)
 
     def blob(self, token: int) -> bytes:
         entry = self._entries[token]
@@ -161,14 +173,14 @@ class _TaskSpool:
         self.host_budget = host_budget
         self.host_bytes = 0
 
-    def put(self, p: int, blob: bytes) -> None:
+    def put(self, p: int, blob: bytes, rows: int = 0) -> None:
         to_disk = (self.host_budget > 0
                    and self.host_bytes + len(blob) > self.host_budget)
         if not to_disk:
             self.host_bytes += len(blob)
-        self.parts[p].put(blob, to_disk)
+        self.parts[p].put(blob, to_disk, rows=rows)
 
-    def put_page(self, p: int, page) -> None:
+    def put_page(self, p: int, page, rows: int = 0) -> None:
         """Device-exchange tier: spool the partitioned Page itself.
         The spool_exchange_bytes budget bounds RESIDENT bytes across
         tiers — a page past it materializes eagerly (spool_blob) and
@@ -181,10 +193,10 @@ class _TaskSpool:
                 self.host_budget:
             from presto_tpu.dist import spool as SPOOL
 
-            self.put(p, SPOOL.spool_blob(page))
+            self.put(p, SPOOL.spool_blob(page), rows=rows)
             return
         self.host_bytes += est
-        self.parts[p].put_page(page, est)
+        self.parts[p].put_page(page, est, rows=rows)
 
     @property
     def page_count(self) -> int:
@@ -193,6 +205,14 @@ class _TaskSpool:
     @property
     def byte_count(self) -> int:
         return sum(p.bytes for p in self.parts)
+
+    def part_stats(self) -> Tuple[List[int], List[int]]:
+        """(rows, bytes) per partition — the stage-boundary stats the
+        adaptive re-planner sums coordinator-side (ISSUE 15). Exact
+        and monotone: accumulated at publish time, stable across
+        release and identical after a deterministic replay."""
+        return ([p.stat_rows for p in self.parts],
+                [p.stat_bytes for p in self.parts])
 
     def release(self, p: int) -> bool:
         if 0 <= p < len(self.parts):
@@ -241,7 +261,7 @@ class _Task:
     # writes live in TaskRuntime/route_* but the contract is the
     # task's; the runtime sanitizer enforces it per instance)
     _shared_attrs = ("pages", "spool", "done", "error", "cancelled",
-                     "spans")
+                     "spans", "boost_retries", "skew_preempted")
 
     def __init__(self, task_id: str):
         self.task_id = task_id
@@ -250,6 +270,13 @@ class _Task:
         self.done = False
         self.error: Optional[str] = None
         self.cancelled = False
+        # per-task executor outcomes shipped on the status plane
+        # (ISSUE 15): overflow-ladder re-entries and pre-engaged skew
+        # chunking, mirrored onto the coordinator's registry counters
+        # so "first-run boosts driven to zero" is visible where the
+        # adaptive re-planner's own counters live
+        self.boost_retries = 0
+        self.skew_preempted = 0
         self.lock = make_lock("server.worker._Task.lock")
         # lifecycle tracing (ISSUE 9): interval math on monotonic,
         # ONE wall anchor for cross-node correlation — the span
@@ -629,7 +656,17 @@ def route_task_get(app, path: str, query: str):
                 "spooledBytes": spool.byte_count if spool else 0,
                 "partitions": len(spool.parts) if spool else 1,
                 "error": task.error,
+                # spool-stats plane (ISSUE 15): exact per-partition
+                # row/byte counts + executor outcomes, summed
+                # coordinator-side at the stage boundary — the input
+                # the adaptive re-planner re-optimizes from
+                "boostRetries": task.boost_retries,
+                "skewPreempted": task.skew_preempted,
             }
+            if spool is not None:
+                rows, nbytes = spool.part_stats()
+                body["spoolRows"] = rows
+                body["spoolBytes"] = nbytes
             if task.spans is not None:
                 # worker-side spans for the coordinator's cross-node
                 # timeline: offsets from this task's creation, plus
@@ -1035,20 +1072,29 @@ class TaskRuntime:
                         # themselves; host bytes materialize lazily
                         # only for HTTP (remote/replay) fetches. The
                         # ROOFLINE §11 d2h-at-emit term deletes here.
-                        pp = SPOOL.device_partition_pages(
-                            ex, page, out_keys, max(nparts, 1))
+                        # with_counts: the same program also emits the
+                        # per-partition row counts (spool-stats plane)
+                        pp, counts = SPOOL.device_partition_pages(
+                            ex, page, out_keys, max(nparts, 1),
+                            with_counts=True)
                         for p, part_page in pp:
-                            state["spool"].put_page(p, part_page)
+                            state["spool"].put_page(
+                                p, part_page, rows=int(counts[p]))
                         return len(pp)
                     host = XF.to_host(page, label="task-emit")
                     n = 0
                     for p, part_page in SPOOL.partition_host_page(
                             host, out_keys, max(nparts, 1)):
+                        # host pages: the validity mask is already
+                        # host numpy — the exact-count read is free
+                        rows = int(XF.np_host(part_page.valid).sum())
                         state["spool"].put(
-                            p, serde.serialize_page(part_page))
+                            p, serde.serialize_page(part_page),
+                            rows=rows)
                         n += 1
                     return n
 
+                ex.skew_preengaged = bool(req.get("skewHint"))
                 ex.stream_fragment(
                     partial, emit, cancelled=lambda: task.cancelled,
                     on_attempt=on_attempt,
@@ -1061,6 +1107,8 @@ class TaskRuntime:
                     if wtr is not None:
                         task.spans = wtr.export()
                     task.spool = state["spool"]
+                    task.boost_retries = ex.capacity_boost_retries
+                    task.skew_preempted = ex.skew_preempted
                     task.done = True
             else:
                 def emit(page) -> bytes:
@@ -1077,6 +1125,7 @@ class TaskRuntime:
                     if wtr is not None:
                         task.spans = wtr.export()
                     task.pages.extend(blobs)
+                    task.boost_retries = ex.capacity_boost_retries
                     task.done = True
         except Exception as e:  # noqa: BLE001 - task failures surface
             # to the coordinator via the X-Task-Error results header

@@ -450,6 +450,33 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1000,
         ),
         PropertyMetadata(
+            "adaptive_execution",
+            "runtime re-planning at spooled-exchange stage "
+            "boundaries (presto_tpu/adaptive/): when a stage's "
+            "spools finish, the not-yet-dispatched DAG suffix "
+            "re-optimizes from EXACT observed row/byte counts — "
+            "broadcast-vs-partitioned flips, join build re-orders, "
+            "capacity re-buckets onto the shapes ladder, skew "
+            "pre-engagement — re-verified by plan_check.verify_dag "
+            "before dispatch (a failed re-verify falls back to the "
+            "static plan, counted on adaptive_replan_rejected). "
+            "auto = on under the stage scheduler; false disables. "
+            "Counters: adaptive_replans / adaptive_dist_flips / "
+            "adaptive_capacity_seeds / adaptive_replan_rejected / "
+            "skew_preempted in EXPLAIN ANALYZE",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
+        ),
+        PropertyMetadata(
+            "adaptive_max_replans",
+            "per-query bound on adaptive re-plans applied at stage "
+            "boundaries (each re-plan re-verifies the mutated DAG; "
+            "the bound keeps re-verification wall off long DAGs "
+            "once the plan has settled). 0 observes stats but never "
+            "mutates",
+            int, 4,
+        ),
+        PropertyMetadata(
             "join_skew_rebalance",
             "on boosted retries, rebalance hot grace-join partitions "
             "by chunking build rows by position (buffers stay at the "

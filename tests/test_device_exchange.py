@@ -207,21 +207,34 @@ def test_mesh_local_exchange_zero_crossings(workers):
 
     def hook(fid):
         at_stage["totals"] = XF.process_totals()
+        at_stage["spooled"] = coord.runner.executor \
+            .spooled_exchange_pages
 
     coord._stage_hook = hook
     t0 = XF.process_totals()
+    spooled0 = coord.runner.executor.spooled_exchange_pages
     try:
         rows = coord.execute(Q3_FAMILY)
     finally:
         coord._stage_hook = None
     t1 = XF.process_totals()
     assert coord.last_distribution == "stage-dag"
-    # exchange phase: zero crossings end to end
+    # exchange phase: zero PAGE-DATA crossings end to end. The only
+    # d2h is the adaptive spool-stats plane (ISSUE 15): ONE int64
+    # per spooled partition entry — the per-partition row-count
+    # vector the device partition program emits alongside the pages
+    # (ROOFLINE §13). Pinning EXACT equality keeps the zero-copy
+    # contract falsifiable: any real page pull would dwarf 8
+    # bytes/entry.
     ex_h2d = at_stage["totals"]["h2d_bytes"] - t0["h2d_bytes"]
     ex_d2h = at_stage["totals"]["d2h_bytes"] - t0["d2h_bytes"]
+    stats_bytes = 8 * (at_stage["spooled"] - spooled0)
     assert ex_h2d == 0, f"exchange phase staged {ex_h2d} bytes h2d"
-    assert ex_d2h == 0, f"exchange phase pulled {ex_d2h} bytes d2h"
-    # whole query: nothing ever stages back; decode is the only d2h
+    assert ex_d2h == stats_bytes, (
+        f"exchange phase pulled {ex_d2h} bytes d2h — expected "
+        f"exactly the spool-stats vectors ({stats_bytes} bytes)")
+    # whole query: nothing ever stages back; decode (and the stats
+    # vectors) are the only d2h
     assert t1["h2d_bytes"] - t0["h2d_bytes"] == 0
     assert t1["d2h_bytes"] - t0["d2h_bytes"] > 0
     assert coord.runner.executor.mesh_local_exchanges >= 1

@@ -586,3 +586,71 @@ def test_runner_invalidation_reaches_wrapped_connector():
     assert r.execute("select * from t").rows == [(1,)]
     r.execute("insert into t select 2")
     assert sorted(r.execute("select * from t").rows) == [(1,), (2,)]
+
+
+# ---------------------------------------------- mesh-path residency
+def _mesh_runner(conn, n=2):
+    """A DistExecutor runner over an n-device CPU mesh (conftest
+    forces the host platform device count)."""
+    from presto_tpu.dist.executor import make_mesh
+    from presto_tpu.session import Session
+
+    return LocalRunner(
+        {"tpch": conn}, default_catalog="tpch", page_rows=PAGE_ROWS,
+        mesh=make_mesh(n),
+        session=Session(catalog="tpch",
+                        properties={"result_cache_enabled": True}),
+    )
+
+
+def test_mesh_root_hit_zero_crossings(conn):
+    """Transfer-ledger pin (ISSUE 15 satellite): a fragment hit at
+    the mesh root serves host pages straight through the extended
+    sink chain (Output + gather-over-replicated pass-throughs) —
+    ZERO h2d/d2h crossings on the replay."""
+    from presto_tpu.exec import xfer as XF
+
+    r = _mesh_runner(conn)
+    r.apply_session()
+    ex = r.executor
+    plan = r.plan(AGG_Q)
+    _, rows1 = ex.execute(plan)
+    assert ex.result_cache_hits == 0
+    base = XF.process_totals()
+    _, rows2 = ex.execute(plan)
+    assert rows1 == rows2
+    assert ex.result_cache_hits >= 1
+    assert ex.h2d_bytes == 0 and ex.d2h_bytes == 0
+    # falsifiable process-totals delta, not just the per-query gauges
+    now = XF.process_totals()
+    assert now["h2d_bytes"] == base["h2d_bytes"]
+    assert now["d2h_bytes"] == base["d2h_bytes"]
+
+
+def test_mesh_midplan_replicated_point_hits(conn):
+    """Mesh-path cache residency (ROADMAP item 6 remainder): a mesh
+    query whose ROOT is uncacheable still caches its REPLICATED
+    interior — the hit replays host pages (staged as mesh-replicated
+    arrays only for the device consumer above) and SKIPS the
+    gathered subtree's collectives entirely."""
+    from presto_tpu.exec import plan as PP
+
+    r = _mesh_runner(conn)
+    r.apply_session()
+    ex = r.executor
+    base = r.plan("select l_returnflag rf, sum(l_quantity) s "
+                  "from lineitem group by l_returnflag")
+    # UniqueId above the interior makes the root uncacheable; the
+    # replicated aggregated interior below is the mesh cache point
+    plan = PP.Output(source=PP.UniqueId(source=base.source),
+                     names=("rf", "s", "uid"))
+    _, rows1 = ex.execute(plan)
+    assert ex.result_cache_misses >= 1
+    m0 = ex.mesh_local_exchanges
+    _, rows2 = ex.execute(plan)
+    assert rows1 == rows2
+    assert ex.result_cache_hits >= 1, (
+        "no mid-plan cache point selected on the mesh (replicated "
+        "subtrees must be eligible)")
+    # the replayed subtree's compiled collectives never ran again
+    assert ex.mesh_local_exchanges == m0

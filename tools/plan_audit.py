@@ -29,8 +29,53 @@ sys.path.insert(
 from tools._common import make_runner, queries  # noqa: E402
 
 
+def _seeded_misestimate_sweep(runner, label: str, dag,
+                              failures: list) -> int:
+    """ISSUE 15: drive the runtime re-planner over this DAG with
+    SYNTHETIC >=10x-off observations (alternating over- and under-
+    estimates, plus an 80/20 skewed partition histogram) at every
+    stage boundary, and require the LIVE DAG to pass STRICT
+    verification after each replan — whether the mutation applied or
+    rolled back. This is the adaptive analog of the broken-plan
+    mutation suite: the re-planner must never leave the DAG in a
+    state the verifier cannot prove. Returns the number of applied
+    re-plans (0 = every boundary was a no-op or clean rollback)."""
+    from presto_tpu.adaptive import Replanner, StageStats
+    from presto_tpu.exec import plan_check as PC
+
+    ex = runner.executor
+    rp = Replanner(ex, dag, broadcast_rows=1 << 21,
+                   max_replans=16, strict=True)
+    dispatched: set = set()
+    applied = 0
+    for frag in dag.fragments:
+        dispatched.add(frag.fid)
+        est = max(int(ex.estimate_rows(frag.root)), 2)
+        obs = est * 10 if frag.fid % 2 else max(est // 10, 1)
+        hot = max(int(obs * 0.8), 1)
+        rp.observe(StageStats(
+            fid=frag.fid, rows=obs, bytes=obs * 16,
+            part_rows=(hot, max(obs - hot, 0)),
+            part_bytes=(hot * 16, max(obs - hot, 0) * 16),
+            task_rows=(obs // 2, obs - obs // 2),
+        ))
+        out = rp.replan(set(dispatched))
+        if out is not None and not out.rejected:
+            applied += 1
+        try:
+            PC.verify_dag(ex, dag, strict=True)
+        except PC.PlanCheckError as e:
+            failures.append((label, [
+                f"[adaptive seeded-misestimate, after stage "
+                f"{frag.fid}] {v}" for v in e.violations]))
+            print(f"# {label}: ADAPTIVE SWEEP FAILED after stage "
+                  f"{frag.fid}", file=sys.stderr)
+            return applied
+    return applied
+
+
 def _audit_one(runner, label: str, sql: str, failures: list,
-               dag_stats: list) -> None:
+               dag_stats: list, replans: list) -> None:
     from presto_tpu.dist.fragmenter import fragment_dag
     from presto_tpu.exec import plan_check as PC
 
@@ -73,8 +118,14 @@ def _audit_one(runner, label: str, sql: str, failures: list,
                 print(f"#   - {v}", file=sys.stderr)
             return
         dag_stats.append(len(dag.fragments))
-        print(f"# {label}: ok ({len(dag.fragments)}-stage dag)",
-              file=sys.stderr)
+        # ISSUE 15: the seeded-misestimate adaptive sweep runs over
+        # the SAME (already statically-verified) DAG — mutating it is
+        # fine, nothing re-reads it after this point
+        applied = _seeded_misestimate_sweep(runner, label, dag,
+                                            failures)
+        replans.append(applied)
+        print(f"# {label}: ok ({len(dag.fragments)}-stage dag, "
+              f"{applied} seeded re-plans)", file=sys.stderr)
     else:
         print(f"# {label}: ok (not dag-distributable)",
               file=sys.stderr)
@@ -98,6 +149,7 @@ def main() -> int:
     t0 = time.time()
     failures: list = []
     dag_stats: list = []
+    replans: list = []
     n = 0
     if do_rungs:
         from bench import RUNGS
@@ -108,19 +160,21 @@ def main() -> int:
             # prewarm path verifies the same plans before compiling
             runner = make_runner(suite, sf, props)
             _audit_one(runner, f"rung {name}",
-                       queries(suite)[qid], failures, dag_stats)
+                       queries(suite)[qid], failures, dag_stats,
+                       replans)
             n += 1
     for suite in corpora:
         runner = make_runner(suite, args.sf)
         for qid, sql in sorted(queries(suite).items()):
             _audit_one(runner, f"{suite} q{qid}", sql, failures,
-                       dag_stats)
+                       dag_stats, replans)
             n += 1
     wall = time.time() - t0
     multi = sum(1 for s in dag_stats if s >= 2)
     print(f"# plan_audit: {n} plans, {len(failures)} with violations, "
           f"{len(dag_stats)} dag-distributable "
-          f"({multi} multi-stage), {wall:.1f}s", file=sys.stderr)
+          f"({multi} multi-stage), {sum(replans)} seeded adaptive "
+          f"re-plans applied, {wall:.1f}s", file=sys.stderr)
     if failures:
         print("PLAN AUDIT FAILED:")
         for label, violations in failures:
@@ -128,7 +182,8 @@ def main() -> int:
                 print(f"  {label}: {v}")
         return 1
     print(f"plan audit clean: {n} plans verified "
-          f"({len(dag_stats)} stage DAGs) in {wall:.1f}s")
+          f"({len(dag_stats)} stage DAGs, {sum(replans)} seeded "
+          f"adaptive re-plans) in {wall:.1f}s")
     return 0
 
 
