@@ -542,6 +542,9 @@ def group_child(only_names) -> int:
             ex.adaptive_capacity_seeds = 0
             ex.adaptive_replan_rejected = 0
             ex.skew_preempted = 0
+            ex.exchange_wire_bytes = 0
+            ex.exchange_raw_bytes = 0
+            ex.exchange_fetch_reused_conns = 0
             pages = list(ex.pages(plan))
             drain(pages)
             flags = list(ex._pending_overflow)
@@ -595,6 +598,14 @@ def group_child(only_names) -> int:
                 "adaptive_replan_rejected":
                     ex.adaptive_replan_rejected,
                 "skew_preempted": ex.skew_preempted,
+                # wire-efficient exchange plane (ISSUE 16, dist/serde
+                # + dist/connpool): post-codec vs pre-codec exchange
+                # bytes and keep-alive reuse (0 on the local pages()
+                # drive — the DCN boundary is where pages serialize)
+                "exchange_wire_bytes": ex.exchange_wire_bytes,
+                "exchange_raw_bytes": ex.exchange_raw_bytes,
+                "exchange_fetch_reused_conns":
+                    ex.exchange_fetch_reused_conns,
             }
 
         # ---- first (warm-up) run doubles as the BOOST-SETTLE loop:

@@ -57,11 +57,12 @@ import json
 import random
 import time
 import urllib.error
-import urllib.request
 import uuid
 from typing import Dict, List, Optional
 
+from presto_tpu.dist import connpool as CONNPOOL
 from presto_tpu.dist import plan_serde, serde
+from presto_tpu.dist import spool as SPOOL
 from presto_tpu.exec import faults as FAULTS
 from presto_tpu.exec import plan as P
 from presto_tpu.exec.executor import QueryDeadlineExceeded
@@ -228,7 +229,7 @@ class DcnRunner:
         errors return [] — the timeline loses the worker detail, the
         query loses nothing."""
         try:
-            with urllib.request.urlopen(
+            with CONNPOOL.request(
                 f"{st.uri}/v1/task/{st.task_id}", timeout=5
             ) as r:
                 return json.loads(r.read().decode()).get("spans") or []
@@ -237,12 +238,15 @@ class DcnRunner:
             return []
 
     def _post_task(self, uri: str, payload: Dict) -> Dict:
-        req = urllib.request.Request(
+        # connpool never replays a POST on a reused socket — a task
+        # submit must reach the worker at most once per attempt
+        with CONNPOOL.request(
             f"{uri}/v1/task",
+            method="POST",
             data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=30) as resp:
+            timeout=30,
+        ) as resp:
             return json.loads(resp.read().decode())
 
     @staticmethod
@@ -308,22 +312,28 @@ class DcnRunner:
                     # no ?part: the coordinator drains gather edges
                     # only (partition 0 / legacy byte buffers) —
                     # worker-to-worker partition fetches live in
-                    # dist/spool.fetch_spool_blobs
-                    req = urllib.request.Request(
+                    # dist/spool.fetch_spool_blobs. ?max streams up
+                    # to a bounded window of page frames per request
+                    # (pooled keep-alive connection), decoded
+                    # incrementally: the token, hasher, and yield
+                    # advance one FRAME at a time, so a mid-stream
+                    # transport failure resumes at the first
+                    # unconsumed page with the replay hash intact.
+                    with CONNPOOL.request(
                         f"{st.uri}/v1/task/{st.task_id}/results/"
                         f"{st.next_token}"
-                    )
-                    with urllib.request.urlopen(req, timeout=60) as r:
+                        f"?max={SPOOL.FETCH_WINDOW_BYTES}",
+                        timeout=60,
+                    ) as r:
                         if r.status == 204:
                             if r.headers.get("X-Done") == "1":
                                 return
                             break  # long-poll timeout; re-ask
-                        body = r.read()
-                        nxt = int(r.headers["X-Next-Token"])
-                        page = serde.deserialize_page(body)
-                        st.hasher.update(body)
-                        st.next_token = nxt
-                        yield page
+                        for body in SPOOL.iter_response_frames(r):
+                            page = serde.deserialize_page(body)
+                            st.hasher.update(body)
+                            st.next_token += 1
+                            yield page
                         break
                 except (urllib.error.URLError, urllib.error.HTTPError,
                         ConnectionError, OSError) as e:
@@ -353,15 +363,22 @@ class DcnRunner:
         while token < st.next_token:
             self._check_deadline(deadline)
             try:
-                req = urllib.request.Request(
-                    f"{uri}/v1/task/{task_id}/results/{token}")
-                with urllib.request.urlopen(req, timeout=60) as r:
+                with CONNPOOL.request(
+                    f"{uri}/v1/task/{task_id}/results/{token}"
+                    f"?max={SPOOL.FETCH_WINDOW_BYTES}", timeout=60,
+                ) as r:
                     if r.status == 204:
                         if r.headers.get("X-Done") == "1":
                             return False  # fewer pages than consumed
                         continue  # long-poll timeout; re-ask
-                    h.update(r.read())
-                    token = int(r.headers["X-Next-Token"])
+                    for body in SPOOL.iter_response_frames(r):
+                        h.update(body)
+                        token += 1
+                        if token >= st.next_token:
+                            # frames past the consumed prefix are NOT
+                            # part of the hash; the response close
+                            # discards the remainder
+                            break
                     attempt = 0
             except (urllib.error.URLError, ConnectionError, OSError) as e:
                 self._raise_if_task_error(e, uri, task_id)
@@ -381,10 +398,10 @@ class DcnRunner:
         analyze_rung, DcnRunner.release_skips) reads. THE one release
         site for both the legacy cuts and the stage-DAG scheduler."""
         try:
-            req = urllib.request.Request(
-                f"{uri}/v1/task/{task_id}", method="DELETE"
-            )
-            urllib.request.urlopen(req, timeout=5).close()
+            with CONNPOOL.request(
+                f"{uri}/v1/task/{task_id}", method="DELETE", timeout=5
+            ) as r:
+                r.read()
         except (urllib.error.URLError, OSError, TimeoutError):
             self.runner.executor.release_skips += 1
 

@@ -50,10 +50,10 @@ import hashlib
 import json
 import time
 import urllib.error
-import urllib.request
 from typing import Dict, List, Optional
 
 from presto_tpu import events as E_events
+from presto_tpu.dist import connpool as CONNPOOL
 from presto_tpu.dist import plan_serde
 from presto_tpu.dist.fragmenter import (
     StageDag,
@@ -246,7 +246,7 @@ class StageScheduler:
         last: Optional[BaseException] = None
         for _ in range(2):
             try:
-                with urllib.request.urlopen(
+                with CONNPOOL.request(
                     f"{pl.uri}/v1/task/{pl.task_id}", timeout=5
                 ) as r:
                     return json.loads(r.read().decode())

@@ -51,9 +51,9 @@ from __future__ import annotations
 
 import functools
 import json
+import struct
 import time
 import urllib.error
-import urllib.request
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -408,6 +408,57 @@ class SourceLost(RuntimeError):
         self.task_id = task_id
 
 
+# the default bounded in-flight-bytes window for one streaming fetch
+# response (ISSUE 16): the server packs consecutive page frames into
+# one response only up to this many bytes, and the client decodes
+# frame-at-a-time off the socket — so consumer host memory per edge
+# stays O(window), not O(partition), while fetch overlaps decode.
+FETCH_WINDOW_BYTES = 4 << 20
+
+
+def pack_frames(blobs: Sequence[bytes]) -> bytes:
+    """Server-side framing of a streamed results response: each page
+    blob rides as `<q len | bytes>` so the consumer can decode pages
+    incrementally off the socket (dedupe-by-token still holds — the
+    token advances one per frame on both ends)."""
+    out = bytearray()
+    for b in blobs:
+        out.extend(struct.pack("<q", len(b)))
+        out.extend(b)
+    return bytes(out)
+
+
+def _read_exact(r, n: int, *, eof_ok: bool = False) -> Optional[bytes]:
+    """Read exactly n bytes from a response; None at a clean EOF when
+    `eof_ok` (frame boundary). A mid-frame EOF raises ConnectionError
+    — the transport-retry ladders treat it like any broken fetch."""
+    chunks = []
+    got = 0
+    while got < n:
+        c = r.read(n - got)
+        if not c:
+            if eof_ok and got == 0:
+                return None
+            raise ConnectionError(
+                f"truncated page frame: got {got} of {n} bytes")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+def iter_response_frames(r) -> Iterator[bytes]:
+    """Incremental client half of `pack_frames`: yield each page blob
+    as it comes off the socket, holding at most ONE frame in memory."""
+    while True:
+        head = _read_exact(r, 8, eof_ok=True)
+        if head is None:
+            return
+        (ln,) = struct.unpack("<q", head)
+        if ln < 0:
+            raise ConnectionError(f"corrupt page-frame length {ln}")
+        yield _read_exact(r, ln)
+
+
 def fetch_spool_blobs(
     uri: str,
     task_id: str,
@@ -418,12 +469,21 @@ def fetch_spool_blobs(
     backoff_s: float = 0.1,
     timeout: float = 60.0,
     deadline: Optional[float] = None,
+    window_bytes: Optional[int] = None,
 ) -> Iterator[bytes]:
-    """Token-acked fetch of one spool partition (at-least-once +
-    dedupe-by-token, the HttpPageBufferClient protocol with the
-    partition dimension added). Raises SourceTaskFailed on
+    """Token-acked streaming fetch of one spool partition
+    (at-least-once + dedupe-by-token, the HttpPageBufferClient
+    protocol with the partition dimension added). Each request drains
+    up to `window_bytes` of consecutive page frames on a pooled
+    keep-alive connection (dist/connpool.py); the token advances one
+    per yielded frame, so a mid-stream transport failure resumes at
+    the first unconsumed page. Raises SourceTaskFailed on
     X-Task-Error, SourceLost after bounded transport retries."""
+    from presto_tpu.dist import connpool as CONNPOOL
+
     token = start_token
+    window = FETCH_WINDOW_BYTES if window_bytes is None \
+        else int(window_bytes)
     while True:
         attempt = 0
         while True:
@@ -437,18 +497,17 @@ def fetch_spool_blobs(
                     "fetch"
                 )
             try:
-                req = urllib.request.Request(
+                with CONNPOOL.request(
                     f"{uri}/v1/task/{task_id}/results/{token}"
-                    f"?part={part}"
-                )
-                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    f"?part={part}&max={window}", timeout=timeout,
+                ) as r:
                     if r.status == 204:
                         if r.headers.get("X-Done") == "1":
                             return
                         break  # long-poll timeout; re-ask same token
-                    body = r.read()
-                    token = int(r.headers["X-Next-Token"])
-                    yield body
+                    for body in iter_response_frames(r):
+                        token += 1
+                        yield body
                     break
             except urllib.error.HTTPError as e:
                 if e.headers.get("X-Task-Error"):
@@ -595,11 +654,14 @@ def ack_spool(uri: str, task_id: str, part: int,
     """Release one consumed spool partition on the producer (the ack
     half of the fetch/ack protocol). Best-effort: a dead producer has
     nothing left to free."""
+    from presto_tpu.dist import connpool as CONNPOOL
+
     try:
-        req = urllib.request.Request(
-            f"{uri}/v1/task/{task_id}/spool/{part}", method="DELETE"
-        )
-        urllib.request.urlopen(req, timeout=timeout).close()
+        with CONNPOOL.request(
+            f"{uri}/v1/task/{task_id}/spool/{part}", method="DELETE",
+            timeout=timeout,
+        ) as r:
+            r.read()
         return True
     except (urllib.error.URLError, OSError, TimeoutError):
         return False

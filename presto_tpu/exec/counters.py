@@ -152,6 +152,19 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "gauge", "donated-program invocations this attempt "
         "(fold/topn merge accumulators reusing their input's HBM in "
         "place via donate_argnums; buffer_donation_enabled)"),
+    "exchange_wire_bytes": (
+        "counter", "exchange-page bytes actually shipped on the wire "
+        "by dist/serde.serialize_page (post-codec blob size; "
+        "executor lifetime — exchange_raw_bytes / exchange_wire_bytes "
+        "is the wire compression ratio)"),
+    "exchange_raw_bytes": (
+        "counter", "pre-codec array bytes behind the serialized "
+        "exchange pages (what a raw wire would have shipped; "
+        "executor lifetime)"),
+    "exchange_fetch_reused_conns": (
+        "counter", "shuffle-plane HTTP requests served on a reused "
+        "keep-alive connection from dist/connpool.py instead of a "
+        "fresh TCP connect (executor lifetime)"),
     "mesh_local_exchanges": (
         "counter", "exchanges that never left the device/process: "
         "spooled edges served Pages directly between same-process "

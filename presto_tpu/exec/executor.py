@@ -600,6 +600,18 @@ class Executor:
         # lifetime, like the spooled-exchange counters).
         self.device_exchange = "auto"
         self.mesh_local_exchanges = 0
+        # ---- exchange wire plane (ISSUE 16, dist/serde.py +
+        # dist/connpool.py): lifetime counters metered through the
+        # thread-bound transfer sink, like the crossings above.
+        # exchange_wire_bytes = post-codec blob bytes serialize_page
+        # shipped; exchange_raw_bytes = the pre-codec array bytes
+        # behind them (ratio = wire compression);
+        # exchange_fetch_reused_conns = shuffle-plane requests served
+        # on a reused keep-alive connection instead of a fresh TCP
+        # connect.
+        self.exchange_wire_bytes = 0
+        self.exchange_raw_bytes = 0
+        self.exchange_fetch_reused_conns = 0
         # ---- streaming subsystem (ISSUE 14, presto_tpu/streaming/ +
         # connectors/stream.py): lifetime counters mirrored onto the
         # executor so every surface (EXPLAIN ANALYZE, /metrics,
@@ -640,6 +652,20 @@ class Executor:
             self.d2h_transfers += 1
             self.d2h_bytes += nbytes
         self.transfer_wall_s += wall_s
+
+    def count_wire(self, wire: int, raw: int) -> None:
+        """Registry-counter sink dist/serde.serialize_page meters
+        exchange wire bytes to while this executor is the
+        thread-bound sink (exec/xfer.py current_sink) — the
+        compression-ratio pair every surface renders."""
+        self.exchange_wire_bytes += wire
+        self.exchange_raw_bytes += raw
+
+    def count_reused_conn(self) -> None:
+        """Registry-counter sink for dist/connpool.py: one
+        shuffle-plane HTTP request served on a reused keep-alive
+        connection."""
+        self.exchange_fetch_reused_conns += 1
 
     def _reset_transfer_gauges(self) -> None:
         """Per-query transfer-gauge reset (execute(),

@@ -331,6 +331,15 @@ def swap_sink(sink) -> Optional[object]:
     return prev
 
 
+def current_sink() -> Optional[object]:
+    """THIS thread's metering sink (an Executor, or None). The wire
+    plane (dist/serde.py, dist/connpool.py) meters exchange bytes and
+    connection reuse onto the same thread-bound sink the transfer
+    choke points use, so the registry counters land on whichever
+    executor owns the running fragment/query."""
+    return getattr(_tls, "sink", None)
+
+
 def _device_nbytes(tree) -> int:
     """Bytes that would cross d2h: the summed size of device-backed
     (jax.Array) leaves. numpy leaves are already host — zero."""

@@ -67,6 +67,10 @@ LOCK_REGISTRY: Dict[str, str] = {
     "connectors.stream.StreamConnector._cv":
         "the append-log table map + offset advance; appends "
         "notify_all so tailing long-pollers (wait_for_offset) wake",
+    "dist.connpool.ConnectionPool._lock":
+        "the per-destination keep-alive connection free-lists + "
+        "reuse/failover tallies (take/put are pure list ops — every "
+        "connect, send, and read happens OUTSIDE the lock)",
     "compilecache._lock":
         "process-wide XLA compile/cache counters fed by jax.monitoring "
         "listeners",

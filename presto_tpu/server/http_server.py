@@ -438,6 +438,20 @@ def _xfer_totals():
     return XFER.process_totals()
 
 
+def _wire_totals():
+    """Process-total exchange wire tallies (dist/serde.py codecs +
+    dist/connpool.py reuse) under the registry counter names — same
+    rationale as _xfer_totals: worker task executors never surface
+    on the scrape path, the process accumulation is the fleet truth
+    loadbench grades wire efficiency from."""
+    from presto_tpu.dist import connpool as CONNPOOL
+    from presto_tpu.dist import serde as SERDE
+
+    out = SERDE.wire_totals()
+    out.update(CONNPOOL.pool_totals())
+    return out
+
+
 def _result_cache_totals():
     """Process-total result-cache tallies under the registry counter
     names (zeros when no session ever created the shared store —
@@ -784,6 +798,10 @@ class QueryManager:
             # process totals — the aggregate copy tax next to QPS/p99)
             xf = _xfer_totals()
             snap.update({k: int(v) for k, v in xf.items()
+                         if k in CTRS.QUERY_COUNTERS})
+            # exchange wire/codec + connection-reuse totals ride the
+            # same process-shared overlay (dist/serde, dist/connpool)
+            snap.update({k: int(v) for k, v in _wire_totals().items()
                          if k in CTRS.QUERY_COUNTERS})
             for name, (kind, _help) in CTRS.QUERY_COUNTERS.items():
                 suffix = "_total" if kind == "counter" else ""
@@ -1356,6 +1374,8 @@ class PrestoTpuServer:
             snap.update(_result_cache_totals())
             xf = _xfer_totals()
             snap.update({k: int(v) for k, v in xf.items()
+                         if k in CTRS.QUERY_COUNTERS})
+            snap.update({k: int(v) for k, v in _wire_totals().items()
                          if k in CTRS.QUERY_COUNTERS})
             out.extend(sorted(snap.items()))
             # the float crossing wall rides as integer milliseconds

@@ -576,14 +576,22 @@ def route_task_get(app, path: str, query: str):
     from urllib.parse import parse_qs
 
     parts = [p for p in path.split("/") if p]
-    # /v1/task/{id}/results/{token}[?part=p]
+    # /v1/task/{id}/results/{token}[?part=p][&max=bytes]
     if len(parts) == 5 and parts[:2] == ["v1", "task"] \
             and parts[3] == "results":
         task = app.get_task(parts[2])
         if task is None:
             return _jresp({"error": "no such task"}, 404)
         token = int(parts[4])
-        part = int(parse_qs(query or "").get("part", ["0"])[0])
+        qs = parse_qs(query or "")
+        part = int(qs.get("part", ["0"])[0])
+        # ?max engages the streaming/ranged response (ISSUE 16): up
+        # to `max` bytes of CONSECUTIVE page frames ship in one
+        # framed body (dist/spool.pack_frames) so the consumer drains
+        # a partition page-at-a-time under a bounded in-flight-bytes
+        # window. Absent ?max, the legacy single-blob shape is served
+        # unchanged.
+        max_bytes = int(qs.get("max", ["0"])[0])
         if app.maybe_inject_fault():
             return _jresp({"error": "injected fault"}, 500)
         # bounded long-poll until the page at `token` exists or the
@@ -637,8 +645,53 @@ def route_task_get(app, path: str, query: str):
                         {"error": f"spool partition {part} released "
                                   f"(already acked)"}, 410)
             if blob is not None:
-                return (200, [("X-Next-Token", str(token + 1)),
-                              ("X-Done", "0")], _PAGES_CT, blob)
+                if max_bytes <= 0:
+                    # legacy single-blob response shape
+                    return (200, [("X-Next-Token", str(token + 1)),
+                                  ("X-Done", "0")], _PAGES_CT, blob)
+                from presto_tpu.dist import spool as SPOOL
+
+                # streaming/ranged response: extend with CONSECUTIVE
+                # ready frames until the byte window fills. Frames
+                # stop once the total reaches max_bytes, so one
+                # response carries at most window + one page — the
+                # consumer's bounded in-flight-bytes contract. Extra
+                # frames are best-effort: any race (ack, store close)
+                # just ends the range and the next request sees the
+                # canonical 410/204 answer.
+                frames = [blob]
+                total = 8 + len(blob)
+                while total < max_bytes:
+                    nxt = token + len(frames)
+                    entry2 = blob2 = None
+                    with task.lock:
+                        if task.error or task.part_released(part):
+                            break
+                        if nxt >= task.part_count(part):
+                            break
+                        if task.spool is not None:
+                            entry2 = (task.spool.parts[part]
+                                      ._entries[nxt])
+                        else:
+                            blob2 = task.pages[nxt]
+                    if entry2 is not None:
+                        try:
+                            if entry2[0] == "page":
+                                blob2 = SPOOL.spool_blob(entry2[1])
+                            else:
+                                store, i = entry2
+                                blob2 = store.blob_at(i)
+                        except (OSError, IndexError):
+                            break
+                    if blob2 is None:
+                        break
+                    frames.append(blob2)
+                    total += 8 + len(blob2)
+                return (200,
+                        [("X-Next-Token", str(token + len(frames))),
+                         ("X-Done", "0"),
+                         ("X-Frames", str(len(frames)))],
+                        _PAGES_CT, SPOOL.pack_frames(frames))
             time.sleep(0.02)
         return (204, [("X-Done", "0")], _JSON_CT, b"")
     if len(parts) == 3 and parts[:2] == ["v1", "task"]:
@@ -710,6 +763,13 @@ def route_task_delete(app, path: str):
 
 class _WorkerHandler(BaseHTTPRequestHandler):
     server_version = "presto-tpu-worker/0.3"
+    # HTTP/1.1 so the shuffle plane's pooled clients
+    # (dist/connpool.py) get keep-alive for real; every response path
+    # sends Content-Length (write_task_response; 204s ship no body).
+    # The socket timeout bounds how long an idle keep-alive handler
+    # thread lingers after its client forgets it.
+    protocol_version = "HTTP/1.1"
+    timeout = 120
 
     def log_message(self, fmt, *args):  # noqa: A003
         pass

@@ -1,14 +1,18 @@
 #!/usr/bin/env bash
-# Pre-PR static gate (ISSUE 6 + ISSUE 11 + ISSUE 12): the
+# Pre-PR static gate (ISSUE 6 + ISSUE 11 + ISSUE 12 + ISSUE 16): the
 # engine-invariant linter, the concurrency soundness pass (lock
 # registry + acquisition graph + blocking-under-lock), the
 # host<->device transfer audit (transfer registry + plane
-# classification + choke-point routing), and the full plan audit
-# (bench rungs + TPC-H/TPC-DS corpus, strict mode). Pure host Python — nothing
-# compiles or touches a device — so the whole gate runs in well under
-# 60 s on the 2-core box (combined budget: <= 30 s for the static
-# rules, the rest for the plan audit). bench.py --prewarm runs the
-# same plan verifier per rung before compiling.
+# classification + choke-point routing), the full plan audit
+# (bench rungs + TPC-H/TPC-DS corpus, strict mode), and the wire-serde
+# property suite (codec x type round-trip matrix, byte-stability,
+# truncation/corruption rejection — the pure-serde subset; the
+# WorkerServer-backed streaming/pool tests stay in tier 1). Pure host
+# Python — nothing compiles or touches a device — so the whole gate
+# runs in well under 60 s on the 2-core box (combined budget: <= 30 s
+# for the static rules, the rest for the plan audit + serde suite).
+# bench.py --prewarm runs the same plan verifier per rung before
+# compiling.
 #
 # Usage: tools/ci_static.sh   (exit nonzero on any finding/violation)
 set -euo pipefail
@@ -26,5 +30,12 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/xfercheck.py
 
 echo "# ci_static: plan audit (tools/plan_audit.py)" >&2
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/plan_audit.py
+
+echo "# ci_static: wire-serde property suite (tests/test_wire_serde.py)" >&2
+# pure-serde subset: everything that does not spin a WorkerServer
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_wire_serde.py -q -p no:cacheprovider \
+    -k "not spooled_task and not connpool and not streaming \
+        and not q3_family and not executor_surface"
 
 echo "# ci_static: clean in $(( $(date +%s) - t0 ))s" >&2

@@ -25,7 +25,13 @@ Reported (one JSON line on stdout, like bench.py's driver contract):
       ``d2h_bytes``/``transfer_wall_seconds`` process totals from
       exec/xfer.py, base-subtracted), visible next to QPS/p99 so a
       serving-path change that re-introduces redundant crossings
-      shows up in the same JSON line that grades its latency.
+      shows up in the same JSON line that grades its latency,
+  exchange_wire_bytes / exchange_raw_bytes /
+  exchange_fetch_reused_conns — wire efficiency of the exchange plane
+      (ISSUE 16, the ``presto_tpu_exchange_*`` process totals from
+      dist/serde.py codecs and dist/connpool.py keep-alive reuse,
+      base-subtracted; 0 on single-process runs where no page ever
+      crosses the DCN boundary).
 
 ``--sanitize`` (ISSUE 11) arms the runtime lock sanitizer
 (presto_tpu/obs/sanitizer.py) before the self-hosted server builds a
@@ -173,6 +179,10 @@ def run_load(server: str, clients: int, duration_s: float,
     base_h2d = _metric(pre, "presto_tpu_h2d_bytes")
     base_d2h = _metric(pre, "presto_tpu_d2h_bytes")
     base_wall = _metric_f(pre, "presto_tpu_transfer_wall_seconds")
+    base_wire = _metric(pre, "presto_tpu_exchange_wire_bytes_total")
+    base_eraw = _metric(pre, "presto_tpu_exchange_raw_bytes_total")
+    base_reuse = _metric(
+        pre, "presto_tpu_exchange_fetch_reused_conns_total")
 
     t0 = time.time()
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
@@ -209,6 +219,17 @@ def run_load(server: str, clients: int, duration_s: float,
         "transfer_wall_ms": round(
             (_metric_f(post, "presto_tpu_transfer_wall_seconds")
              - base_wall) * 1000, 1),
+        # exchange wire efficiency (ISSUE 16): post-codec vs pre-codec
+        # bytes crossing the DCN boundary, and keep-alive reuse, from
+        # the dist/serde + dist/connpool process totals on /metrics
+        # (0 on single-process runs — no page ever serializes)
+        "exchange_wire_bytes": _metric(
+            post, "presto_tpu_exchange_wire_bytes_total") - base_wire,
+        "exchange_raw_bytes": _metric(
+            post, "presto_tpu_exchange_raw_bytes_total") - base_eraw,
+        "exchange_fetch_reused_conns": _metric(
+            post, "presto_tpu_exchange_fetch_reused_conns_total")
+            - base_reuse,
     }
 
 
