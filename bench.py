@@ -412,6 +412,27 @@ def main() -> int:
                 info if info is not None else {"error": err}
             )
         _write_details(details)
+
+        # ---- phase 5 (ISSUE 17): the concurrent-serving rung — the
+        # loadbench batching A/B child, recorded per round like every
+        # other rung. Skip-on-budget, and an SLO failure is reported
+        # in the details, never allowed to zero the ladder's exit.
+        remaining = deadline - time.time()
+        if remaining < 180:
+            details["load_skipped"] = "bench budget exhausted"
+            _write_details(details)
+        else:
+            info, err = _run_child(
+                [sys.executable, __file__, "--load"],
+                timeout=remaining,
+            )
+            # the child wrote details["load"] itself — re-read before
+            # adding the summary so it survives
+            details = _read_details()
+            details["load_summary"] = (
+                info if info is not None else {"error": err}
+            )
+            _write_details(details)
         return 0
     finally:
         # the driver contract: exactly one JSON line, no matter what
@@ -1205,6 +1226,76 @@ def sqlite_child() -> int:
     return 0
 
 
+def load_child() -> int:
+    """ISSUE 17: the concurrent-serving rung. Runs tools/loadbench.py
+    twice over the SAME fixed mixed deck (8 clients, 80% repeated
+    statements, fixed seed) — cross-query batching pinned OFF, then
+    ON — and records QPS / p50 / p99 / cache hit rate /
+    queries_per_launch / launches_per_query for both passes into
+    BENCH_DETAILS.json under "load". Passes run --no-cache so every
+    statement actually executes: the A/B grades the DISPATCH plane,
+    and replays launch nothing.
+
+    SLO gate (the exit code): the batched pass must not regress p99
+    past BENCH_LOAD_P99_SLO_MS (default 60000 — a hang-catcher, not a
+    latency promise: BENCH_LOAD_WARMUP_S of unmeasured deck keeps
+    MOST compile bills out of the window, but a fresh server can
+    still mint late-width batch programs inside it; deployments
+    tighten the bound via the env) and must not lose QPS to the solo
+    pass beyond 20%. Like every child, the last stdout line is one
+    JSON object for the driver."""
+    duration = float(os.environ.get("BENCH_LOAD_DURATION_S", "10"))
+    warmup = float(os.environ.get("BENCH_LOAD_WARMUP_S", "6"))
+    slo_ms = float(os.environ.get("BENCH_LOAD_P99_SLO_MS", "60000"))
+    out = {}
+    for label, knob in (("solo", "false"), ("batched", "true")):
+        info, err = _run_child(
+            [sys.executable, "-m", "tools.loadbench",
+             "--clients", "8", "--duration", str(duration),
+             "--warmup", str(warmup),
+             "--repeat-frac", "0.8", "--seed", "42", "--no-cache",
+             "--batching", knob],
+            timeout=(duration + warmup) * 10 + 300,
+        )
+        out[label] = info if info is not None else {"error": err}
+        print(f"# load ({label}): "
+              + (json.dumps(info, sort_keys=True) if info else err),
+              file=sys.stderr)
+    details = _read_details()
+    details["load"] = out
+    _write_details(details)
+    b, s = out["batched"], out["solo"]
+    failures = []
+    if "error" in b or "error" in s:
+        failures.append("load pass failed: "
+                        + str(b.get("error") or s.get("error")))
+    else:
+        if b["p99_ms"] > slo_ms:
+            failures.append(
+                f"p99 SLO: batched {b['p99_ms']}ms > {slo_ms}ms")
+        if s["qps"] > 0 and b["qps"] < 0.8 * s["qps"]:
+            failures.append(
+                f"QPS regression: batched {b['qps']} < 80% of "
+                f"solo {s['qps']}")
+    summary = {
+        "metric": "loadbench_batched_p99",
+        "value": b.get("p99_ms", 0),
+        "unit": "ms",
+        "qps_batched": b.get("qps", 0),
+        "qps_solo": s.get("qps", 0),
+        "queries_per_launch": b.get("queries_per_launch", 0),
+        "launches_per_query_batched": b.get("launches_per_query", 0),
+        "launches_per_query_solo": s.get("launches_per_query", 0),
+        "slo_failures": failures,
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print(f"# load SLO FAILED: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
 if __name__ == "__main__":
     if "--group-child" in sys.argv:
         i = sys.argv.index("--group-child")
@@ -1234,4 +1325,6 @@ if __name__ == "__main__":
         sys.exit(oracle_child())
     if "--sqlite-child" in sys.argv:
         sys.exit(sqlite_child())
+    if "--load" in sys.argv:
+        sys.exit(load_child())
     sys.exit(main())

@@ -91,11 +91,18 @@ class Replanner:
         MEASURED numbers: the whole observed build must fit one
         chip's broadcast byte share (or the row threshold when no
         byte share was wired) and stay under the per-buffer row
-        ceiling."""
+        ceiling. The byte test charges freight_bytes (ISSUE 17) —
+        broadcast ships the spool over the WIRE once per consumer,
+        and after the per-column page codecs (ROOFLINE §14 table)
+        the measured wire bytes run 2-8x under the raw spool bytes
+        the static planner had to assume; costing on raw bytes
+        over-prices broadcast and leaves codec-friendly builds
+        (scan-ordered keys, low-cardinality dictionaries) stuck on
+        the repartition path."""
         if st.rows > SH.SAFE_BUFFER_ROWS:
             return False
         if self.broadcast_bytes is not None:
-            return st.bytes <= int(self.broadcast_bytes)
+            return st.freight_bytes <= int(self.broadcast_bytes)
         if self.broadcast_rows is not None:
             return st.rows <= int(self.broadcast_rows)
         return False

@@ -30,11 +30,27 @@ class StageStats:
     part_bytes: Tuple[int, ...]
     # per producer task (passthrough edges: consumer task t's input)
     task_rows: Tuple[int, ...]
+    # measured post-codec wire bytes of the spool (ISSUE 17): what the
+    # exchange actually ships after the per-column page codecs
+    # (dist/serde.py, ROOFLINE §14 codec table). Device-resident spool
+    # entries that never serialized report their raw footprint, so
+    # this is an upper bound on true freight. 0 = producer predates
+    # the wire-stats plane (fall back to `bytes`).
+    wire_bytes: int = 0
 
     @property
     def row_bytes(self) -> int:
-        """Observed average wire bytes per row (>=1)."""
+        """Observed average spool bytes per row (>=1)."""
         return max(self.bytes // max(self.rows, 1), 1)
+
+    @property
+    def freight_bytes(self) -> int:
+        """The byte count broadcast-vs-partitioned costing should
+        charge: measured wire bytes when the producer reported them,
+        else the raw spool bytes. Per-column codecs routinely ship
+        2-8x under raw (ROOFLINE §14), so costing on raw bytes
+        systematically over-prices broadcast."""
+        return self.wire_bytes if self.wire_bytes > 0 else self.bytes
 
     @property
     def max_part_rows(self) -> int:
@@ -73,12 +89,17 @@ def stats_from_statuses(fid: int,
     peers / non-spooled tasks) — the re-planner then simply has no
     observation for this stage."""
     per_task: List[Tuple[List[int], List[int]]] = []
+    wire_total = 0
     for st in statuses:
         rows = st.get("spoolRows")
         if rows is None:
             return None
-        per_task.append((list(rows), list(st.get("spoolBytes") or
-                                          [0] * len(rows))))
+        nbytes = list(st.get("spoolBytes") or [0] * len(rows))
+        per_task.append((list(rows), nbytes))
+        # measured wire bytes (ISSUE 17); a task missing the field
+        # charges its raw spool bytes so freight never under-counts
+        wire = st.get("spoolWireBytes")
+        wire_total += (sum(wire) if wire is not None else sum(nbytes))
     if not per_task:
         return None
     nparts = max(len(r) for r, _ in per_task)
@@ -98,4 +119,5 @@ def stats_from_statuses(fid: int,
         part_rows=tuple(part_rows),
         part_bytes=tuple(part_bytes),
         task_rows=tuple(task_rows),
+        wire_bytes=wire_total,
     )

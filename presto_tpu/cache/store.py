@@ -191,6 +191,16 @@ class ResultCache:
             )
             return self._maintain_locked()
 
+    def peek_rows(self, key: str) -> bool:
+        """Tally-free presence probe for a statement key — the
+        server's cache-aware admission (ISSUE 17) asks "would this
+        statement hit?" before spending a resource-group slot on it,
+        and an advisory peek must not distort the hit/miss tallies or
+        the LRU order the real serving path maintains."""
+        with self._lock:
+            e = self._expire_locked(key)
+            return e is not None and e.kind == "rows"
+
     # ------------------------------------------------------ rows kind
     def get_rows(self, key: str):
         """(names, rows, types) for a statement key, or None. Lists

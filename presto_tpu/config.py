@@ -175,6 +175,8 @@ ETC_SESSION_KEYS: Dict[str, str] = {
     "ivm.enabled": "ivm_enabled",
     "stream-tail.enabled": "stream_tail_enabled",
     "stream-poll.ms": "stream_poll_ms",
+    "cross-query-batching": "cross_query_batching",
+    "cross-query-batch.wait-ms": "cross_query_batch_wait_ms",
 }
 
 # consumed structurally by server_from_etc (constructor args /

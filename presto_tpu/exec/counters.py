@@ -228,6 +228,22 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "counter", "EventListener exceptions swallowed by the "
         "events.dispatch choke point — counted here instead of lost "
         "silently (executor lifetime)"),
+    "cross_query_batches": (
+        "counter", "shared cross-query device steps dispatched by "
+        "this executor as a gather-group LEADER "
+        "(server/launch_batcher.py; executor lifetime — the leader's "
+        "one launch covers every ganged query)"),
+    "cross_query_batched_queries": (
+        "counter", "launches this executor served FROM a shared "
+        "cross-query batch instead of a solo program (leader and "
+        "follower slots both count; executor lifetime)"),
+    "batch_gather_wait_ms": (
+        "counter", "milliseconds this executor's launches spent in "
+        "the cross-query gather window (bounded by "
+        "cross_query_batch_wait_ms per launch; executor lifetime)"),
+    "queries_per_launch": (
+        "gauge", "widest cross-query batch this executor rode (slots "
+        "per shared launch; 0 = every launch ran solo)"),
 }
 
 # stats-dict entries that are COMPUTED in execute_with_stats rather

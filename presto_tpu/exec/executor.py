@@ -17,6 +17,7 @@ compiled-expression LRU (sql/gen/ExpressionCompiler cache) reborn.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import os
@@ -630,6 +631,28 @@ class Executor:
         self.ivm_full_recomputes = 0
         self.cursor_polls = 0
         self.stream_appends_seen = 0
+        # ---- cross-query launch batching (ISSUE 17,
+        # server/launch_batcher.py): the concurrent server path
+        # attaches ONE process-shared LaunchBatcher to every per-query
+        # executor; compatible fused-pipeline launches (same jit-key
+        # family + shapes.py bucket) gang into one vmapped device step
+        # with in-program per-query demux. cross_query_batching is the
+        # tri-state session knob ("auto" = on whenever a batcher is
+        # attached — attachment itself is the concurrent-server
+        # condition; raw Executors never batch); wait_ms bounds the
+        # gather window so a lone query never stalls past it.
+        # Counters: cross_query_batches = shared steps this executor
+        # dispatched as leader; cross_query_batched_queries = launches
+        # it served from a shared batch (leader or follower);
+        # batch_gather_wait_ms = summed window wait;
+        # queries_per_launch = widest batch ridden (per-query gauge).
+        self.launch_batcher = None
+        self.cross_query_batching = "auto"
+        self.cross_query_batch_wait_ms = 25
+        self.cross_query_batches = 0
+        self.cross_query_batched_queries = 0
+        self.batch_gather_wait_ms = 0
+        self.queries_per_launch = 0
 
     # ------------------------------------------------------------ plumbing
     def count_listener_error(self) -> None:
@@ -1335,17 +1358,134 @@ class Executor:
 
         scan_row_b = chain_row_b
 
+        # cross-query launch batching (ISSUE 17): when the concurrent
+        # server attached a LaunchBatcher and the session didn't force
+        # it off, per-split launches first offer themselves to the
+        # shared batch point — compatible launches from OTHER queries
+        # (equal frozen plan nodes hash equal, so identical statements
+        # across clients share a key) gang into one vmapped step.
+        xq_on = (
+            self.launch_batcher is not None
+            and self.cross_query_batching not in
+            (False, None, "false", "off")
+        )
+
+        def make_xq_fn(n_pad, B):
+            # shared batched program: generation vmapped over the
+            # stacked [B, n_pad] slots, then DEMUXED IN-PROGRAM — the
+            # jitted fn returns one (page, flags) pytree per slot, so
+            # every ganged query walks away with exactly the page its
+            # solo launch would have produced (row parity is
+            # structural, not reassembled on the host)
+            gen_b = conn.gen_batch(cur.table, n_pad, names)
+
+            def post(datas, valid, count):
+                return apply_steps(
+                    make_page(datas, valid, n_pad, count), steps)
+
+            def run_xq(starts, counts):
+                datas, valid = gen_b(starts)
+                out = jax.vmap(post)(datas, valid, counts)
+                return tuple(
+                    jax.tree_util.tree_map(lambda x, i=i: x[i], out)
+                    for i in range(B)
+                )
+
+            return run_xq
+
+        def launch_xq(split):
+            """Offer one split to the cross-query batch point; returns
+            the demuxed page, or None when the solo path should run
+            (batching off, oversized bucket, lone leader, or a chain
+            that does not trace under vmap)."""
+            n_pad = SH.bucket(split.row_count)
+            cap = min(SH.SPLIT_BATCH_MAX,
+                      SH.SPLIT_BATCH_ROWS_MAX // max(n_pad, 1))
+            if cap < 2:
+                return None  # one slot already rides the fault line
+            gkey = ("xq", node, key_extra, cur.table, n_pad)
+
+            def make_batched(entries):
+                # EXACT width, not the split-batch bucket: a rounded-up
+                # lane is dead compute the full n_pad rows wide, which
+                # on a compute-bound backend erases the dispatch win.
+                # Widths are small (cap <= SPLIT_BATCH_MAX) so the
+                # per-width program count is bounded and warm after the
+                # first gang at each width.
+                B = len(entries)
+                jkey = ("xq_batch", node, key_extra, cur.table,
+                        n_pad, B)
+                if jkey not in self._jit_cache:
+                    self._jit_cache[jkey] = jax.jit(
+                        make_xq_fn(n_pad, B))
+                starts = np.zeros(B, np.int64)
+                counts = np.zeros(B, np.int64)
+                for j, (s0, c0) in enumerate(entries):
+                    starts[j] = s0
+                    counts[j] = c0
+                try:
+                    # metered h2d: 2xB int64 slot descriptors per
+                    # shared launch (exec/xfer.py choke point),
+                    # attributed to the leader
+                    out = self._jit_cache[jkey](
+                        XF.to_device(starts, label="batch-starts"),
+                        XF.to_device(counts, label="batch-starts"))
+                except Exception:
+                    # conservative escape (the stream_batched shape):
+                    # a chain that does not trace under vmap demotes
+                    # every participant to its solo path
+                    self._jit_cache.pop(jkey, None)
+                    self.split_batch_fallbacks += 1
+                    raise
+                return [out[j] for j in range(len(entries))]
+
+            res = self.launch_batcher.submit(
+                gkey, split.start_row, split.row_count, cap,
+                self.cross_query_batch_wait_ms, make_batched)
+            if res is None:
+                return None
+            page, flags, width, waited_ms, leader = res
+            if leader:
+                # ONE launch covers every ganged query — only the
+                # leader pays it, so aggregate program_launches
+                # measures real dispatches
+                self.program_launches += 1
+                self.cross_query_batches += 1
+            self.cross_query_batched_queries += 1
+            self.queries_per_launch = max(
+                self.queries_per_launch, width)
+            self.batch_gather_wait_ms += int(waited_ms)
+            self.splits_scanned += 1
+            # this query's slot share of the stacked batch buffer
+            self.peak_memory_bytes = max(
+                self.peak_memory_bytes, n_pad * scan_row_b
+            )
+            self._pending_overflow.extend(flags)
+            return page
+
         def launch_one(split):
+            solo_mark = contextlib.nullcontext()
+            if xq_on:
+                page = launch_xq(split)
+                if page is not None:
+                    return page
+                # solo fallthrough still seeds the train: same-key
+                # arrivals linger behind this execution exactly as
+                # behind a batched step (launch_batcher.solo_inflight)
+                n_pad = SH.bucket(split.row_count)
+                solo_mark = self.launch_batcher.solo_inflight(
+                    ("xq", node, key_extra, cur.table, n_pad))
             n_pad = SH.bucket(split.row_count)
             key = ("fused", node, key_extra, cur.table, n_pad)
             if key not in self._jit_cache:
                 gen_fn = conn.gen_body(cur.table, n_pad, names)
                 self._jit_cache[key] = jax.jit(
                     functools.partial(run_split, gen_fn, n_pad))
-            page, flags = self._jit_cache[key](
-                jnp.int64(split.start_row),
-                jnp.int64(split.row_count),
-            )
+            with solo_mark:
+                page, flags = self._jit_cache[key](
+                    jnp.int64(split.start_row),
+                    jnp.int64(split.row_count),
+                )
             # the generation buffer lives INSIDE the fused program and
             # never passes _account_page — account it here so
             # peak_device_bytes stays honest for fused pipelines
@@ -1994,6 +2134,7 @@ class Executor:
         self.fused_partial_aggs = 0
         self.program_launches = 0
         self.splits_scanned = 0
+        self.queries_per_launch = 0
         self.memory_chunked_pipelines = 0
         self.buffers_donated = 0
 

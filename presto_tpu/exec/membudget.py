@@ -104,6 +104,21 @@ def resolve_budget(setting: int, backend: Optional[str] = None) -> int:
     return hbm - hbm // HEADROOM_DIV
 
 
+def group_share_bytes(share: float, setting: int = 0,
+                      backend: Optional[str] = None) -> int:
+    """Resolve a resource group's fractional HBM ``memory_share``
+    (server/resource_groups.py, ISSUE 17) into the governed
+    device_memory_budget for ONE admitted query: the share of the
+    resolved whole-device budget, floored so a tiny share still
+    leaves the governor a workable chunk size (it rewrites pipelines
+    to fit rather than failing them). 0 when no share is configured —
+    the session/default budget applies unchanged."""
+    if share <= 0:
+        return 0
+    total = resolve_budget(setting, backend)
+    return max(int(total * share), 1 << 24)
+
+
 def rows_cap(row_bytes: int, budget: int, fault_rows: Optional[int],
              share_div: int) -> Optional[int]:
     """Largest governed buffer capacity (in rows, on the ladder) for a

@@ -88,6 +88,11 @@ LOCK_REGISTRY: Dict[str, str] = {
         "readers",
     "server.http_server.MemoryArbiter._cv":
         "HBM-footprint admission: used/active accounting + waiters",
+    "server.launch_batcher.LaunchBatcher._cv":
+        "the cross-query batch point: pending gather-groups keyed by "
+        "jit-key family; leaders gather under a bounded window, "
+        "followers park for the published per-slot results — the "
+        "shared device dispatch itself runs OUTSIDE this lock",
     "server.http_server.QueryManager._exec_lock":
         "the serial-path device lock (one query on the chip when no "
         "memory arbiter is configured)",

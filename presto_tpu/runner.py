@@ -286,6 +286,15 @@ class LocalRunner:
             int(sb) if sb.isdigit()
             else ("auto" if sb == "auto" else 0)
         )
+        # cross-query launch batching (ISSUE 17): "auto" engages
+        # whenever a LaunchBatcher is attached — attachment IS the
+        # concurrent-server condition, so raw Executors and the
+        # serial path resolve to solo launches with zero checks
+        ex.cross_query_batching = {
+            "auto": "auto", "true": True, "false": False,
+        }[self.session.get("cross_query_batching")]
+        ex.cross_query_batch_wait_ms = int(
+            self.session.get("cross_query_batch_wait_ms"))
         # persistent compile cache (process-global jax config, so the
         # wiring is idempotent; compilecache.py): programs compile once
         # per canonical shape per machine, not per process
@@ -383,6 +392,34 @@ class LocalRunner:
 
         walk(plan)
         return max(total, floor)
+
+    def statement_cache_probe(self, sql: str) -> bool:
+        """Whether this statement would be served whole from the
+        full-statement result cache RIGHT NOW — pure host work (parse
+        + plan + key probe, no execution), used by the server's
+        cache-aware admission (ISSUE 17): a near-zero-cost hit should
+        not occupy a resource-group concurrency slot or reserve HBM.
+        Advisory by design — the admitted execute path re-probes, so
+        a racing eviction between probe and serve just runs the query
+        for real."""
+        try:
+            stmt = parse(sql)
+            if not isinstance(stmt, N.Query):
+                return False
+            self.apply_session()
+            if self.executor.result_cache is None:
+                return False
+            out = self._plan_statement_query(stmt)
+            keyed = self._statement_cache_key(out)
+            if keyed is None:
+                return False
+            # tally-free peek: the probe must not distort the
+            # hit/miss counters the serving path maintains
+            return self.executor.result_cache.peek_rows(keyed[0])
+        except Exception:  # noqa: BLE001 - admission probe is
+            # advisory: anything unparseable/unplannable here fails
+            # loudly on the normal execute path instead
+            return False
 
     def execute(self, sql: str) -> QueryResult:
         stmt = parse(sql)

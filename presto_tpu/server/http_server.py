@@ -477,7 +477,22 @@ class QueryManager:
     # from both HTTP handler threads and query-execution threads —
     # written ONLY under self._lock outside __init__
     _shared_attrs = ("_queries", "_seq", "completed_by_state",
-                     "rows_returned_total", "query_wall_ms_total")
+                     "rows_returned_total", "query_wall_ms_total",
+                     "cache_admission_bypasses",
+                     "exec_counter_totals",
+                     "queued_now", "peak_queued")
+
+    # launch/batch counters accumulated across the concurrent path's
+    # per-query executors at completion (ISSUE 17): those executors
+    # are discarded per query, so the PROCESS aggregate — the number
+    # the loadbench launches-per-query A/B reads — lives here and
+    # overlays the registry snapshot on /metrics + system.metrics
+    # (the _result_cache_totals rationale applied to dispatch)
+    _EXEC_TOTAL_SUMS = (
+        "program_launches", "splits_scanned", "cross_query_batches",
+        "cross_query_batched_queries", "batch_gather_wait_ms",
+    )
+    _EXEC_TOTAL_MAX = ("queries_per_launch",)
 
     def __init__(self, runner_factory, listeners=(),
                  resource_groups=None, memory_arbiter=None,
@@ -505,6 +520,19 @@ class QueryManager:
         self.completed_by_state: Dict[str, int] = {}
         self.rows_returned_total = 0
         self.query_wall_ms_total = 0
+        # cache-aware admission (ISSUE 17): statements served whole
+        # from the result cache without ever taking a resource-group
+        # concurrency slot or an arbiter reservation
+        self.cache_admission_bypasses = 0
+        # process launch/batch aggregate (see _EXEC_TOTAL_SUMS)
+        self.exec_counter_totals: Dict[str, int] = {}
+        # admission queue depth (ISSUE 17): queries currently waiting
+        # for admission (resource-group slot / memory reservation /
+        # the serial exec lock) and the lifetime peak — the number the
+        # cache-bypass loadbench assertion reads: replays must never
+        # inflate this line
+        self.queued_now = 0
+        self.peak_queued = 0
         # latency histograms (obs/histo.py): bucketed query wall and
         # per-stage wall for p50/p95/p99 — internally locked, written
         # via observe() from completion paths, scraped by /metrics
@@ -592,6 +620,28 @@ class QueryManager:
 
     def _run(self, q: _Query) -> None:
         group = getattr(q, "resource_group", None)
+        runner = None
+        if self.memory is not None and not q.cancelled:
+            # cache-aware admission (ISSUE 17): a statement the
+            # result cache would serve whole costs near nothing —
+            # parking it in the resource-group line or reserving HBM
+            # for it would spend real slots on zero-cost work and
+            # queue REAL queries behind replays. The probe is pure
+            # host work (parse + plan + tally-free key peek); on a
+            # hit the query executes immediately, outside every
+            # admission gate. Advisory: a racing eviction between
+            # probe and serve just runs the query for real, admitted
+            # only by the arbiter-level backstop it skipped — an
+            # accepted, bounded misestimate (est is small anyway).
+            runner = self._runner_factory(q.session)
+            if runner.statement_cache_probe(q.sql):
+                if group is not None:
+                    self.resource_groups.cancel_queued(group)
+                with self._lock:
+                    self.cache_admission_bypasses += 1
+                self._execute(q, runner)
+                return
+        self._queue_enter(q)
         if group is not None:
             if q.cancelled:
                 self.resource_groups.cancel_queued(group)
@@ -604,15 +654,31 @@ class QueryManager:
                 self._record_completion(q)
                 return
         try:
-            self._run_admitted(q)
+            self._run_admitted(q, runner)
         finally:
             if group is not None:
                 self.resource_groups.release(group)
 
+    def _queue_enter(self, q: _Query) -> None:
+        """Mark q as waiting for admission. Paired with _queue_exit
+        (first of: execution start, completion record) via a consumed-
+        once flag, so abort paths and the execute path can both exit
+        without double counting."""
+        q.in_admission = True
+        with self._lock:
+            self.queued_now += 1
+            self.peak_queued = max(self.peak_queued, self.queued_now)
+
+    def _queue_exit(self, q: _Query) -> None:
+        if getattr(q, "in_admission", False):
+            q.in_admission = False
+            with self._lock:
+                self.queued_now -= 1
+
     # NB: not named `*_locked` — that suffix is the machine-checked
     # caller-holds-the-lock convention (tools/concheck.py); this
     # method ACQUIRES the execution lock/arbiter itself
-    def _run_admitted(self, q: _Query) -> None:
+    def _run_admitted(self, q: _Query, runner=None) -> None:
         if self.memory is None:
             with self._exec_lock:
                 self._execute(q)
@@ -621,9 +687,26 @@ class QueryManager:
         # the global device lock (VERDICT r2 #8); each query runs on
         # its own runner/executor (shared jit cache), so small queries
         # interleave while the arbiter keeps the sum under budget
-        runner = self._runner_factory(q.session)
+        if runner is None:
+            runner = self._runner_factory(q.session)
         est = runner.estimate_memory(q.sql)
         group = getattr(q, "resource_group", None)
+        if group is not None and self.resource_groups is not None:
+            # per-group HBM shares (ISSUE 17): the group policy's
+            # memory_share resolves into THIS query's governed
+            # device budget (exec/membudget.py) — N concurrent
+            # queries split the device by policy instead of
+            # colliding into the OOM ladder. An explicit session
+            # device_memory_budget always wins.
+            share = self.resource_groups.memory_share_for(group)
+            if share > 0 and not q.session.is_set(
+                    "device_memory_budget"):
+                from presto_tpu.exec import membudget as MB
+
+                q.session.set(
+                    "device_memory_budget",
+                    MB.group_share_bytes(share),
+                )
         if group is not None and self.resource_groups is not None:
             # per-group memory quotas gate before the global arbiter
             # (reference: soft_memory_limit per resource group)
@@ -647,6 +730,7 @@ class QueryManager:
                 self.resource_groups.release_memory(group, est)
 
     def _execute(self, q: _Query, runner=None) -> None:
+            self._queue_exit(q)
             if q.cancelled:
                 # canceled while queued: still record completion so event
                 # listeners and /metrics see every created query finish
@@ -701,10 +785,31 @@ class QueryManager:
                     q.trace = lt if lt is not prev_trace else None
                 q.done.set()
                 self._record_completion(q)
+                self._accumulate_exec_totals(runner)
+
+    def _accumulate_exec_totals(self, runner) -> None:
+        """Fold one finished query's launch/batch counters into the
+        process aggregate (concurrent path only — the serial path's
+        bootstrap executor already IS the process surface, and adding
+        it here would double-count). Per-attempt gauges carry the
+        final attempt's values, matching EXPLAIN ANALYZE."""
+        if self.memory is None or runner is None:
+            return
+        ex = getattr(runner, "executor", None)
+        if ex is None:
+            return
+        with self._lock:
+            t = self.exec_counter_totals
+            for name in self._EXEC_TOTAL_SUMS:
+                t[name] = t.get(name, 0) + int(getattr(ex, name, 0))
+            for name in self._EXEC_TOTAL_MAX:
+                t[name] = max(
+                    t.get(name, 0), int(getattr(ex, name, 0)))
 
     def _record_completion(self, q: _Query) -> None:
         from presto_tpu import events as E
 
+        self._queue_exit(q)
         wall_ms = q.info()["elapsedTimeMillis"]
         with self._lock:
             self.completed_by_state[q.state] = (
@@ -803,6 +908,19 @@ class QueryManager:
             # same process-shared overlay (dist/serde, dist/connpool)
             snap.update({k: int(v) for k, v in _wire_totals().items()
                          if k in CTRS.QUERY_COUNTERS})
+            # launch/batch totals accumulate across the concurrent
+            # path's discarded per-query executors (ISSUE 17): sums
+            # ADD to the bootstrap executor's own counts (zero when
+            # idle), the width gauge takes the max — the aggregate
+            # launches-per-query truth the loadbench A/B reads
+            with self._lock:
+                for name in self._EXEC_TOTAL_SUMS:
+                    snap[name] = snap.get(name, 0) + \
+                        self.exec_counter_totals.get(name, 0)
+                for name in self._EXEC_TOTAL_MAX:
+                    snap[name] = max(
+                        snap.get(name, 0),
+                        self.exec_counter_totals.get(name, 0))
             for name, (kind, _help) in CTRS.QUERY_COUNTERS.items():
                 suffix = "_total" if kind == "counter" else ""
                 lines += [
@@ -814,6 +932,18 @@ class QueryManager:
                 f"presto_tpu_transfer_wall_seconds "
                 f"{xf['transfer_wall_s']}",
             ]
+        # cache-aware admission (ISSUE 17): replays that never took a
+        # resource-group slot — next to the hit-rate so loadbench can
+        # assert near-zero-cost hits stop occupying the queue
+        with self._lock:
+            bypasses = self.cache_admission_bypasses
+            peak_q = self.peak_queued
+        lines += [
+            "# TYPE presto_tpu_admission_cache_bypasses_total counter",
+            f"presto_tpu_admission_cache_bypasses_total {bypasses}",
+            "# TYPE presto_tpu_peak_queued gauge",
+            f"presto_tpu_peak_queued {peak_q}",
+        ]
         return "\n".join(lines) + "\n"
 
 
@@ -1231,8 +1361,16 @@ class PrestoTpuServer:
         self._default_catalog = default_catalog
 
         memory_arbiter = None
+        # cross-query launch batching (ISSUE 17): ONE shared batch
+        # point for the concurrent path's per-query executors —
+        # attachment is what "auto" resolves against, so the serial
+        # path and raw Executors never batch
+        self._launch_batcher = None
         if memory_budget_bytes:
             memory_arbiter = MemoryArbiter(memory_budget_bytes)
+            from presto_tpu.server.launch_batcher import LaunchBatcher
+
+            self._launch_batcher = LaunchBatcher()
 
         # fail-fast validation: a bad deployment default (unknown name,
         # rejected value) must abort startup, not fail every query.
@@ -1264,6 +1402,14 @@ class PrestoTpuServer:
                 # serial path: one engine, re-sessioned per query
                 self._runner.session = session
                 return self._runner
+            # the concurrent server defaults the result cache ON
+            # (ISSUE 17): the process-shared store is what collapses
+            # repeated dashboard statements across per-query runners,
+            # and cache-aware admission needs hits to exist to bypass
+            # the queue. Raw Executor / serial-path / library defaults
+            # stay off; an explicit client/deployment off wins.
+            if not session.is_set("result_cache_enabled"):
+                session.set("result_cache_enabled", True)
             # concurrent path: per-query runner/executor so query state
             # (overflow flags, capacity boosts, stream caches) never
             # crosses queries; compiled kernels and views are server-
@@ -1276,6 +1422,11 @@ class PrestoTpuServer:
                 session=session,
             )
             r.executor._jit_cache = self._shared_jit_cache
+            # every per-query executor shares THE batch point: a
+            # compatible launch from any of them can lead or join a
+            # gather group (runner.apply_session resolves the
+            # session's cross_query_batching against this attachment)
+            r.executor.launch_batcher = self._launch_batcher
             r.views = self._runner.views
             r.prepared = self._runner.prepared
             r.access_control = self._runner.access_control
@@ -1291,6 +1442,17 @@ class PrestoTpuServer:
             listener_error_counter=(
                 self._runner.executor.count_listener_error),
         )
+        if self._launch_batcher is not None:
+            # gather only when there is someone to gang with: a lone
+            # client on the concurrent path must never pay the window
+            mgr = self.manager
+
+            def _running_queries() -> int:
+                with mgr._lock:
+                    return sum(1 for q in mgr._queries.values()
+                               if q.state == "RUNNING")
+
+            self._launch_batcher.concurrency_probe = _running_queries
         # coordinator+worker single process (reference: a node that is
         # both coordinator and worker): an embedded task runtime makes
         # this server a full DCN peer — it serves the /v1/task control
@@ -1377,11 +1539,25 @@ class PrestoTpuServer:
                          if k in CTRS.QUERY_COUNTERS})
             snap.update({k: int(v) for k, v in _wire_totals().items()
                          if k in CTRS.QUERY_COUNTERS})
+            # launch/batch totals: same overlay as /metrics (see
+            # QueryManager.metrics_text) so the two surfaces agree
+            with mgr._lock:
+                for name in mgr._EXEC_TOTAL_SUMS:
+                    snap[name] = snap.get(name, 0) + \
+                        mgr.exec_counter_totals.get(name, 0)
+                for name in mgr._EXEC_TOTAL_MAX:
+                    snap[name] = max(
+                        snap.get(name, 0),
+                        mgr.exec_counter_totals.get(name, 0))
+                bypasses = mgr.cache_admission_bypasses
+                peak_q = mgr.peak_queued
             out.extend(sorted(snap.items()))
             # the float crossing wall rides as integer milliseconds
             # (system.metrics values are BIGINT)
             out.append(("transfer_wall_ms",
                         int(xf["transfer_wall_s"] * 1000)))
+            out.append(("admission_cache_bypasses", bypasses))
+            out.append(("peak_queued", peak_q))
             return out
 
         def runtime_tasks():

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import time
 from typing import Dict, List, Optional, Tuple
 
 from presto_tpu.obs.sanitizer import (
@@ -42,6 +43,17 @@ class ResourceGroupSpec:
     max_queued: int = 100
     max_memory_bytes: int = 0
     sub_groups: Tuple["ResourceGroupSpec", ...] = ()
+    # scheduling policy (ISSUE 17; reference: the resource-group
+    # schedulingPolicy/schedulingWeight knobs): higher-priority
+    # waiters claim freed concurrency slots first, and every waiter
+    # AGES — effective priority grows with time queued
+    # (AGING_PRIORITY_PER_S) — so a long-scan group can never starve
+    # an interactive group, and vice versa
+    priority: int = 0
+    # fraction of the resolved device budget (exec/membudget.py)
+    # queries admitted through this group may each govern to;
+    # 0.0 = no share configured (the session/default budget applies)
+    memory_share: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,8 +83,16 @@ class ResourceGroupManager:
     reserved memory at EVERY level of its path."""
 
     # lock discipline (tools/lint `locks` rule): the per-path slot/
-    # queue/memory tallies shared across every query's admission thread
-    _shared_attrs = ("_running", "_queued", "_memory")
+    # queue/memory tallies plus the fair-scheduling waiter line are
+    # shared across every query's admission thread
+    _shared_attrs = ("_running", "_queued", "_memory", "_waiters",
+                     "_ticket")
+
+    # aging rate for fair scheduling: one effective-priority point per
+    # this many seconds queued, so a low-priority waiter overtakes a
+    # priority-P stream of arrivals after P * this many seconds —
+    # bounded starvation by construction
+    AGING_PRIORITY_PER_S = 2.0
 
     def __init__(self, groups: Optional[List[ResourceGroupSpec]] = None):
         self.groups = list(groups or [ResourceGroupSpec("global")])
@@ -82,6 +102,11 @@ class ResourceGroupManager:
         self._running: Dict[str, int] = {}
         self._queued: Dict[str, int] = {}
         self._memory: Dict[str, int] = {}
+        # fair scheduling (ISSUE 17): the live waiter line —
+        # [selection, arrival time, ticket] per blocked acquire —
+        # ranked by (effective priority desc, ticket asc)
+        self._waiters: List[list] = []
+        self._ticket = 0
         self._all_paths: List[Tuple[str, ResourceGroupSpec]] = []
 
         def walk(g: ResourceGroupSpec, prefix: str):
@@ -135,24 +160,66 @@ class ResourceGroupManager:
                 self._queued[path] += 1
         return sel
 
+    def _slots_free_locked(self, sel: GroupSelection) -> bool:
+        return all(
+            self._running[path] < spec.hard_concurrency
+            for spec, path in zip(sel.specs, sel.paths)
+        )
+
+    def _front_of_line_locked(self, entry: list) -> bool:
+        """Fair scheduling (ISSUE 17): ``entry`` may claim its slots
+        only when it ranks first — by (effective priority desc,
+        arrival ticket asc) — among the waiters whose OWN groups have
+        capacity right now. Effective priority = the leaf's configured
+        priority plus time queued over AGING_PRIORITY_PER_S, so a
+        short interactive query jumps a saturated line immediately
+        while a long-scan waiter ages its way up instead of starving.
+        A high-priority waiter whose group is itself full never blocks
+        an admissible one (eligibility is capacity-filtered)."""
+        now = time.monotonic()
+
+        def rank(e):
+            sel, arrival, ticket = e
+            eff = sel.leaf.priority + (
+                (now - arrival) / self.AGING_PRIORITY_PER_S
+            )
+            return (-eff, ticket)
+
+        best = None
+        for e in self._waiters:
+            if not self._slots_free_locked(e[0]):
+                continue
+            if best is None or rank(e) < rank(best):
+                best = e
+        return best is entry
+
     def acquire(self, sel: GroupSelection, should_abort=None) -> bool:
         """Block until every level of the path has a concurrency slot
-        (QUEUED -> RUNNING). Returns False when aborted (queue slots
-        already released)."""
+        (QUEUED -> RUNNING) AND this waiter is first in the fair-
+        scheduling line for those slots. Returns False when aborted
+        (queue slots already released)."""
         with self._cv:
-            while any(
-                self._running[path] >= spec.hard_concurrency
-                for spec, path in zip(sel.specs, sel.paths)
-            ):
-                if should_abort is not None and should_abort():
-                    for path in sel.paths:
-                        self._queued[path] -= 1
-                    return False
-                self._cv.wait(timeout=0.05)
-            for path in sel.paths:
-                self._queued[path] -= 1
-                self._running[path] += 1
-            return True
+            self._ticket += 1
+            entry = [sel, time.monotonic(), self._ticket]
+            self._waiters.append(entry)
+            try:
+                while True:
+                    if (self._slots_free_locked(sel)
+                            and self._front_of_line_locked(entry)):
+                        for path in sel.paths:
+                            self._queued[path] -= 1
+                            self._running[path] += 1
+                        return True
+                    if should_abort is not None and should_abort():
+                        for path in sel.paths:
+                            self._queued[path] -= 1
+                        return False
+                    self._cv.wait(timeout=0.05)
+            finally:
+                self._waiters.remove(entry)
+                # the line changed: the next-ranked waiter must
+                # re-evaluate _front_of_line_locked
+                self._cv.notify_all()
 
     def release(self, sel: GroupSelection) -> None:
         with self._cv:
@@ -199,6 +266,20 @@ class ResourceGroupManager:
                 self._memory[path] -= nbytes
             self._cv.notify_all()
 
+    def memory_share_for(self, sel: GroupSelection) -> float:
+        """The HBM share governing queries admitted through this
+        selection: the most specific (deepest) nonzero ``memory_share``
+        along the path wins; 0.0 = no share configured. The server
+        resolves it against the device budget via
+        exec/membudget.group_share_bytes and seeds each admitted
+        query's device_memory_budget, so N concurrent queries split
+        the device by policy instead of colliding into the OOM
+        ladder."""
+        for spec in reversed(sel.specs):
+            if spec.memory_share > 0:
+                return spec.memory_share
+        return 0.0
+
     # ----------------------------------------------------------- introspection
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -209,6 +290,8 @@ class ResourceGroupManager:
                     "hardConcurrency": g.hard_concurrency,
                     "maxQueued": g.max_queued,
                     "maxMemoryBytes": g.max_memory_bytes,
+                    "priority": g.priority,
+                    "memoryShare": g.memory_share,
                     "running": self._running[path],
                     "queued": self._queued[path],
                     "reservedMemoryBytes": self._memory[path],

@@ -104,6 +104,11 @@ class _PartitionSpool:
         # content is deterministic)
         self.stat_rows = 0
         self.stat_bytes = 0
+        # measured post-codec wire bytes (ISSUE 17): blob-tier entries
+        # count their actual serialized length; device-resident pages
+        # never serialized, so they count their raw footprint (an
+        # upper bound — freight costing must never under-count)
+        self.stat_wire_bytes = 0
 
     def put(self, blob: bytes, to_disk: bool, rows: int = 0) -> None:
         from presto_tpu.exec.pagestore import PageStore
@@ -119,6 +124,7 @@ class _PartitionSpool:
         self._entries.append((store, store.page_count - 1))
         self.stat_rows += int(rows)
         self.stat_bytes += len(blob)
+        self.stat_wire_bytes += len(blob)
 
     def put_page(self, page, est_bytes: int, rows: int = 0) -> None:
         """Spool one partitioned Page WITHOUT serializing (the device-
@@ -128,6 +134,7 @@ class _PartitionSpool:
         self._page_bytes += est_bytes
         self.stat_rows += int(rows)
         self.stat_bytes += int(est_bytes)
+        self.stat_wire_bytes += int(est_bytes)
 
     def blob(self, token: int) -> bytes:
         entry = self._entries[token]
@@ -206,13 +213,15 @@ class _TaskSpool:
     def byte_count(self) -> int:
         return sum(p.bytes for p in self.parts)
 
-    def part_stats(self) -> Tuple[List[int], List[int]]:
-        """(rows, bytes) per partition — the stage-boundary stats the
-        adaptive re-planner sums coordinator-side (ISSUE 15). Exact
-        and monotone: accumulated at publish time, stable across
-        release and identical after a deterministic replay."""
+    def part_stats(self) -> Tuple[List[int], List[int], List[int]]:
+        """(rows, bytes, wire bytes) per partition — the stage-
+        boundary stats the adaptive re-planner sums coordinator-side
+        (ISSUE 15; wire bytes ISSUE 17). Exact and monotone:
+        accumulated at publish time, stable across release and
+        identical after a deterministic replay."""
         return ([p.stat_rows for p in self.parts],
-                [p.stat_bytes for p in self.parts])
+                [p.stat_bytes for p in self.parts],
+                [p.stat_wire_bytes for p in self.parts])
 
     def release(self, p: int) -> bool:
         if 0 <= p < len(self.parts):
@@ -717,9 +726,10 @@ def route_task_get(app, path: str, query: str):
                 "skewPreempted": task.skew_preempted,
             }
             if spool is not None:
-                rows, nbytes = spool.part_stats()
+                rows, nbytes, wire = spool.part_stats()
                 body["spoolRows"] = rows
                 body["spoolBytes"] = nbytes
+                body["spoolWireBytes"] = wire
             if task.spans is not None:
                 # worker-side spans for the coordinator's cross-node
                 # timeline: offsets from this task's creation, plus

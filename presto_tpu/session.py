@@ -485,6 +485,33 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "split by hash (SURVEY §6.7 per-partition rebalancing)",
             bool, True,
         ),
+        PropertyMetadata(
+            "cross_query_batching",
+            "gang compatible fused-pipeline launches from CONCURRENT "
+            "queries into one shared vmapped device step with "
+            "in-program per-query demux (server/launch_batcher.py) — "
+            "the PR-3 split-batching amortization applied across "
+            "queries, the batching-inference-server shape. auto = on "
+            "under the concurrent server path only (raw Executors "
+            "and the serial path never batch); false forces solo "
+            "launches. Counters: cross_query_batches / "
+            "cross_query_batched_queries / batch_gather_wait_ms / "
+            "queries_per_launch in EXPLAIN ANALYZE",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
+        ),
+        PropertyMetadata(
+            "cross_query_batch_wait_ms",
+            "bounded gather window in milliseconds for cross-query "
+            "launch batching: the first compatible launch (the group "
+            "leader) waits at most this long for peers before "
+            "dispatching (extended while a same-key step is already "
+            "executing — continuous batching), so a lone query never "
+            "stalls past the window; the window is only ever paid "
+            "when >= 2 queries are running server-wide; 0 batches "
+            "only launches already pending at submit time",
+            int, 25,
+        ),
     ]
 }
 

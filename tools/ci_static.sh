@@ -7,10 +7,13 @@
 # (bench rungs + TPC-H/TPC-DS corpus, strict mode), and the wire-serde
 # property suite (codec x type round-trip matrix, byte-stability,
 # truncation/corruption rejection — the pure-serde subset; the
-# WorkerServer-backed streaming/pool tests stay in tier 1). Pure host
-# Python — nothing compiles or touches a device — so the whole gate
-# runs in well under 60 s on the 2-core box (combined budget: <= 30 s
-# for the static rules, the rest for the plan audit + serde suite).
+# WorkerServer-backed streaming/pool tests stay in tier 1), plus the
+# sanitized serving smoke (ISSUE 17: a bounded loadbench pass racing
+# the concurrent-admission/batching locks under the runtime
+# sanitizer). All legs but the smoke are pure host Python — nothing
+# compiles or touches a device — so the whole gate runs in well under
+# 90 s on the 2-core box (combined budget: <= 30 s for the static
+# rules, the rest for the plan audit + serde suite + smoke).
 # bench.py --prewarm runs the same plan verifier per rung before
 # compiling.
 #
@@ -37,5 +40,13 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
     tests/test_wire_serde.py -q -p no:cacheprovider \
     -k "not spooled_task and not connpool and not streaming \
         and not q3_family and not executor_surface"
+
+echo "# ci_static: sanitized serving smoke (tools/loadbench.py)" >&2
+# ISSUE 17: a bounded concurrent-load pass with the lock sanitizer
+# armed — N protocol clients x the shared result cache x cache-aware
+# admission x the cross-query launch batcher race deliberately; any
+# lock-order inversion or unlocked shared-attr write fails the gate
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.loadbench \
+    --sanitize --smoke > /dev/null
 
 echo "# ci_static: clean in $(( $(date +%s) - t0 ))s" >&2

@@ -31,7 +31,16 @@ Reported (one JSON line on stdout, like bench.py's driver contract):
       (ISSUE 16, the ``presto_tpu_exchange_*`` process totals from
       dist/serde.py codecs and dist/connpool.py keep-alive reuse,
       base-subtracted; 0 on single-process runs where no page ever
-      crosses the DCN boundary).
+      crosses the DCN boundary),
+  program_launches / launches_per_query / cross_query_batches /
+  cross_query_batched_queries / queries_per_launch — cross-query
+      launch batching economics (ISSUE 17; ``--batching true|false``
+      pins the session knob on every client for the A/B, and
+      launches_per_query divides this run's launches by EXECUTED
+      queries — cache replays launch nothing),
+  admission_cache_bypasses / peak_queued — cache-aware admission:
+      replays that skipped the resource-group queue entirely, next to
+      the lifetime peak admission queue depth they kept down.
 
 ``--sanitize`` (ISSUE 11) arms the runtime lock sanitizer
 (presto_tpu/obs/sanitizer.py) before the self-hosted server builds a
@@ -138,19 +147,31 @@ def _histo_base(text: str, name: str) -> dict:
 
 
 def run_load(server: str, clients: int, duration_s: float,
-             repeat_frac: float, cache: bool, seed: int = 0) -> dict:
+             repeat_frac: float, cache: bool, seed: int = 0,
+             batching: str = "auto", warmup_s: float = 0.0,
+             batch_wait_ms: int = None) -> dict:
     from presto_tpu.client import StatementClient
 
-    stop_at = time.time() + duration_s
     lock = threading.Lock()
     tally = {"queries": 0, "errors": 0, "rows": 0}
 
-    def worker(idx: int) -> None:
+    def worker(idx: int, stop_at: float, record: bool) -> None:
         rng = random.Random(seed * 1000 + idx)
         cl = StatementClient(server, user=f"load{idx}",
                              catalog="tpch")
-        if cache:
-            cl.session_properties["result_cache_enabled"] = "true"
+        # explicit both ways: the concurrent server path now DEFAULTS
+        # the result cache on (ISSUE 17), so the --no-cache baseline
+        # must actively opt out, not merely stay silent
+        cl.session_properties["result_cache_enabled"] = (
+            "true" if cache else "false")
+        # cross-query launch batching A/B (ISSUE 17): "auto" rides the
+        # server default; "true"/"false" pin the session knob so the
+        # same deck grades launches-per-query batched vs solo
+        if batching != "auto":
+            cl.session_properties["cross_query_batching"] = batching
+        if batch_wait_ms is not None:
+            cl.session_properties["cross_query_batch_wait_ms"] = str(
+                batch_wait_ms)
         uniq = idx * 1_000_000  # per-client namespace: no cross-client
         while time.time() < stop_at:  # accidental repeats
             if rng.random() < repeat_frac:
@@ -164,12 +185,28 @@ def run_load(server: str, clients: int, duration_s: float,
             except Exception:  # noqa: BLE001 - a load generator
                 ok = False     # counts failures, it never crashes
                 res = None
+            if not record:
+                continue
             with lock:
                 tally["queries"] += 1
                 if not ok:
                     tally["errors"] += 1
                 elif res is not None:
                     tally["rows"] += len(res.rows)
+
+    if warmup_s > 0:
+        # steady-state stance: run the same deck off the books first so
+        # jit compiles (solo AND the width-bucketed xq_batch variants)
+        # land outside the measured window — the serving-bench analogue
+        # of bench.py --prewarm
+        warm_stop = time.time() + warmup_s
+        warm = [threading.Thread(target=worker,
+                                 args=(i, warm_stop, False), daemon=True)
+                for i in range(clients)]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(timeout=warmup_s * 4 + 60)
 
     pre = _scrape_metrics(server)
     hname = "presto_tpu_query_latency_seconds"
@@ -183,9 +220,17 @@ def run_load(server: str, clients: int, duration_s: float,
     base_eraw = _metric(pre, "presto_tpu_exchange_raw_bytes_total")
     base_reuse = _metric(
         pre, "presto_tpu_exchange_fetch_reused_conns_total")
+    base_launch = _metric(pre, "presto_tpu_program_launches")
+    base_xq = _metric(pre, "presto_tpu_cross_query_batches_total")
+    base_xqq = _metric(
+        pre, "presto_tpu_cross_query_batched_queries_total")
+    base_bypass = _metric(
+        pre, "presto_tpu_admission_cache_bypasses_total")
 
     t0 = time.time()
-    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+    stop_at = t0 + duration_s
+    threads = [threading.Thread(target=worker,
+                                args=(i, stop_at, True), daemon=True)
                for i in range(clients)]
     for t in threads:
         t.start()
@@ -198,6 +243,13 @@ def run_load(server: str, clients: int, duration_s: float,
     misses = (_metric(post, "presto_tpu_result_cache_misses_total")
               - base_miss)
     looked = hits + misses
+    # launch economics (ISSUE 17): the dispatch-amortization headline.
+    # launches_per_query divides the run's program launches by the
+    # queries that actually EXECUTED (cache hits replay zero launches
+    # and would flatter the ratio) — the A/B acceptance reads this
+    # batched vs solo on a --no-cache run
+    launches = _metric(post, "presto_tpu_program_launches") - base_launch
+    executed = max(tally["queries"] - hits, 1)
     return {
         "clients": clients,
         "duration_s": round(wall, 2),
@@ -230,6 +282,21 @@ def run_load(server: str, clients: int, duration_s: float,
         "exchange_fetch_reused_conns": _metric(
             post, "presto_tpu_exchange_fetch_reused_conns_total")
             - base_reuse,
+        # cross-query launch batching (ISSUE 17)
+        "batching": batching,
+        "program_launches": launches,
+        "launches_per_query": round(launches / executed, 3),
+        "cross_query_batches": _metric(
+            post, "presto_tpu_cross_query_batches_total") - base_xq,
+        "cross_query_batched_queries": _metric(
+            post, "presto_tpu_cross_query_batched_queries_total")
+            - base_xqq,
+        "queries_per_launch": _metric(
+            post, "presto_tpu_queries_per_launch"),
+        "admission_cache_bypasses": _metric(
+            post, "presto_tpu_admission_cache_bypasses_total")
+            - base_bypass,
+        "peak_queued": _metric(post, "presto_tpu_peak_queued"),
     }
 
 
@@ -360,6 +427,23 @@ def main() -> int:
     ap.add_argument("--no-cache", action="store_true",
                     help="run the same load without the result cache "
                          "(the A/B baseline)")
+    ap.add_argument("--batching", choices=("auto", "true", "false"),
+                    default="auto",
+                    help="cross_query_batching session knob pinned on "
+                         "every client (ISSUE 17); 'auto' rides the "
+                         "server default — batched on the concurrent "
+                         "path, solo everywhere else")
+    ap.add_argument("--warmup", type=float, default=0.0,
+                    help="seconds of unmeasured same-deck load before "
+                         "the measured window, so compiles settle "
+                         "first (steady-state A/B stance)")
+    ap.add_argument("--batch-wait-ms", type=int, default=None,
+                    help="pin cross_query_batch_wait_ms on every "
+                         "client (gather-window sweep knob)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="bounded CI shape: caps clients/duration so "
+                         "the sanitizer leg finishes in seconds while "
+                         "still racing every serving-path lock")
     ap.add_argument("--sanitize", action="store_true",
                     help="arm the runtime lock sanitizer over the "
                          "self-hosted server and fail on any "
@@ -374,6 +458,11 @@ def main() -> int:
     ap.add_argument("--rows-per-append", type=int, default=512)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    if args.smoke:
+        args.clients = min(args.clients, 4)
+        args.duration = min(args.duration, 3.0)
+        args.warmup = min(args.warmup, 2.0)
+        args.scale = min(args.scale, 0.01)
 
     san = None
     if args.sanitize:
@@ -420,7 +509,9 @@ def main() -> int:
     try:
         out = run_load(server, args.clients, args.duration,
                        args.repeat_frac, cache=not args.no_cache,
-                       seed=args.seed)
+                       seed=args.seed, batching=args.batching,
+                       warmup_s=args.warmup,
+                       batch_wait_ms=args.batch_wait_ms)
     finally:
         if srv is not None:
             srv.stop()

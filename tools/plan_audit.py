@@ -58,6 +58,10 @@ def _seeded_misestimate_sweep(runner, label: str, dag,
             part_rows=(hot, max(obs - hot, 0)),
             part_bytes=(hot * 16, max(obs - hot, 0) * 16),
             task_rows=(obs // 2, obs - obs // 2),
+            # ISSUE 17: measured wire bytes 8x under raw (a typical
+            # per-column codec ratio) so the sweep drives the
+            # freight-costed broadcast test through replan+verify
+            wire_bytes=obs * 2,
         ))
         out = rp.replan(set(dispatched))
         if out is not None and not out.rejected:
@@ -72,6 +76,42 @@ def _seeded_misestimate_sweep(runner, label: str, dag,
                   f"{frag.fid}", file=sys.stderr)
             return applied
     return applied
+
+
+def _wire_misestimate_case(failures: list) -> None:
+    """ISSUE 17: one seeded wire-misestimate pin. A build whose RAW
+    spool bytes blow the broadcast byte share but whose MEASURED
+    post-codec wire bytes fit (scan-ordered keys delta+deflate to
+    almost nothing, ROOFLINE §14) must pass the re-planner's
+    broadcast test — and the pre-wire-stats behavior (raw-byte
+    costing) must be reproduced exactly by wire_bytes=0, so legacy
+    producers never get mis-flipped."""
+    from presto_tpu.adaptive import Replanner, StageStats
+
+    rp = Replanner(None, None, broadcast_bytes=1 << 20)
+    kw = dict(fid=0, rows=1 << 16, part_rows=(1 << 16,),
+              part_bytes=(1 << 24,), task_rows=(1 << 16,))
+    raw_only = StageStats(bytes=1 << 24, **kw)
+    measured = StageStats(bytes=1 << 24, wire_bytes=1 << 18, **kw)
+    still_fat = StageStats(bytes=1 << 24, wire_bytes=1 << 22, **kw)
+    checks = [
+        (not rp._fits_broadcast(raw_only),
+         "raw 16MiB build with no wire stats must NOT fit a 1MiB "
+         "broadcast share"),
+        (rp._fits_broadcast(measured),
+         "16MiB build measuring 256KiB on the wire must fit a 1MiB "
+         "broadcast share"),
+        (not rp._fits_broadcast(still_fat),
+         "build measuring 4MiB on the wire must NOT fit a 1MiB "
+         "broadcast share"),
+    ]
+    bad = [msg for ok, msg in checks if not ok]
+    if bad:
+        failures.append(("wire-misestimate case", bad))
+        for msg in bad:
+            print(f"# wire-misestimate case: {msg}", file=sys.stderr)
+    else:
+        print("# wire-misestimate case: ok", file=sys.stderr)
 
 
 def _audit_one(runner, label: str, sql: str, failures: list,
@@ -151,6 +191,7 @@ def main() -> int:
     dag_stats: list = []
     replans: list = []
     n = 0
+    _wire_misestimate_case(failures)
     if do_rungs:
         from bench import RUNGS
 
