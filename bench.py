@@ -546,6 +546,7 @@ def group_child(only_names) -> int:
             # execution paths actually engaged, and how many fused-scan
             # launches the split batching left
             ex.pallas_joins_used = 0
+            ex.pallas_kernels_used = 0
             ex.generated_joins_used = 0
             ex.fused_partial_aggs = 0
             ex.program_launches = 0
@@ -558,6 +559,10 @@ def group_child(only_names) -> int:
             # run's, not a settle+timed cumulative
             ex.buffers_donated = 0
             ex.mesh_local_exchanges = 0
+            # ICI exchange plane (ISSUE 18): per-run, same reasoning
+            ex.ici_exchanges = 0
+            ex.ici_bytes = 0
+            ex.mesh_exchange_fallbacks = 0
             ex.adaptive_replans = 0
             ex.adaptive_dist_flips = 0
             ex.adaptive_capacity_seeds = 0
@@ -580,6 +585,10 @@ def group_child(only_names) -> int:
         def path_counters(ex=ex):
             return {
                 "pallas_joins_used": ex.pallas_joins_used,
+                # every Pallas engagement of ANY kind (joins, the
+                # segmented-reduction agg, the exchange partition-id
+                # pass) — ISSUE 18's kernel-coverage counter
+                "pallas_kernels_used": ex.pallas_kernels_used,
                 "generated_joins_used": ex.generated_joins_used,
                 "fused_partial_aggs": ex.fused_partial_aggs,
                 "program_launches": ex.program_launches,
@@ -608,6 +617,15 @@ def group_child(only_names) -> int:
                 # invocations on the successful attempt
                 "mesh_local_exchanges": ex.mesh_local_exchanges,
                 "buffers_donated": ex.buffers_donated,
+                # ICI exchange plane (ISSUE 18): repartition edges
+                # lowered to in-program all_to_all + the bytes they
+                # routed over the interconnect instead of the spool
+                # serde/HTTP plane (0 on the local pages() drive —
+                # nonzero only under the DCN stage scheduler, same
+                # contract as adaptive_replans)
+                "ici_exchanges": ex.ici_exchanges,
+                "ici_bytes": ex.ici_bytes,
+                "mesh_exchange_fallbacks": ex.mesh_exchange_fallbacks,
                 # adaptive execution (ISSUE 15): re-plans applied at
                 # stage boundaries (0 on the local pages() drive —
                 # nonzero only when a rung runs the DCN stage

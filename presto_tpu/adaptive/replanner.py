@@ -39,6 +39,16 @@ TIGHTEN_FACTOR = 4
 # clear wins re-order)
 SWAP_RATIO = 2
 
+# ICI-vs-wire bandwidth handicap for broadcast flips (ISSUE 18): a
+# stage whose exchange already lowered to the in-program all_to_all
+# (StageStats.ici_bytes > 0) moved its freight over the device
+# interconnect — a flip to broadcast would move the SAME bytes back
+# onto the spool serde/HTTP wire, which ships this many times slower
+# per byte (ROOFLINE §16 measures the q3-family rung; the TPU v4
+# ICI:DCN ratio is far larger still). The flip must fit a budget
+# shrunk by this ratio before it can win.
+ICI_WIRE_RATIO = 16
+
 
 @dataclasses.dataclass
 class ReplanOutcome:
@@ -102,7 +112,15 @@ class Replanner:
         if st.rows > SH.SAFE_BUFFER_ROWS:
             return False
         if self.broadcast_bytes is not None:
-            return st.freight_bytes <= int(self.broadcast_bytes)
+            budget = int(self.broadcast_bytes)
+            if st.ici_bytes > 0:
+                # the observed exchange rode the ICI plane (ISSUE
+                # 18): its partitioned freight never touched the
+                # wire, so a broadcast flip would ADD serde+HTTP
+                # traffic the current plan does not pay — charge it
+                # the measured bandwidth handicap
+                budget //= ICI_WIRE_RATIO
+            return st.freight_bytes <= budget
         if self.broadcast_rows is not None:
             return st.rows <= int(self.broadcast_rows)
         return False

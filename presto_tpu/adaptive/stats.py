@@ -37,6 +37,13 @@ class StageStats:
     # this is an upper bound on true freight. 0 = producer predates
     # the wire-stats plane (fall back to `bytes`).
     wire_bytes: int = 0
+    # bytes that moved over the device interconnect instead of the
+    # wire (ISSUE 18): >0 marks a stage whose repartition edge the
+    # scheduler lowered to the in-program all_to_all plane — its
+    # freight never touched the spool serde/HTTP path, which the
+    # broadcast-flip cost model must charge differently (a flip to
+    # broadcast would move the build BACK onto the wire).
+    ici_bytes: int = 0
 
     @property
     def row_bytes(self) -> int:

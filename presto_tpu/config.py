@@ -141,6 +141,7 @@ ETC_SESSION_KEYS: Dict[str, str] = {
     "query.max-memory-bytes": "query_max_memory_bytes",
     "hash-partition-count": "hash_partition_count",
     "pallas-join.enabled": "pallas_join_enabled",
+    "mesh-exchange.mode": "mesh_exchange_mode",
     "spill.threshold-bytes": "spill_threshold_bytes",
     "generated-join.enabled": "generated_join_enabled",
     "agg-optimistic.rows": "agg_optimistic_rows",

@@ -920,3 +920,226 @@ def _pad_np(arr, cap):
     out = np.zeros((cap,) + arr.shape[1:], arr.dtype)
     out[: arr.shape[0]] = arr
     return out
+
+
+# ---------------------------------------------------------------------
+# ICI exchange plane (ISSUE 18): lower a spooled repartition edge to an
+# in-program lax.all_to_all when the producer stage's spools and the
+# consumer stage's readers are co-resident on ONE process mesh. The
+# spool plane stays authoritative for DCN-remote consumers and for
+# replay/fault recovery; this plane only replaces the
+# partition -> serialize -> HTTP -> deserialize -> re-stage hop with a
+# single collective over the device interconnect.
+
+
+def ici_exchange_supported(nparts: int, pages) -> bool:
+    """Static shape gate for `ici_exchange_pages`: the exchange maps
+    partition p to mesh device p, so the partition count must be a
+    power of two that the local device pool can host, and every page's
+    capacity (a ladder power of two >= 8) must shard evenly across it.
+    Anything else stays on the spool plane — a shape, never an error."""
+    if nparts < 2 or (nparts & (nparts - 1)) != 0:
+        return False
+    if nparts > len(jax.devices()):
+        return False
+    return all(p.capacity % nparts == 0 for p in pages)
+
+
+_ICI_MESHES: Dict[int, Mesh] = {}
+
+# compiled all_to_all exchange programs, keyed by exchange geometry
+# (see _ici_program: process-level so per-query coordinator executors
+# share warm programs the way the shapes ladder intends)
+_ICI_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _ici_mesh(d: int) -> Mesh:
+    """One cached Mesh per device count: the compiled exchange
+    programs close over the mesh object, so handing every jit-cache
+    hit the SAME mesh keeps shard_map from re-validating placements."""
+    if d not in _ICI_MESHES:
+        _ICI_MESHES[d] = make_mesh(d)
+    return _ICI_MESHES[d]
+
+
+def _ici_program(ex, mesh: Mesh, keys: Tuple[int, ...], dicts,
+                 nluts: int, d: int, out_cap: int):
+    """The per-page exchange collective: shard-local splitmix64
+    routing + all_to_all + compaction to the ladder landing capacity.
+    Mirrors DistExecutor._repartition_fn, with two deltas: the routing
+    hash is dist/spool.device_row_hash_u64 — BIT-IDENTICAL to the
+    spool plane's host and device partitioners, so a mid-query
+    fallback (or one side of a co-partitioned join taking the spool
+    path) lands every row in the same partition — and the landing
+    capacity is shapes.exchange_partition_cap, the SAME bucket the
+    spool partitioner compacts to, so consumer jit keys cannot tell
+    the planes apart."""
+    from presto_tpu.dist import spool as SPOOL
+
+    def body(pg: Page, *vhs):
+        vh_by_key = iter(vhs)
+        full = tuple(next(vh_by_key) if dct is not None else None
+                     for dct in dicts)
+        r = pg.capacity  # local rows per device
+        h = SPOOL.device_row_hash_u64(pg, keys, full)
+        tgt = (h % jnp.uint64(d)).astype(jnp.int32)
+        tgt = jnp.where(pg.valid, tgt, d)
+        # stable-sort rows by destination partition (== destination
+        # device), position within each destination bucket
+        perm = jnp.argsort(tgt, stable=True)
+        st = tgt[perm]
+        first = jnp.searchsorted(
+            st, jnp.arange(d, dtype=st.dtype), side="left"
+        )
+        pos = jnp.arange(r, dtype=jnp.int64) - first[
+            jnp.clip(st, 0, d - 1)].astype(jnp.int64)
+        slot = jnp.where(
+            (st < d) & (pos < r),
+            st.astype(jnp.int64) * r + pos,
+            jnp.int64(d * r),
+        )
+
+        def to_send(x):
+            out = jnp.zeros((d * r,), dtype=x.dtype)
+            return out.at[slot].set(x[perm], mode="drop").reshape(d, r)
+
+        sent = jax.tree.map(to_send, pg)  # includes valid
+        recv = jax.tree.map(
+            lambda x: jax.lax.all_to_all(
+                x, "d", split_axis=0, concat_axis=0, tiled=False
+            ),
+            sent,
+        )
+        flat = jax.tree.map(
+            lambda x: x.reshape((d * r,) + x.shape[2:]), recv
+        )
+        # compact the d*r landing zone to the spool plane's partition
+        # bucket; skew joins the boosted-retry ladder via the
+        # OR-reduced flag exactly like device_partition_pages
+        targets, out_valid, num = compact_indices(flat.valid, out_cap)
+        blocks = []
+        for blk in flat.blocks:
+            if isinstance(blk.data, tuple):
+                data = tuple(scatter_column(dd, targets, out_cap)
+                             for dd in blk.data)
+            else:
+                data = scatter_column(blk.data, targets, out_cap)
+            nulls = (scatter_column(blk.nulls, targets, out_cap)
+                     if blk.nulls is not None else None)
+            blocks.append(blk.with_data(data, nulls=nulls))
+        out = Page(blocks=tuple(blocks), valid=out_valid)
+        overflow = jax.lax.psum(
+            (num > out_cap).astype(jnp.int32), "d") > 0
+        return out, overflow
+
+    # PROCESS-level cache, not ex._jit_cache: the coordinator builds
+    # one executor per query, and a per-executor cache would re-pay
+    # the shard_map compile for every query (and every test) hitting
+    # the same exchange shape. The program depends on the dicts only
+    # through their None-pattern (LUT values are operands), and jit
+    # re-traces per page schema on its own — so the key is just the
+    # exchange geometry. Benign-race dict like _ICI_MESHES: a lost
+    # write costs one duplicate compile, never a wrong program.
+    key = (keys, d, out_cap,
+           tuple(dct is not None for dct in dicts), nluts)
+    if key not in _ICI_PROGRAMS:
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(PS("d"),) + (PS(),) * nluts,
+            out_specs=(PS("d"), PS()), check_vma=False,
+        ))
+        if jax.default_backend() == "cpu":
+            # the CPU collective-rendezvous fence, same reasoning as
+            # DistExecutor._fenced (dataflow-readiness scheduling can
+            # interleave two in-flight collectives)
+            inner = fn
+
+            def fn(*args):
+                out = inner(*args)
+                # xfercheck: raw-ok - sync fence (no copy): pins
+                jax.block_until_ready(out)  # rendezvous order on CPU
+                return out
+
+        _ICI_PROGRAMS[key] = fn
+    return _ICI_PROGRAMS[key]
+
+
+def ici_exchange_pages(ex, pages, keys: Tuple[int, ...], nparts: int):
+    """Exchange spooled raw producer pages into `nparts` partition
+    page lists over the device interconnect — ONE all_to_all program
+    per raw page, no serialization and no host hop anywhere on the
+    path (device-resident inputs stage with zero metered bytes; a
+    host-resident input pays its honest h2d once).
+
+    Returns ``(parts, ici_bytes)`` where ``parts[p]`` is the list of
+    device partition pages consumer task p reads (capacities identical
+    to what `device_partition_pages` would have spooled) and
+    ``ici_bytes`` is the static byte footprint routed through the
+    collective's send buffers — the ledger row `exec/counters.py`
+    declares and `adaptive/replanner.py` costs exchanges with.
+
+    Overflow discipline: the per-program OR-reduced flag settles HERE
+    (the coordinator owns this exchange; there is no worker attempt
+    loop to defer into) — each overflowing round re-runs EVERY page at
+    the next ladder rung so all partition pages land at one capacity,
+    counting `capacity_boost_retries` like any other boosted retry."""
+    from presto_tpu.dist import spool as SPOOL
+    from presto_tpu.exec import shapes as SH
+    from presto_tpu.exec.executor import page_bytes
+
+    pages = list(pages)
+    if not ici_exchange_supported(nparts, pages):
+        raise ValueError(
+            f"ici exchange unsupported: nparts={nparts} over "
+            f"{len(jax.devices())} devices, caps="
+            f"{[p.capacity for p in pages]}")
+    d = nparts
+    mesh = _ici_mesh(d)
+    page_spec = NamedSharding(mesh, PS("d"))
+    lut_spec = NamedSharding(mesh, PS())
+    ici_bytes = 0
+    staged = []
+    for page in pages:
+        dicts = tuple(page.block(k).dictionary for k in keys)
+        luts = tuple(
+            XF.to_device(SPOOL._dict_value_hashes(dct), spec=lut_spec,
+                         label="dict-hash")
+            if dct is not None else None
+            for dct in dicts
+        )
+        pg = XF.to_device(page, spec=page_spec,
+                          label="ici-exchange-stage")
+        ici_bytes += page_bytes(page)
+        staged.append((pg, dicts, luts))
+    boost = ex._capacity_boost
+    while True:
+        outs = []
+        overflowed = False
+        for pg, dicts, luts in staged:
+            out_cap = SH.exchange_partition_cap(
+                pg.capacity, nparts, boost)
+            fn = _ici_program(ex, mesh, keys, dicts,
+                              sum(1 for v in luts if v is not None),
+                              d, out_cap)
+            out, overflow = fn(pg, *[v for v in luts
+                                     if v is not None])
+            outs.append((out, out_cap))
+            if bool(overflow):
+                overflowed = True
+        if not overflowed:
+            break
+        boost = SH.next_boost(boost)
+        ex.capacity_boost_retries += 1
+        if boost > SH.DEVICE_FAULT_ROWS:
+            raise RuntimeError(
+                "ici exchange overflow did not settle on the boost "
+                "ladder")
+    parts: List[List[Page]] = [[] for _ in range(nparts)]
+    for out, out_cap in outs:
+        # shard p of the exchanged page IS partition p: slice it out
+        # as consumer task p's device page (device-side view, no
+        # crossing — the spool data plane serves it from here)
+        for p in range(nparts):
+            parts[p].append(jax.tree.map(
+                lambda x, p=p: x[p * out_cap:(p + 1) * out_cap], out))
+    return parts, ici_bytes

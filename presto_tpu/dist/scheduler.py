@@ -134,6 +134,15 @@ class StageScheduler:
         self._worker_boosts = 0
         self._worker_skew = 0
         self.replanner = None  # set by run() when adaptive is on
+        # ICI exchange plane (ISSUE 18): producer fids whose
+        # repartition edge lowers to an in-program all_to_all, the
+        # synthetic finished-task placement holding each one's
+        # partition pages, and the coordinator-built stage stats the
+        # re-planner reads in place of the (raw, unpartitioned)
+        # worker spool stats
+        self._mesh_fids: set = set()
+        self._mesh_placement: Dict[int, _Placement] = {}
+        self._mesh_stats: Dict[int, object] = {}
 
     # ------------------------------------------------------ plumbing
     def _retry_attempts(self) -> int:
@@ -170,6 +179,150 @@ class StageScheduler:
                 return self._ntasks[f.fid]
         return 1  # root consumer (always a gather) or unknown
 
+    # ------------------------------------------- ICI exchange plane
+    def _mesh_eligible(self, fid: int, pool: List[str]) -> bool:
+        """Whether this stage's repartition edge lowers to the ICI
+        all_to_all plane (ISSUE 18). auto/true = only when the whole
+        dispatch pool is co-resident in THIS process (the mesh the
+        coordinator's collective runs on IS the mesh the spools live
+        on — zero-copy collection, zero-copy consumer reads) and the
+        consumer task count maps onto the local device mesh. Any miss
+        is a shape, not an error: the stage simply keeps the spooled
+        HTTP plane, which stays authoritative for DCN-remote
+        consumers and replay recovery."""
+        import jax
+
+        from presto_tpu.server import worker as W
+
+        mode = self.coord.runner.session.get("mesh_exchange_mode")
+        if mode == "false":
+            return False
+        frag = self.dag.fragment(fid)
+        if frag.output_kind != "repartition" or not frag.output_keys:
+            return False
+        if not frag.sharded:
+            return False
+        nparts = self._consumer_tasks(fid)
+        if nparts < 2 or (nparts & (nparts - 1)) != 0:
+            return False
+        if nparts > len(jax.devices()):
+            return False
+        return all(W.local_runtime(uri) is not None for uri in pool)
+
+    def _run_mesh_exchange(self, fid: int) -> None:
+        """After the stage barrier: collect the producers' RAW device
+        pages straight out of their same-process spools, run the
+        all_to_all partitioning program, and park the partition pages
+        in ONE synthetic finished task on the first producer's
+        runtime — consumers then read partition t.index from it over
+        the unchanged spool data plane. Any trace/shape failure falls
+        back LOUDLY (counted, logged) to the spool partitioner with
+        BIT-IDENTICAL splitmix64 routing, so the fallback's partition
+        contents equal the collective's."""
+        import logging
+
+        from presto_tpu.adaptive import StageStats
+        from presto_tpu.dist import executor as DX
+        from presto_tpu.dist import spool as SPOOL
+        from presto_tpu.server import worker as W
+        from presto_tpu.server.worker import _TaskSpool
+
+        ex = self.ex
+        frag = self.dag.fragment(fid)
+        keys = tuple(frag.output_keys)
+        nparts = self._consumer_tasks(fid)
+        pages = []
+        for t in self.tasks[fid]:
+            it = SPOOL.local_source_pages(
+                t.placement.uri, t.placement.task_id, 0)
+            if it is None:
+                # placement migrated off-process mid-stage (replay on
+                # a remote survivor): nothing to collect locally —
+                # loud fallback is impossible too, so the consumers
+                # must read the raw spool; surface as a hard error
+                # (eligibility pinned every pool member local, and a
+                # replay lands back on the same local pool)
+                raise RuntimeError(
+                    f"mesh exchange: producer spool for stage {fid} "
+                    f"not local at {t.placement.uri}")
+            pages.extend(it)
+        from presto_tpu.exec.executor import page_bytes
+
+        total_bytes = sum(page_bytes(p) for p in pages)
+        ici = False
+        try:
+            parts, nbytes = DX.ici_exchange_pages(
+                ex, pages, keys, nparts)
+            ex.ici_exchanges += 1
+            ex.ici_bytes += nbytes
+            ici = True
+        except Exception as e:  # noqa: BLE001 - loud fallback below
+            ex.mesh_exchange_fallbacks += 1
+            logging.getLogger("presto_tpu.dist").warning(
+                "mesh exchange for stage %d fell back to the spool "
+                "partitioner: %r", fid, e)
+            from presto_tpu.exec import shapes as SH
+
+            # the coordinator owns this exchange, so the spool
+            # partitioner's deferred overflow flags settle HERE (a
+            # worker defers them into its stream_fragment attempt
+            # loop); each overflowing round re-partitions everything
+            # one ladder rung up
+            boost0 = ex._capacity_boost
+            try:
+                while True:
+                    n0 = len(ex._pending_overflow)
+                    parts = [[] for _ in range(nparts)]
+                    for page in pages:
+                        for p, part_page in \
+                                SPOOL.device_partition_pages(
+                                    ex, page, keys, nparts):
+                            parts[p].append(part_page)
+                    flags = ex._pending_overflow[n0:]
+                    del ex._pending_overflow[n0:]
+                    if not any(bool(f) for f in flags):
+                        break
+                    ex._capacity_boost = SH.next_boost(
+                        ex._capacity_boost)
+                    ex.capacity_boost_retries += 1
+                    if ex._capacity_boost > SH.DEVICE_FAULT_ROWS:
+                        raise RuntimeError(
+                            "mesh-exchange fallback overflow did not "
+                            "settle on the boost ladder")
+            finally:
+                ex._capacity_boost = boost0
+        # host budget 0 = never demote: the landing caps already
+        # bound HBM residency, and a demotion would serialize
+        # (spool_blob d2h) behind the plane's zero-crossing contract
+        spool = _TaskSpool(nparts, 0)
+        for p in range(nparts):
+            for page in parts[p]:
+                spool.put_page(p, page, rows=0)
+        uri = self.tasks[fid][0].placement.uri
+        task_id = f"{self.qid}.f{fid}.mesh"
+        W.local_runtime(uri).register_finished_task(task_id, spool)
+        self._mesh_placement[fid] = _Placement(uri, task_id)
+        # stage stats for the re-planner: the mesh path never pulls
+        # per-partition counts (that d2h is exactly what it deletes),
+        # so rows/bytes are the STATIC capacity upper bounds;
+        # ici_bytes>0 marks the freight as interconnect-resident for
+        # the broadcast-flip cost model (adaptive/replanner.py)
+        self._mesh_stats[fid] = StageStats(
+            fid=fid,
+            rows=sum(p.capacity for p in pages),
+            bytes=total_bytes,
+            part_rows=tuple(
+                sum(pg.capacity for pg in parts[p])
+                for p in range(nparts)),
+            part_bytes=tuple(
+                total_bytes // nparts for _ in range(nparts)),
+            task_rows=tuple(
+                sum(p.capacity for p in pages)
+                for _ in self.tasks[fid]),
+            wire_bytes=0,
+            ici_bytes=total_bytes if ici else 0,
+        )
+
     def _payload_for(self, t: _SchedTask, task_id: str) -> Dict:
         frag = self.dag.fragment(t.fid)
         n = self._ntasks[t.fid]
@@ -186,7 +339,15 @@ class StageScheduler:
             # workers record queue/run/attempt spans and ship them on
             # the status plane for the cross-node timeline
             payload["trace"] = True
-        if frag.output_kind == "repartition":
+        if t.fid in self._mesh_fids:
+            # ICI exchange plane (ISSUE 18): the producer spools its
+            # RAW device pages to ONE partition and the coordinator
+            # runs the all_to_all partitioning itself after the stage
+            # barrier — the worker skips per-page hashing, P-way
+            # compaction, and the spool-stats d2h pull entirely
+            payload["outputPartitions"] = 1
+            payload["meshExchange"] = True
+        elif frag.output_kind == "repartition":
             payload["outputPartitions"] = self._consumer_tasks(t.fid)
             payload["outputKeys"] = list(frag.output_keys)
         else:
@@ -215,7 +376,19 @@ class StageScheduler:
                 ]
                 spec: Dict = {"tasks": tasks}
                 up_kind = self.dag.fragment(u).output_kind
-                if up_kind == "repartition" and read == "broadcast":
+                if (u in self._mesh_placement
+                        and up_kind == "repartition"
+                        and read == "repartition"):
+                    # mesh-lowered producer: every consumer task reads
+                    # its partition from the ONE synthetic task the
+                    # coordinator's all_to_all landed — same spool
+                    # data plane (local fast path or HTTP), one
+                    # producer placement instead of N
+                    mp = self._mesh_placement[u]
+                    spec["tasks"] = [{"uri": mp.uri,
+                                      "taskId": mp.task_id}]
+                    spec["partition"] = t.index
+                elif up_kind == "repartition" and read == "broadcast":
                     # adaptive dist flip: the producer ALREADY spooled
                     # P hash partitions; draining every one of them
                     # from every producer task is exactly the full
@@ -283,6 +456,12 @@ class StageScheduler:
     def _stage_stats(self, fid: int):
         from presto_tpu.adaptive import stats_from_statuses
 
+        if fid in self._mesh_stats:
+            # mesh-lowered stage: the workers spooled RAW pages with
+            # no per-partition stats (the d2h pull the plane
+            # deletes); the coordinator-built capacity-bound stats
+            # stand in (ISSUE 18)
+            return self._mesh_stats[fid]
         bodies = [t.status for t in self.tasks[fid]
                   if t.status is not None]
         if len(bodies) != len(self.tasks[fid]):
@@ -373,6 +552,10 @@ class StageScheduler:
                         self._delete(t.placement)
                     if t.spec is not None:
                         self._delete(t.spec)
+            # synthetic mesh-exchange tasks release like any other
+            # placement (task expiry frees their partition pages)
+            for pl in self._mesh_placement.values():
+                self._delete(pl)
 
     # ------------------------------------------------------- stages
     def _run_stage(self, fid: int) -> None:
@@ -381,6 +564,11 @@ class StageScheduler:
         # rate-limited inside _alive_for_submit)
         pool = self._pool()
         self.stage_pools.append(list(pool))
+        if self._mesh_eligible(fid, pool):
+            # decided BEFORE dispatch: every task payload of this
+            # stage must carry the meshExchange contract (raw
+            # one-partition spools) for the post-barrier collective
+            self._mesh_fids.add(fid)
         stage = self.tasks[fid]
         tr = self.trace
         sspan = None
@@ -421,6 +609,10 @@ class StageScheduler:
                 self._redispatch(t, cause=e, replay=False)
         self.ex.stages_scheduled += 1
         self._wait(stage)
+        if fid in self._mesh_fids:
+            # stage barrier passed: run the ICI all_to_all over the
+            # producers' raw spools before any consumer dispatches
+            self._run_mesh_exchange(fid)
         if tr is not None:
             tr.end(sspan)
         # the EventListener SPI fires traced or not (span stats ride

@@ -323,6 +323,8 @@ def device_partition_pages(
     boost = ex._capacity_boost
     cap = SH.exchange_partition_cap(cap_in, nparts, boost)
     use_pallas = ex._pallas_exchange_on()
+    if use_pallas:
+        ex.pallas_kernels_used += 1
 
     def body(pg: Page, *vhs):
         vh_by_key = iter(vhs)

@@ -57,6 +57,25 @@ QUERY_COUNTERS: Dict[str, tuple] = {
     "pallas_joins_used": (
         "counter", "Pallas join kernel engagements (lifetime; EXPLAIN "
         "ANALYZE reports the per-query delta)"),
+    "pallas_kernels_used": (
+        "counter", "Pallas kernel engagements of ANY kind — join "
+        "probes, segmented-reduction aggregations, partition-id "
+        "exchange hashing (lifetime; the device-native kernel tier's "
+        "overall engagement gauge)"),
+    "ici_exchanges": (
+        "counter", "repartition exchanges lowered to an in-program "
+        "lax.all_to_all over the co-resident mesh instead of the "
+        "spool/HTTP plane (dist/scheduler.py mesh-exchange plane; "
+        "coordinator lifetime)"),
+    "ici_bytes": (
+        "counter", "bytes routed through mesh all_to_all exchange "
+        "programs (send-buffer footprint of the settled attempt — "
+        "interconnect traffic, never a host crossing; coordinator "
+        "lifetime)"),
+    "mesh_exchange_fallbacks": (
+        "counter", "mesh-lowered exchanges that fell back LOUDLY to "
+        "the authoritative spool plane (trace failure or unsettled "
+        "overflow ladder) — counted, never a silent wrong answer"),
     "programs_compiled": (
         "gauge", "real XLA backend compiles attributed to this query "
         "(a persistent-cache hit counts as program_cache_hits)"),

@@ -350,6 +350,16 @@ class Executor:
         # property); pallas_joins_used is observability for tests
         self.pallas_join = False
         self.pallas_joins_used = 0
+        # every Pallas kernel engagement (joins, segmented-reduction
+        # aggregation, partition-id exchange hashing) — the device-
+        # native tier's overall gauge (ISSUE 18)
+        self.pallas_kernels_used = 0
+        # mesh all_to_all exchange plane (dist/scheduler.py; mirrored
+        # onto the coordinator): exchanges lowered onto the ICI mesh,
+        # their send-buffer bytes, and loud fallbacks to the spool plane
+        self.ici_exchanges = 0
+        self.ici_bytes = 0
+        self.mesh_exchange_fallbacks = 0
         # build-free generated joins (generated_join_enabled session
         # property); generated_joins_used is observability for tests
         self.generated_join = True
@@ -888,6 +898,16 @@ class Executor:
         CPU+TPU worker pool and silently mis-route co-partitioned
         join keys; "true"/"force" is session-distributed to every
         task payload, so it resolves identically fleet-wide."""
+        return self.pallas_join in (True, "force")
+
+    def _pallas_agg_on(self) -> bool:
+        """Segmented-reduction Pallas aggregation (ops/pallas_agg.py),
+        behind the pallas_join_enabled tri-state. Engaged only when
+        explicitly forced, and then always in interpret mode: the
+        kernel's in-kernel one-hot dot is unvalidated on hardware
+        (pallas_agg.agg_lowers_on_tpu), matching the radix join
+        probe's posture. "auto" keeps the jnp segment-op path, which
+        computes identical results."""
         return self.pallas_join in (True, "force")
 
     def _jit(self, key, fn, static_argnums=(), donate_argnums=()):
@@ -1684,9 +1704,13 @@ class Executor:
         if (node.capacity > A.MATMUL_AGG_MAX_GROUPS
                 and _subtree_has_join(node.source)):
             return None
+        pallas = self._pallas_agg_on()
+        if pallas:
+            self.pallas_kernels_used += 1
         raw = functools.partial(
             _partial_agg_page, node.group_channels, node.aggregates,
             layouts_t, collect_k=self._collect_k_eff,
+            pallas_agg=pallas,
         )
         merge_raw = functools.partial(
             _merge_partials_page, node.aggregates, layouts_t,
@@ -2525,7 +2549,8 @@ class Executor:
                 key_extra=("partial", node.group_channels,
                            node.aggregates, pcap,
                            64 * self._capacity_boost,
-                           self._collect_k_eff),
+                           self._collect_k_eff,
+                           self._pallas_agg_on()),
             )
             if fused is not None:
                 yield from fused
@@ -2544,13 +2569,17 @@ class Executor:
             return
         cap = _next_pow2(node.capacity * self._capacity_boost)
         max_iters = 64 * self._capacity_boost
+        pallas_agg = self._pallas_agg_on()
+        if pallas_agg:
+            self.pallas_kernels_used += 1
         fn = self._jit(
             ("agg_partial", node.group_channels, node.aggregates,
-             tuple(tuple(l) for l in layouts), self._collect_k_eff),
+             tuple(tuple(l) for l in layouts), self._collect_k_eff,
+             pallas_agg),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts),
-                collect_k=self._collect_k_eff,
+                collect_k=self._collect_k_eff, pallas_agg=pallas_agg,
             ),
             static_argnums=(1, 2),
         )
@@ -2792,13 +2821,17 @@ class Executor:
         if self.agg_optimistic_rows:
             cap = min(cap, _next_pow2(
                 self.agg_optimistic_rows * self._capacity_boost))
+        pallas_agg = self._pallas_agg_on()
+        if pallas_agg:
+            self.pallas_kernels_used += 1
         partial_fn = self._jit(
             ("agg_partial", node.group_channels, node.aggregates,
-             tuple(tuple(l) for l in layouts), self._collect_k_eff),
+             tuple(tuple(l) for l in layouts), self._collect_k_eff,
+             pallas_agg),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts),
-                collect_k=self._collect_k_eff,
+                collect_k=self._collect_k_eff, pallas_agg=pallas_agg,
             ),
             static_argnums=(1, 2),
         )
@@ -2852,7 +2885,8 @@ class Executor:
                 node.source, agg_tail=tail,
                 key_extra=("single", node.group_channels,
                            node.aggregates, cap, max_iters,
-                           self._collect_k_eff),
+                           self._collect_k_eff,
+                           self._pallas_agg_on()),
             )
             if tail is not None and node.group_channels else None
         )
@@ -2994,13 +3028,17 @@ class Executor:
         cap = _next_pow2(node.capacity * self._capacity_boost)
         pcap = SH.chunk_bucket(cap, parts)
         max_iters = 64 * self._capacity_boost
+        pallas_agg = self._pallas_agg_on()
+        if pallas_agg:
+            self.pallas_kernels_used += 1
         partial_fn = self._jit(
             ("agg_partial", node.group_channels, node.aggregates,
-             tuple(tuple(l) for l in layouts), self._collect_k_eff),
+             tuple(tuple(l) for l in layouts), self._collect_k_eff,
+             pallas_agg),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts),
-                collect_k=self._collect_k_eff,
+                collect_k=self._collect_k_eff, pallas_agg=pallas_agg,
             ),
             static_argnums=(1, 2),
         )
@@ -3070,13 +3108,17 @@ class Executor:
         cap = _next_pow2(node.capacity * self._capacity_boost)
         pcap = SH.chunk_bucket(cap, parts)
         max_iters = 64 * self._capacity_boost
+        pallas_agg = self._pallas_agg_on()
+        if pallas_agg:
+            self.pallas_kernels_used += 1
         partial_fn = self._jit(
             ("agg_partial", node.group_channels, node.aggregates,
-             tuple(tuple(l) for l in layouts), self._collect_k_eff),
+             tuple(tuple(l) for l in layouts), self._collect_k_eff,
+             pallas_agg),
             functools.partial(
                 _partial_agg_page, node.group_channels, node.aggregates,
                 tuple(tuple(l) for l in layouts),
-                collect_k=self._collect_k_eff,
+                collect_k=self._collect_k_eff, pallas_agg=pallas_agg,
             ),
             static_argnums=(1, 2),
         )
@@ -3780,6 +3822,7 @@ class Executor:
         from presto_tpu.ops import pallas_join as PJ
 
         self.pallas_joins_used += 1
+        self.pallas_kernels_used += 1
         layout = PJ.plan_layout(build.capacity)
         interpret = self._pallas_interpret(layout)
         index, build_ovf = self._jit(
@@ -3978,6 +4021,7 @@ class Executor:
             from presto_tpu.ops import pallas_join as PJ
 
             self.pallas_joins_used += 1
+            self.pallas_kernels_used += 1
             layout = PJ.plan_layout(build.capacity)
             interpret = self._pallas_interpret(layout)
         use_unique = (
@@ -4635,7 +4679,18 @@ def _collect_finalize_block(spec, in_t, extra_t, state_blocks) -> Block:
 
 
 def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
-                      cap: int, max_iters: int = 64, collect_k: int = 1024):
+                      cap: int, max_iters: int = 64, collect_k: int = 1024,
+                      pallas_agg: bool = False):
+    # segmented-reduction Pallas tier (ops/pallas_agg.py, ISSUE 18):
+    # same SQL semantics, group totals from the blocked one-hot-matmul
+    # kernel; unsupported kinds delegate back to the jnp path inside
+    # PA.aggregate, so one dispatch covers the whole layout
+    if pallas_agg:
+        from presto_tpu.ops import pallas_agg as PA
+
+        agg_fn = functools.partial(PA.aggregate, interpret=True)
+    else:
+        agg_fn = A.aggregate
     groups = _group_ids(group_channels, page, cap, max_iters)
     # dense fast path may size output below cap (see _group_ids)
     out_cap = groups.group_valid.shape[0]
@@ -4663,7 +4718,7 @@ def _partial_agg_page(group_channels, aggregates, layouts, page: Page,
         for st in layout:
             vals, out_nulls, dic = _state_reduce(
                 st, blk, st.input_kind, True,
-                lambda data, nulls, k=st.input_kind: A.aggregate(
+                lambda data, nulls, k=st.input_kind: agg_fn(
                     groups, k, out_cap, data, nulls
                 ),
             )

@@ -218,6 +218,17 @@ TRANSFER_REGISTRY: Dict[str, Tuple[str, str, str]] = {
         "d2h", "data",
         "CPU-only collective fence: blocks on program outputs to "
         "serialize rendezvous order — a sync, not a copy"),
+    "dist.executor._ici_program": (
+        "d2h", "data",
+        "ICI exchange collective's CPU-only rendezvous fence (ISSUE "
+        "18), same sync-not-copy shape as DistExecutor._fenced"),
+    "dist.executor.ici_exchange_pages": (
+        "h2d", "data",
+        "ICI exchange staging: spooled producer pages commit onto "
+        "the exchange mesh sharded over axis d (device-resident "
+        "pages cross ZERO bytes — the zero-crossing half of the "
+        "ledger pin; a host-resident input pays its honest h2d "
+        "once) plus replicated dictionary value-hash LUTs"),
     "dist.executor._stack_to_mesh": (
         "h2d+d2h", "data",
         "local pages gather to host (d2h when device-resident) and "

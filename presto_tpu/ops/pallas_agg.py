@@ -1,0 +1,237 @@
+"""Pallas TPU segmented-reduction aggregation kernel (north-star:
+"HashAggregationOperator as a segmented reduction", SURVEY §8.2.3).
+
+The contract is shared with ops/agg._sorted_aggregate: rows arrive in
+GROUP-SORTED order (GroupbyResult.sort_perm / gid_sorted from
+compute_groups_sorted), invalid/non-contributing rows carry a group id
+outside [0, num_groups) so they drop out of every reduction for free.
+The kernel grid-blocks the sorted rows on the shapes ladder and
+accumulates per-group partial sums into the SAME out_ref across
+sequential grid steps (TPU grid iterations are sequential, so out_ref
+is a legal accumulator; initialized at program_id == 0). Each step
+reduces its block with one one-hot dot_general — the MXU-shaped
+segmented reduction — instead of a scatter.
+
+int64 exactness: TPU lanes are 32-bit and the dot accumulates int32,
+so i64 values travel as (lo32, hi32) int32 words and are decomposed
+in-kernel into 16 unsigned 4-bit limbs; per-limb group sums stay under
+2^31 for any input up to 2^27 rows, and the host-side recombination
+with wrapping u64 shifts reproduces the two's-complement int64 sum
+exactly (same decomposition argument as ops/agg._mm_sum_int).
+
+Lowering status: the kernel is written TPU-shaped (2-D iota, int8xint8
+dot with int32 accumulation, block ladder), but like the radix join
+probe it is interpret-verified only on this toolchain — the executor
+engages it under pallas_join_enabled=true/force and always in
+interpret mode (`agg_lowers_on_tpu()` is False until the in-kernel
+one-hot dot is validated on hardware). jnp fallback: ops/agg.aggregate
+computes identical results and stays the default everywhere.
+
+Reference: presto-main operator/aggregation/* accumulate loops — the
+per-group accumulation re-expressed as a blocked one-hot matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.ops import agg as A
+from presto_tpu.ops.pallas_join import _split64
+
+# one grid step reduces this many sorted rows (8 sublanes x 128 lanes x
+# 2 groups-of-lanes — small enough that the (B, G) one-hot stays well
+# under VMEM at the group cap below)
+BLOCK_ROWS = 2048
+# group capacity ceiling: (BLOCK_ROWS x G) int8 one-hot + (16, G) int32
+# accumulator must fit VMEM; 4096 matches ops/agg.MATMUL_AGG_MAX_GROUPS
+# so the Pallas tier covers exactly the shapes the jnp MXU tier does
+PALLAS_AGG_MAX_GROUPS = A.MATMUL_AGG_MAX_GROUPS
+
+_N_LIMBS = 16  # 16 x 4-bit limbs cover the full u64 bit pattern
+
+# kinds the segmented-reduction kernel computes; everything else falls
+# back to ops/agg.aggregate (float SUM keeps the jnp path for
+# accumulation-order stability, MIN/MAX/ANY are segment-gather shaped)
+SUPPORTED_KINDS = (A.SUM, A.COUNT, A.COUNT_STAR, A.BOOL_OR, A.BOOL_AND)
+
+
+def agg_lowers_on_tpu() -> bool:
+    """Whether the segmented-reduction kernel lowers through Mosaic on
+    the current toolchain. Not yet: the in-kernel broadcasted-iota
+    one-hot + int8 dot_general is unvalidated on hardware, so the
+    kernel runs interpret-only (the CPU test path), exactly like the
+    radix join probe (ops/pallas_join.layout_lowers_on_tpu)."""
+    return False
+
+
+def _limb_kernel(ids_ref, vlo_ref, vhi_ref, out_ref, *,
+                 num_groups: int):
+    """One grid step: 16-limb decomposition of the block's (lo, hi)
+    words, one int8 x int8 -> int32 dot against the block's one-hot,
+    accumulated into the persistent (16, G) out_ref."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[:]
+    b = ids.shape[0]
+    onehot = (
+        ids[:, None]
+        == jax.lax.broadcasted_iota(jnp.int32, (b, num_groups), 1)
+    ).astype(jnp.int8)
+    lo = vlo_ref[:].astype(jnp.uint32)
+    hi = vhi_ref[:].astype(jnp.uint32)
+    limbs = jnp.concatenate(
+        [
+            jnp.stack(
+                [((w >> jnp.uint32(4 * k)) & jnp.uint32(0xF)).astype(
+                    jnp.int8) for k in range(8)]
+            )
+            for w in (lo, hi)
+        ]
+    )  # (16, B)
+    acc = jax.lax.dot_general(
+        limbs, onehot, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out_ref[:, :] += acc
+
+
+def _segmented_limb_sums(
+    ids: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+    num_groups: int, *, interpret: bool, block_rows: int = BLOCK_ROWS,
+) -> jnp.ndarray:
+    """(16, num_groups) int32 per-limb group sums over rows whose id
+    lies in [0, num_groups); everything else contributes zero."""
+    from jax.experimental import pallas as pl
+
+    n = ids.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        # pad rows route to the dropped id == num_groups
+        ids = jnp.concatenate(
+            [ids, jnp.full((pad,), num_groups, jnp.int32)]
+        )
+        zero = jnp.zeros((pad,), jnp.int32)
+        lo = jnp.concatenate([lo, zero])
+        hi = jnp.concatenate([hi, zero])
+    nblocks = ids.shape[0] // block_rows
+    blk = pl.BlockSpec((block_rows,), lambda j: (j,))
+    kernel = functools.partial(_limb_kernel, num_groups=num_groups)
+    return pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[blk, blk, blk],
+        out_specs=pl.BlockSpec(
+            (_N_LIMBS, num_groups), lambda j: (0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (_N_LIMBS, num_groups), jnp.int32
+        ),
+        interpret=interpret,
+    )(ids, lo, hi)
+
+
+def _recombine_i64(limb_sums: jnp.ndarray) -> jnp.ndarray:
+    """Wrapping u64 recombination of (16, G) per-limb sums back into the
+    exact two's-complement int64 group totals."""
+    shifts = jnp.uint64(1) << (
+        jnp.uint64(4) * jnp.arange(_N_LIMBS, dtype=jnp.uint64)
+    )
+    total = jnp.sum(
+        limb_sums.astype(jnp.int64).astype(jnp.uint64) * shifts[:, None],
+        axis=0, dtype=jnp.uint64,
+    )
+    return total.astype(jnp.int64)
+
+
+def segmented_sum_i64(
+    values: jnp.ndarray, ids: jnp.ndarray, num_groups: int, *,
+    interpret: bool = True,
+) -> jnp.ndarray:
+    """Exact int64 per-group sum of `values` (any integer dtype) over
+    group ids; rows with id outside [0, num_groups) contribute 0."""
+    lo, hi = _split64(values.astype(jnp.int64))
+    limbs = _segmented_limb_sums(
+        ids.astype(jnp.int32), lo, hi, num_groups, interpret=interpret
+    )
+    return _recombine_i64(limbs)
+
+
+def segmented_count(
+    ids: jnp.ndarray, num_groups: int, *, interpret: bool = True
+) -> jnp.ndarray:
+    """int64 per-group row count (ids outside [0, num_groups) drop)."""
+    ones = jnp.ones(ids.shape, jnp.int64)
+    return segmented_sum_i64(ones, ids, num_groups,
+                             interpret=interpret)
+
+
+def supported(kind: str, num_groups: int, data) -> bool:
+    """Whether this (kind, shape) runs on the segmented-reduction
+    kernel; callers fall back to ops/agg.aggregate otherwise."""
+    if kind not in SUPPORTED_KINDS or num_groups > PALLAS_AGG_MAX_GROUPS:
+        return False
+    if isinstance(data, tuple):  # long-decimal limb pairs
+        return False
+    if kind == A.SUM:
+        return data is not None and jnp.issubdtype(
+            data.dtype, jnp.integer
+        )
+    return True
+
+
+def aggregate(
+    groups,  # ops/agg.GroupbyResult
+    kind: str,
+    out_capacity: int,
+    data: Optional[jnp.ndarray] = None,
+    nulls: Optional[jnp.ndarray] = None,
+    *,
+    interpret: bool = True,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Drop-in for ops/agg.aggregate over the supported kinds: same SQL
+    semantics (SUM over zero non-null inputs yields NULL, COUNT yields
+    0), same (values[out_capacity], null_mask) shape, group totals from
+    the Pallas kernel instead of segment ops. Unsupported kinds
+    delegate to the jnp path so callers need no second dispatch."""
+    if not supported(kind, out_capacity, data):
+        return A.aggregate(groups, kind, out_capacity, data, nulls)
+    contributing = groups.row_valid
+    if nulls is not None:
+        contributing = contributing & ~nulls
+    cids = jnp.where(
+        contributing, groups.group_ids, out_capacity
+    ).astype(jnp.int32)
+    if kind == A.COUNT_STAR:
+        ids = jnp.where(
+            groups.row_valid, groups.group_ids, out_capacity
+        ).astype(jnp.int32)
+        return segmented_count(ids, out_capacity,
+                               interpret=interpret), None
+    ncontrib = segmented_count(cids, out_capacity, interpret=interpret)
+    empty = ncontrib == 0
+    if kind == A.COUNT:
+        return ncontrib, None
+    if kind == A.SUM:
+        totals = segmented_sum_i64(
+            data, cids, out_capacity, interpret=interpret
+        )
+        return totals.astype(data.dtype), empty
+    # BOOL_OR / BOOL_AND: count the true contributing rows
+    trues = segmented_count(
+        jnp.where(data.astype(jnp.bool_), cids,
+                  jnp.int32(out_capacity)),
+        out_capacity, interpret=interpret,
+    )
+    if kind == A.BOOL_OR:
+        return (trues > 0), empty
+    return (trues == ncontrib) & ~empty, empty

@@ -22,14 +22,19 @@ Two table layouts, picked by plan_layout(build_capacity):
 
 2. **"radix"** — general bucketed layout up to RADIX_MAX_BUILD rows:
    VMEM-sized buckets addressed by the hash's top bits, one (hash,
-   start, count) entry per unique hash, probed by a (bucket,
-   probe-block) grid kernel. The kernel is correct and covered by the
-   CPU suite in interpret mode, but its per-lane table gather exceeds
-   what this Mosaic version can lower, so on TPU it runs only when
-   forced (pallas_join_enabled=true) and then in interpret mode
-   (XLA-emulated grid). The blueprint is written for the day the
-   toolchain grows vector gather; until then big builds default to the
-   sort join, which is the better TPU program anyway.
+   start, count) entry per unique hash. The probe is a true radix-
+   partitioned pass (ISSUE 18): a host-side partition-id pass bucket-
+   sorts the probe rows, then a 1-D grid probes each padded block
+   against the ONE bucket slice it belongs to, the block -> bucket map
+   riding in as a scalar-prefetch operand — O(N) HBM traffic instead
+   of the old (bucket x block) cross-product's O(buckets * N). The
+   kernel is correct and covered by the CPU suite in interpret mode,
+   but its per-lane table gather exceeds what this Mosaic version can
+   lower, so on TPU it runs only when forced (pallas_join_enabled=true)
+   and then in interpret mode (XLA-emulated grid). The blueprint is
+   written for the day the toolchain grows vector gather; until then
+   big builds default to the sort join, which is the better TPU
+   program anyway.
 
 Reference: presto-main operator/{PagesIndex,JoinHash}.java — the
 address-sorted PagesIndex plus an open-addressing hash over row
@@ -409,41 +414,104 @@ def _radix_kernel(plo_ref, phi_ref, tlo_ref, thi_ref, tstart_ref,
 def _probe_radix(probe_hash, tables, num_buckets, bucket_cap, *,
                  interpret, block_rows: int = 2048,
                  max_probes: int = _MAX_ITERS + 1):
+    """Partition-id pass + per-bucket probe (ISSUE 18).
+
+    The old shape ran a (num_buckets, nblocks) cross-product grid —
+    every probe block re-read against EVERY bucket slice, O(B * N)
+    HBM traffic with each row live in exactly one step. Now a host-
+    side partition-id pass buckets the rows first: sort probe rows by
+    their hash's bucket id, pad each bucket's run to a block_rows
+    multiple (<= num_buckets * (block_rows - 1) pad rows, static
+    bound), and run a 1-D (nblocks,) grid where each block probes
+    exactly the ONE bucket slice it belongs to. The block -> bucket
+    map is data-dependent, so it rides in as a scalar-prefetch operand
+    driving the table BlockSpec index_map — the Pallas radix-join
+    shape from the north-star (partition pass, then per-partition
+    build/probe with grid-blocked HBM tiling).
+
+    Pad slots carry hash 0 and probe like real rows (bounded by
+    max_probes), but their results are never gathered back."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     log2b = (num_buckets - 1).bit_length() if num_buckets > 1 else 0
     n = probe_hash.shape[0]
-    pad = (-n) % block_rows
-    if pad:
-        probe_hash = jnp.concatenate(
-            [probe_hash, jnp.zeros((pad,), probe_hash.dtype)]
-        )
-    np_ = probe_hash.shape[0]
     plo, phi = _split64(probe_hash)
-
-    nblocks = np_ // block_rows
-    grid = (num_buckets, nblocks)
-    pblk = pl.BlockSpec((block_rows,), lambda b, j: (j,))
-    tblk = pl.BlockSpec((bucket_cap,), lambda b, j: (b,))
-    oblk = pl.BlockSpec((block_rows,), lambda b, j: (b * nblocks + j,))
-    kernel = functools.partial(
-        _radix_kernel, bucket_cap=bucket_cap, log2b=log2b,
+    h32 = _mix32(plo, phi)
+    bucket = (
+        (h32 >> jnp.uint32(32 - log2b)).astype(jnp.int32)
+        if log2b else jnp.zeros(h32.shape, jnp.int32)
+    )
+    # partition-id pass: stable bucket sort + padded per-bucket runs
+    perm = jnp.argsort(bucket)
+    sbucket = bucket[perm]
+    counts = jnp.zeros((num_buckets,), jnp.int32).at[bucket].add(
+        jnp.int32(1)
+    )
+    padded = (
+        (counts + jnp.int32(block_rows - 1)) // jnp.int32(block_rows)
+    ) * jnp.int32(block_rows)
+    off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
+    )
+    pad_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded).astype(jnp.int32)]
+    )
+    idx = jnp.arange(n, dtype=jnp.int32)
+    # padded position of sorted row i: bucket base + rank within bucket
+    ppos = pad_off[sbucket] + (idx - off[sbucket])
+    # static ceiling: every bucket pads by < block_rows
+    npad = -(-(n + num_buckets * (block_rows - 1)) // block_rows)
+    npad *= block_rows
+    nblocks = npad // block_rows
+    plo_p = jnp.zeros((npad,), jnp.int32).at[ppos].set(
+        plo[perm], mode="drop")
+    phi_p = jnp.zeros((npad,), jnp.int32).at[ppos].set(
+        phi[perm], mode="drop")
+    # block -> bucket map (scalar prefetch): block k serves the bucket
+    # whose padded run covers row k * block_rows; trailing blocks past
+    # the last padded row clip to the final bucket and probe pad slots
+    bstarts = jnp.arange(nblocks, dtype=jnp.int32) * jnp.int32(
+        block_rows)
+    bmap = jnp.clip(
+        jnp.searchsorted(pad_off[1:], bstarts, side="right").astype(
+            jnp.int32),
+        0, num_buckets - 1,
+    )
+    pblk = pl.BlockSpec((block_rows,), lambda j, bmap: (j,))
+    tblk = pl.BlockSpec((bucket_cap,), lambda j, bmap: (bmap[j],))
+    # in-bucket rows need no bucket-id filter (log2b=0 => all live):
+    # the partition pass already routed each block to its one bucket
+    inner = functools.partial(
+        _radix_kernel, bucket_cap=bucket_cap, log2b=0,
         max_probes=max_probes,
     )
-    start_b, cnt_b = pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((num_buckets * np_,), jnp.int32),
-            jax.ShapeDtypeStruct((num_buckets * np_,), jnp.int32),
-        ),
-        grid=grid,
+
+    def kernel(bmap_ref, *refs):
+        # the scalar-prefetch operand only drives the index_maps; the
+        # probe body never reads it
+        inner(*refs)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblocks,),
         in_specs=[pblk, pblk, tblk, tblk, tblk, tblk],
-        out_specs=(oblk, oblk),
+        out_specs=(pblk, pblk),
+    )
+    start_p, cnt_p = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((npad,), jnp.int32),
+            jax.ShapeDtypeStruct((npad,), jnp.int32),
+        ),
         interpret=interpret,
-    )(plo, phi, *tables)
-    start = jnp.max(start_b.reshape(num_buckets, np_), axis=0)
-    cnt = jnp.max(cnt_b.reshape(num_buckets, np_), axis=0)
-    return start[:n], cnt[:n]
+    )(bmap, plo_p, phi_p, *tables)
+    # gather each row's result from its padded slot, then undo the
+    # bucket sort
+    start = jnp.full((n,), -1, jnp.int32).at[perm].set(start_p[ppos])
+    cnt = jnp.zeros((n,), jnp.int32).at[perm].set(cnt_p[ppos])
+    return start, cnt
 
 
 def probe_index(probe_hash: jnp.ndarray, tables, layout, *,

@@ -882,6 +882,22 @@ class TaskRuntime:
         with self._tasks_lock:
             return self.tasks.pop(task_id, None)
 
+    def register_finished_task(self, task_id: str,
+                               spool: "_TaskSpool") -> None:
+        """Register an already-FINISHED task whose output is a
+        pre-built spool — the ICI exchange plane's landing surface
+        (ISSUE 18): the coordinator runs the all_to_all partitioning
+        itself after the stage barrier and parks the per-partition
+        device pages here, so consumers read them through the ONE
+        spool data plane (mesh-local fast path or HTTP, token-indexed
+        re-fetch, ack/release, task expiry) with no new protocol."""
+        t = _Task(task_id)
+        with t.lock:
+            t.spool = spool
+            t.done = True
+        with self._tasks_lock:
+            self.tasks[task_id] = t
+
     def task_count(self) -> int:
         with self._tasks_lock:
             return len(self.tasks)
@@ -1132,8 +1148,19 @@ class TaskRuntime:
                     )
 
                 dev_exchange = ex._device_exchange_on()
+                mesh_raw = bool(req.get("meshExchange"))
 
                 def emit(page) -> int:
+                    if mesh_raw:
+                        # ICI exchange plane (ISSUE 18): spool the RAW
+                        # page to partition 0 untouched — partitioning
+                        # happens in the coordinator's post-barrier
+                        # all_to_all program, and the per-partition
+                        # stats plane moves there with it (no
+                        # spool-stats d2h pull, no hashing, no P-way
+                        # compaction on this side of the edge)
+                        state["spool"].put_page(0, page, rows=0)
+                        return 1
                     if dev_exchange:
                         # device tier (ISSUE 13): partition + compact
                         # ON DEVICE (dist/spool.device_partition_pages

@@ -116,6 +116,17 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             validate=lambda v: v in ("auto", "true", "false"),
         ),
         PropertyMetadata(
+            "mesh_exchange_mode",
+            "lower a repartition exchange to an in-program "
+            "lax.all_to_all when its producer spools and consumer "
+            "readers are co-resident on one process mesh (ISSUE 18); "
+            "auto = co-resident stages only, false = always the "
+            "spooled HTTP plane (the authoritative path for "
+            "DCN-remote consumers and replay recovery)",
+            str, "auto",
+            validate=lambda v: v in ("auto", "true", "false"),
+        ),
+        PropertyMetadata(
             "spill_threshold_bytes",
             "joins/aggregations whose state estimate exceeds this many "
             "bytes run in hash-partition passes (grace-style spill; 0 = "

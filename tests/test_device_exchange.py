@@ -178,6 +178,16 @@ def workers():
     w2.stop()
 
 
+@pytest.fixture(scope="module")
+def q3_base():
+    """Single-node q3-family baseline rows, computed once: four tests
+    compare distributed rows against it and the LocalRunner compile
+    is the expensive part."""
+    single = LocalRunner({"tpch": TpchConnector(SF)},
+                         page_rows=PAGE_ROWS)
+    return single.execute(Q3_FAMILY).rows
+
+
 def _coord(workers, **props):
     defaults = {
         "stage_scheduler": "true",
@@ -190,7 +200,7 @@ def _coord(workers, **props):
                      session_props=defaults)
 
 
-def test_mesh_local_exchange_zero_crossings(workers):
+def test_mesh_local_exchange_zero_crossings(workers, q3_base):
     """THE acceptance pin: a forced-partitioned q3-family query over
     same-process workers with device_exchange_enabled records ZERO
     h2d/d2h process-total deltas for the exchange phase (snapshot at
@@ -198,11 +208,14 @@ def test_mesh_local_exchange_zero_crossings(workers):
     has happened by then), zero h2d for the whole query (only result
     decode crosses, d2h), and rows identical to both the host-spool
     path and the sqlite oracle."""
-    single = LocalRunner({"tpch": TpchConnector(SF)},
-                         page_rows=PAGE_ROWS)
-    base = single.execute(Q3_FAMILY).rows
+    base = q3_base
 
-    coord = _coord(workers, device_exchange_enabled="true")
+    # mesh_exchange_mode=false: this test pins the SPOOL plane's
+    # ledger (per-partition spools + stats vectors); the ICI
+    # all_to_all plane (ISSUE 18), which pulls no stats vectors at
+    # all, has its own pin in test_ici_exchange_ledger_pin
+    coord = _coord(workers, device_exchange_enabled="true",
+                   mesh_exchange_mode="false")
     at_stage = {}
 
     def hook(fid):
@@ -336,7 +349,7 @@ def test_lazy_spool_materializes_for_http(workers):
         timeout=5).close()
 
 
-def test_worker_loss_mid_exchange_replays(workers):
+def test_worker_loss_mid_exchange_replays(workers, q3_base):
     """Forced fallback: a worker lost between stages (HTTP down AND
     out of the local-runtime registry, so the mesh-local path cannot
     serve its spools) still completes — the scheduler excludes the
@@ -349,9 +362,7 @@ def test_worker_loss_mid_exchange_replays(workers):
     uris = [f"http://127.0.0.1:{w1.start()}",
             f"http://127.0.0.1:{w2.start()}"]
     try:
-        single = LocalRunner({"tpch": TpchConnector(SF)},
-                             page_rows=PAGE_ROWS)
-        base = single.execute(Q3_FAMILY).rows
+        base = q3_base
         coord = _coord(uris, device_exchange_enabled="true",
                        task_retry_attempts=3)
         killed = {}
@@ -458,6 +469,159 @@ def test_donated_jit_wrapper_is_salted():
     x = jnp.arange(4)
     assert np.array_equal(np.asarray(f2(x)), np.arange(4) + 1)
     assert np.array_equal(np.asarray(x), np.arange(4))  # NOT donated
+
+
+# ------------------------- ISSUE 18: ICI all_to_all exchange plane
+def _partition_rows(pairs_or_lists, nparts):
+    """Normalize both planes' outputs to sorted row-repr lists per
+    partition: spool plane yields (p, page) pairs, the ICI plane a
+    list-of-page-lists indexed by partition."""
+    out = [[] for _ in range(nparts)]
+    if isinstance(pairs_or_lists, list) and pairs_or_lists and \
+            isinstance(pairs_or_lists[0], list):
+        for p, plist in enumerate(pairs_or_lists):
+            for pp in plist:
+                out[p].extend(map(repr, pp.to_pylist()))
+    else:
+        for p, pp in pairs_or_lists:
+            out[p].extend(map(repr, pp.to_pylist()))
+    return [sorted(r) for r in out]
+
+
+# every key family at nparts=4, plus ONE nparts=2 case: the routing
+# hash is nparts-independent (h % D), so one extra D pins the modulo
+# plumbing without paying a shard_map compile per (keys, D) pair
+@pytest.mark.parametrize("keys,nparts", [
+    ((0,), 4), ((0,), 2), ((1,), 4), ((3,), 4), ((4,), 4), ((5,), 4),
+    ((0, 1, 2, 3, 4, 5), 4),
+])
+def test_ici_vs_spool_partition_parity_per_key_type(keys, nparts):
+    """The routing contract the fallback depends on: the all_to_all
+    program and the spool partitioner put EVERY row in the SAME
+    partition for every key family — NULL sentinel, -0.0/NaN
+    normalization, dictionary VALUE hashes, short and long decimal —
+    because both compute the identical splitmix64 row hash."""
+    from presto_tpu.dist import executor as DX
+
+    page = _key_page()
+    ex = Executor({"tpch": TpchConnector(SF)})
+    ex.device_exchange = "true"
+    parts, nbytes = DX.ici_exchange_pages(ex, [page], keys, nparts)
+    ici = _partition_rows(parts, nparts)
+    spool = _partition_rows(
+        list(SPOOL.device_partition_pages(ex, page, keys, nparts)),
+        nparts)
+    assert ici == spool, f"keys={keys} nparts={nparts}"
+    assert sum(len(r) for r in ici) == len(page.to_pylist())
+    assert nbytes > 0
+
+
+def test_ici_skew_overflow_boosts_and_preserves_rows():
+    """Seeded skew on the ICI path: every row hashes to ONE partition,
+    overflowing the chunk-bucketed landing capacity — the OR-reduced
+    overflow flag settles on the boost ladder (capacity_boost_retries
+    counted) and no row is dropped."""
+    from presto_tpu.dist import executor as DX
+
+    n = 1 << 14
+    page = Page.from_arrays([[7] * n], [T.BIGINT])
+    ex = Executor({"tpch": TpchConnector(SF)})
+    ex.device_exchange = "true"
+    r0 = ex.capacity_boost_retries
+    parts, _ = DX.ici_exchange_pages(ex, [page], (0,), 4)
+    assert ex.capacity_boost_retries - r0 >= 1
+    rows = [r for plist in parts for pp in plist
+            for r in pp.to_pylist()]
+    assert len(rows) == n
+    nonempty = [p for p, plist in enumerate(parts)
+                if any(pp.num_rows() for pp in plist)]
+    assert len(nonempty) == 1  # the skewed key routes to ONE shard
+
+
+def test_ici_exchange_ledger_pin(workers, q3_base, monkeypatch):
+    """THE ISSUE-18 acceptance pin: on the mesh path the q3-family
+    exchange phase crosses ZERO bytes in EITHER direction (no spool
+    stats vectors — the collective pulls nothing) AND serializes ZERO
+    spool blobs (the wire codec never runs), with ici_exchanges
+    counted and rows identical to the spool plane and the sqlite
+    oracle."""
+    base = q3_base
+
+    blobs = {"n": 0}
+    real = SPOOL.spool_blob
+
+    def counting_blob(page):
+        blobs["n"] += 1
+        return real(page)
+
+    monkeypatch.setattr(SPOOL, "spool_blob", counting_blob)
+    coord = _coord(workers, device_exchange_enabled="true")  # auto mesh
+    snaps = []
+
+    def hook(fid):
+        snaps.append(XF.process_totals())
+
+    coord._stage_hook = hook
+    t0 = XF.process_totals()
+    try:
+        rows = coord.execute(Q3_FAMILY)
+    finally:
+        coord._stage_hook = None
+    t1 = XF.process_totals()
+    ex = coord.runner.executor
+    assert coord.last_distribution == "stage-dag"
+    assert ex.ici_exchanges >= 1
+    assert ex.mesh_exchange_fallbacks == 0
+    assert ex.ici_bytes > 0
+    # q3's DAG is [repartition, repartition, gather]; each _stage_hook
+    # boundary fires AFTER that stage's barrier AND its post-barrier
+    # all_to_all, so the second-to-last snapshot closes the exchange
+    # phase. (The final gather stage still pays the ISSUE-15 gather-
+    # edge spool-stats pull — 8 bytes/page — which is NOT an exchange
+    # crossing; the mesh plane deleted the repartition-edge stats
+    # entirely, which is exactly what this pin holds at ZERO.)
+    assert len(snaps) >= 2
+    ex_h2d = snaps[-2]["h2d_bytes"] - t0["h2d_bytes"]
+    ex_d2h = snaps[-2]["d2h_bytes"] - t0["d2h_bytes"]
+    assert ex_h2d == 0, f"ICI exchange staged {ex_h2d} bytes h2d"
+    assert ex_d2h == 0, f"ICI exchange pulled {ex_d2h} bytes d2h"
+    assert blobs["n"] == 0, (
+        f"mesh path serialized {blobs['n']} spool blobs — the wire "
+        f"codec must never run on the ICI plane")
+    # whole query: only result decode crosses (d2h)
+    assert t1["h2d_bytes"] - t0["h2d_bytes"] == 0
+    assert t1["d2h_bytes"] - t0["d2h_bytes"] > 0
+    # parity: spool plane and sqlite oracle
+    monkeypatch.setattr(SPOOL, "spool_blob", real)
+    spool_rows = _coord(workers, device_exchange_enabled="true",
+                        mesh_exchange_mode="false").execute(Q3_FAMILY)
+    assert rows_equal(rows, spool_rows)
+    assert rows_equal(rows, base)
+    db = load_sqlite(TpchConnector(SF), ["lineitem", "orders"])
+    assert rows_equal(rows, db.execute(Q3_FAMILY).fetchall())
+
+
+def test_ici_trace_failure_falls_back_to_spool(workers, q3_base,
+                                               monkeypatch):
+    """Mid-query fallback: when the collective cannot lower (forced
+    here by making ici_exchange_pages raise), the scheduler falls
+    back LOUDLY to the spool partitioner — counted, logged — and the
+    query still returns identical rows, because the fallback routes
+    with the bit-identical splitmix64 hash."""
+    from presto_tpu.dist import executor as DX
+
+    base = q3_base
+
+    def boom(ex, pages, keys, nparts):
+        raise RuntimeError("forced trace failure")
+
+    monkeypatch.setattr(DX, "ici_exchange_pages", boom)
+    coord = _coord(workers, device_exchange_enabled="true")
+    rows = coord.execute(Q3_FAMILY)
+    ex = coord.runner.executor
+    assert ex.mesh_exchange_fallbacks >= 1
+    assert ex.ici_exchanges == 0
+    assert rows_equal(rows, base)
 
 
 # ------------------------------------------------- xfercheck jnp gap

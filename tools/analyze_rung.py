@@ -113,7 +113,10 @@ def main() -> int:
           f"d2h_transfers={ctr.get('d2h_transfers', 0)} "
           f"transfer_wall_s={ctr.get('transfer_wall_s', 0.0)} "
           f"mesh_local_exchanges={ctr.get('mesh_local_exchanges', 0)} "
-          f"buffers_donated={ctr.get('buffers_donated', 0)}",
+          f"buffers_donated={ctr.get('buffers_donated', 0)} "
+          f"ici_exchanges={ctr.get('ici_exchanges', 0)} "
+          f"ici_bytes={ctr.get('ici_bytes', 0)} "
+          f"pallas_kernels_used={ctr.get('pallas_kernels_used', 0)}",
           file=sys.stderr)
     print(f"# analyzed wall (incl. per-page drain overhead): {total:.2f}s")
     return 0

@@ -10,7 +10,9 @@
 # WorkerServer-backed streaming/pool tests stay in tier 1), plus the
 # sanitized serving smoke (ISSUE 17: a bounded loadbench pass racing
 # the concurrent-admission/batching locks under the runtime
-# sanitizer). All legs but the smoke are pure host Python — nothing
+# sanitizer), and the interpret-mode Pallas smoke (ISSUE 18: radix
+# join + segmented reduction vs host oracles, no device needed).
+# All legs but the smokes are pure host Python — nothing
 # compiles or touches a device — so the whole gate runs in well under
 # 90 s on the 2-core box (combined budget: <= 30 s for the static
 # rules, the rest for the plan audit + serde suite + smoke).
@@ -40,6 +42,11 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
     tests/test_wire_serde.py -q -p no:cacheprovider \
     -k "not spooled_task and not connpool and not streaming \
         and not q3_family and not executor_surface"
+
+echo "# ci_static: interpret-mode Pallas smoke (tools/pallas_smoke.py)" >&2
+# ISSUE 18: radix hash-join probe + segmented reduction on a seeded
+# page, oracle-checked in pure CPU interpret mode — no device, < 5 s
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/pallas_smoke.py
 
 echo "# ci_static: sanitized serving smoke (tools/loadbench.py)" >&2
 # ISSUE 17: a bounded concurrent-load pass with the lock sanitizer

@@ -251,6 +251,23 @@ def check_session_props() -> List[Finding]:
                 "session-props", "README.md", 1,
                 f"etc key {etc_key!r} is undocumented — add it to "
                 f"README's deployment-config table"))
+    # PR-13 mixed-pool caveat pin (ISSUE 18 satellite): the Pallas
+    # exchange partition-id hash is NOT compatible with the splitmix64
+    # tier, so pallas-join.enabled's doc row must carry the warning
+    # that a per-process backend auto-probe would mis-route
+    # co-partitioned keys on a mixed pool — a silently-dropped caveat
+    # here re-opens a wrong-results hole, hence a build gate
+    pj_row = next(
+        (ln for ln in readme.splitlines()
+         if ln.strip().startswith("| `pallas-join.enabled`")), "")
+    if "mixed pool" not in pj_row or "mis-route" not in pj_row:
+        out.append(Finding(
+            "session-props", "README.md", 1,
+            "the `pallas-join.enabled` config-table row must state "
+            "the mixed-pool hashing caveat (Pallas partition ids "
+            "are not splitmix64-compatible; auto-probing the "
+            "backend per process would mis-route co-partitioned "
+            "keys)"))
     return out
 
 

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Interpret-mode Pallas smoke (ISSUE 18): the ci_static leg that
+proves the two device-native kernel tiers still produce ORACLE-exact
+results on a seeded page, in seconds, with no device.
+
+Two checks, both pure CPU interpret mode (the same posture tier-1's
+parity suites pin, compressed to one seeded case each):
+
+  radix join      ops/pallas_join build_index + probe_index on a
+                  4096-row build (> DIM_MAX_BUILD, so the true
+                  radix-partitioned tier runs, not the small-dim
+                  tile), checked against a numpy searchsorted oracle
+                  over duplicate hashes, an invalid band, and an
+                  absent-hash probe band;
+  segmented sum   ops/pallas_agg segmented_sum_i64 / segmented_count
+                  against a host oracle over seeded group ids,
+                  including empty groups and values that overflow
+                  int32 partial sums (the 16x4-bit limb exactness
+                  argument, checked not trusted).
+
+Budget: < 5 s on the 2-core box — one pallas_call compile each in
+interpret mode. Run: `python tools/pallas_smoke.py` (exit 1 on any
+mismatch); tools/ci_static.sh runs it as the Pallas leg.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+
+def _check_radix_join() -> int:
+    import jax.numpy as jnp
+
+    from presto_tpu.ops import pallas_join as PJ
+
+    rng = np.random.default_rng(18)
+    nb, np_ = 1 << 12, 2000
+    assert nb > PJ.DIM_MAX_BUILD  # pin: this leg exercises the RADIX tier
+    # duplicate hashes from a small universe, spread across the u64
+    # range so the radix bucketing (top bits) actually disperses them
+    bhash = rng.choice(500, size=nb).astype(np.uint64) * np.uint64(
+        0x9E3779B97F4A7C15
+    )
+    bvalid = rng.random(nb) > 0.1  # an invalid band the probe must skip
+    # probe: half present hashes, half absent (universe shifted by 1)
+    phash = np.concatenate([
+        rng.choice(500, size=np_ // 2).astype(np.uint64),
+        rng.choice(500, size=np_ - np_ // 2).astype(np.uint64)
+        * np.uint64(2) + np.uint64(1),
+    ]) * np.uint64(0x9E3779B97F4A7C15)
+    layout = PJ.plan_layout(nb)
+    if layout[0] != "radix":
+        print(f"# pallas_smoke: expected radix layout for {nb}-row "
+              f"build, got {layout[0]!r}", file=sys.stderr)
+        return 1
+    tabs, perm, overflow = PJ.build_index(
+        jnp.asarray(bhash), jnp.asarray(bvalid), layout
+    )
+    if bool(overflow):
+        print("# pallas_smoke: unexpected build_index overflow",
+              file=sys.stderr)
+        return 1
+    start, cnt = PJ.probe_index(
+        jnp.asarray(phash), tabs, layout, interpret=True
+    )
+    start, cnt = np.asarray(start), np.asarray(cnt)
+    # oracle: counts of equal-hash VALID build rows, segments located
+    # in the poison-sorted build order (invalid rows sort last)
+    poisoned = np.where(bvalid, bhash, np.uint64(0xFFFFFFFFFFFFFFFF))
+    sh = np.sort(poisoned, kind="stable")
+    want_lo = np.searchsorted(sh, phash, side="left")
+    want_cnt = (
+        np.searchsorted(sh, phash, side="right") - want_lo
+    ).astype(cnt.dtype)
+    if not np.array_equal(cnt, want_cnt):
+        bad = int(np.sum(cnt != want_cnt))
+        print(f"# pallas_smoke: radix join match-count mismatch on "
+              f"{bad}/{np_} probe rows", file=sys.stderr)
+        return 1
+    hit = want_cnt > 0
+    if not np.array_equal(start[hit], want_lo[hit].astype(start.dtype)):
+        print("# pallas_smoke: radix join segment-start mismatch",
+              file=sys.stderr)
+        return 1
+    # the permutation really is the hash-sort of the poisoned build
+    if not np.array_equal(np.asarray(perm)[: nb], np.argsort(
+            poisoned, kind="stable").astype(np.asarray(perm).dtype)[: nb]):
+        print("# pallas_smoke: build perm is not the hash-sort order",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _check_segmented_sum() -> int:
+    import jax.numpy as jnp
+
+    from presto_tpu.ops import pallas_agg as PA
+
+    rng = np.random.default_rng(18)
+    n, groups = 3000, 97  # group 13 deliberately left empty
+    ids = rng.integers(0, groups, n)
+    ids[ids == 13] = 14
+    # values big enough that a 32-bit partial sum would wrap
+    vals = rng.integers(-(1 << 40), 1 << 40, n)
+    got = np.asarray(PA.segmented_sum_i64(
+        jnp.asarray(vals), jnp.asarray(ids), groups, interpret=True))
+    want = np.zeros(groups, dtype=object)
+    for g, v in zip(ids, vals):
+        want[g] += int(v)
+    if not np.array_equal(got, want.astype(np.int64)):
+        print("# pallas_smoke: segmented_sum_i64 mismatch vs host "
+              "oracle", file=sys.stderr)
+        return 1
+    cgot = np.asarray(PA.segmented_count(
+        jnp.asarray(ids), groups, interpret=True))
+    cwant = np.bincount(ids, minlength=groups)
+    if not np.array_equal(cgot, cwant):
+        print("# pallas_smoke: segmented_count mismatch vs host "
+              "oracle", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    rc = _check_radix_join() | _check_segmented_sum()
+    wall = time.monotonic() - t0
+    if rc == 0:
+        print(f"# pallas_smoke: radix join + segmented reduction "
+              f"oracle-exact in {wall:.1f}s (interpret mode)",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

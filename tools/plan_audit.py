@@ -114,6 +114,46 @@ def _wire_misestimate_case(failures: list) -> None:
         print("# wire-misestimate case: ok", file=sys.stderr)
 
 
+def _ici_flip_case(failures: list) -> None:
+    """ISSUE 18: one seeded ICI-vs-spool flip pin. Identical freight,
+    two observed planes: a spooled build whose wire bytes fit the
+    broadcast byte share flips to broadcast, but the SAME build
+    observed on the ICI plane (ici_bytes > 0 — its repartition edge
+    already lowered to the in-program all_to_all) must NOT flip:
+    broadcast reads are spool reads, so the flip would move freight
+    the current plan ships over the interconnect back onto the
+    serde+HTTP wire. The re-planner charges that an ICI_WIRE_RATIO
+    budget handicap (adaptive/replanner.py)."""
+    from presto_tpu.adaptive import Replanner, StageStats
+
+    rp = Replanner(None, None, broadcast_bytes=1 << 20)
+    kw = dict(fid=0, rows=1 << 14, part_rows=(1 << 14,),
+              part_bytes=(1 << 19,), task_rows=(1 << 14,))
+    spooled = StageStats(bytes=1 << 19, wire_bytes=1 << 19, **kw)
+    on_ici = StageStats(bytes=1 << 19, ici_bytes=1 << 19, **kw)
+    tiny_on_ici = StageStats(bytes=1 << 13, ici_bytes=1 << 13, **kw)
+    checks = [
+        (rp._fits_broadcast(spooled),
+         "512KiB spooled build must fit a 1MiB broadcast share "
+         "(the spool-plane flip this case contrasts against)"),
+        (not rp._fits_broadcast(on_ici),
+         "the SAME 512KiB build observed on the ICI plane must NOT "
+         "flip — broadcast would move its freight back onto the "
+         "wire"),
+        (rp._fits_broadcast(tiny_on_ici),
+         "an 8KiB ICI-plane build must still flip (fits even the "
+         "ICI_WIRE_RATIO-shrunk share — truly tiny builds beat any "
+         "exchange)"),
+    ]
+    bad = [msg for ok, msg in checks if not ok]
+    if bad:
+        failures.append(("ici-flip case", bad))
+        for msg in bad:
+            print(f"# ici-flip case: {msg}", file=sys.stderr)
+    else:
+        print("# ici-flip case: ok", file=sys.stderr)
+
+
 def _audit_one(runner, label: str, sql: str, failures: list,
                dag_stats: list, replans: list) -> None:
     from presto_tpu.dist.fragmenter import fragment_dag
@@ -192,6 +232,7 @@ def main() -> int:
     replans: list = []
     n = 0
     _wire_misestimate_case(failures)
+    _ici_flip_case(failures)
     if do_rungs:
         from bench import RUNGS
 
