@@ -41,6 +41,9 @@ from presto_tpu.cache.rules import (  # noqa: F401
     VOLATILE_FUNCTIONS,
     append_only_tables,
     cacheable,
+    descriptor_contains,
+    family_key,
+    filter_descriptor,
     scan_tables,
     select_cache_points,
     snapshot_tokens,

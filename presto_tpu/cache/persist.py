@@ -1,0 +1,326 @@
+"""Persistent warm-start tier for the result cache (ISSUE 19).
+
+Reference: the compile-cache story applied to RESULTS — presto's
+materialized-artifact reuse survives process restarts because the
+artifact carries enough identity to prove it still matches its
+inputs. The result cache's disk tier already holds serializable host
+pytrees; this module adds the missing identity layer: a versioned
+JSON manifest (entry key, snapshot tokens, stream watermark, byte
+size, wire-serde fingerprint) published atomically next to one
+payload file per entry (the spool wire format: dist/serde frames
+under dist/spool length-prefix framing — the SAME bytes the exchange
+plane ships, so there is exactly one page serialization in the
+engine).
+
+Warm load runs once per process when a session configures
+``result_cache_persist_dir`` (the ``shared_cache()`` boot pass):
+every manifest entry whose snapshot tokens still match the live
+connectors is re-admitted through the ordinary ``put_pages`` path
+(budget, LRU, demotion all apply); everything else drops LOUDLY —
+counted on ``cache_manifest_drops``, logged with the reason, and a
+PROVEN-stale payload (the connector answered with a different token)
+is deleted from disk so the next boot does not re-litigate it. A
+truncated manifest, a missing payload file, or a serde-fingerprint
+mismatch each load zero entries and count drops; none of them can
+crash the boot or serve stale rows (validation happens before any
+byte is decoded into the store).
+
+Concurrency: ``CachePersister._lock`` guards only the in-memory
+manifest map and its sequence number. ALL file I/O happens outside
+the lock on a seq-loop: snapshot the manifest under the lock, write
+tmp + atomic rename outside it, then re-check the sequence — a racing
+publish simply triggers one more rewrite (concheck: no blocking I/O
+under a registered lock).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.obs.sanitizer import make_lock, register_owner
+
+log = logging.getLogger("presto_tpu.cache")
+
+MANIFEST_VERSION = 1
+_MANIFEST = "manifest.json"
+
+
+def _entry_file(key: str) -> str:
+    return hashlib.sha256(key.encode()).hexdigest()[:24] + ".pages"
+
+
+def _unpack_frames(blob: bytes) -> List[bytes]:
+    """Inverse of dist/spool.pack_frames over an in-memory payload
+    file; raises ValueError on any truncation/corruption (the caller
+    counts the drop)."""
+    out: List[bytes] = []
+    off = 0
+    n = len(blob)
+    while off < n:
+        if off + 8 > n:
+            raise ValueError("truncated frame header")
+        (ln,) = struct.unpack_from("<q", blob, off)
+        off += 8
+        if ln < 0 or off + ln > n:
+            raise ValueError(f"corrupt frame length {ln}")
+        out.append(blob[off:off + ln])
+        off += ln
+    return out
+
+
+class CachePersister:
+    """Manifest + payload-file lifecycle for one persist directory.
+    One instance per configured directory, owned by the ResultCache
+    (store.configure re-binds on a directory change, the same
+    last-writer-wins governance every other store knob follows)."""
+
+    # lock discipline (tools/lint `locks` rule): the manifest map and
+    # its publish sequence are mutated by concurrent per-query
+    # publishers and the warm-load pass
+    _shared_attrs = ("_entries", "_seq", "_loaded", "_written_seq")
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._lock = make_lock("cache.persist.CachePersister._lock")
+        self._entries: Dict[str, Dict] = {}
+        self._seq = 0
+        self._written_seq = 0
+        self._loaded = False
+        # manifest parse outcome, settled at construction (single-
+        # threaded: the instance is not shared until configure
+        # returns); warm_load reports it as a loud drop
+        self._broken: Optional[str] = None
+        os.makedirs(directory, exist_ok=True)
+        self._read_manifest()
+        register_owner(self)
+
+    # ------------------------------------------------------- manifest
+    def _read_manifest(self) -> None:
+        from presto_tpu.dist.serde import wire_fingerprint
+
+        path = os.path.join(self.directory, _MANIFEST)
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                doc = json.loads(f.read().decode("utf-8"))
+            if int(doc.get("version", -1)) != MANIFEST_VERSION:
+                raise ValueError(
+                    f"manifest version {doc.get('version')!r} "
+                    f"(this engine writes {MANIFEST_VERSION})")
+            entries = doc["entries"]
+            if not isinstance(entries, dict):
+                raise ValueError("manifest entries not a map")
+            if doc.get("serde") != wire_fingerprint():
+                # every payload predates this serde format: the
+                # entries are undecodable here, so the in-memory
+                # manifest starts empty (files stay on disk for a
+                # rolled-back engine; a re-publish of the same key
+                # overwrites its payload file in place)
+                self._broken = (
+                    f"serde fingerprint {doc.get('serde')!r} != "
+                    f"{wire_fingerprint()!r}: {len(entries)} "
+                    f"entries dropped")
+                self._broken_count = len(entries)
+                return
+            self._entries = dict(entries)  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            self._broken = f"unreadable manifest: {e}"
+            self._broken_count = 1
+
+    _broken_count = 0
+
+    def _write_manifest(self) -> None:
+        """Atomic manifest publish on a seq-loop — see module
+        docstring for the lock discipline."""
+        from presto_tpu.dist.serde import wire_fingerprint
+
+        path = os.path.join(self.directory, _MANIFEST)
+        while True:
+            with self._lock:
+                if self._written_seq == self._seq:
+                    return
+                seq = self._seq
+                doc = {
+                    "version": MANIFEST_VERSION,
+                    "serde": wire_fingerprint(),
+                    "entries": dict(self._entries),
+                }
+            blob = json.dumps(doc).encode("utf-8")
+            fd, tmp = tempfile.mkstemp(
+                prefix=_MANIFEST + ".tmp", dir=self.directory)
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return  # disk trouble: persistence is best-effort
+            with self._lock:
+                if self._written_seq < seq:
+                    self._written_seq = seq
+
+    # -------------------------------------------------------- publish
+    def persist(self, key: str, host_pages, tables, snap,
+                watermark: Optional[int],
+                family: Optional[tuple]) -> None:
+        """Write one entry's payload file + manifest row. Called by
+        the store AFTER it released its own lock (file I/O and the
+        per-page serialization never run under the store lock)."""
+        from presto_tpu.dist.serde import serialize_page
+        from presto_tpu.dist.spool import pack_frames
+
+        try:
+            blob = pack_frames([serialize_page(p) for p in host_pages])
+        except Exception as e:  # noqa: BLE001 - best-effort tier:
+            # an unserializable page type stays memory-only
+            log.warning("result-cache persist skipped for %s: %r",
+                        key, e)
+            return
+        fname = _entry_file(key)
+        fd, tmp = tempfile.mkstemp(prefix=fname + ".tmp",
+                                   dir=self.directory)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(self.directory, fname))
+        except OSError as e:
+            log.warning("result-cache persist failed for %s: %r",
+                        key, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return
+        meta = {
+            "file": fname,
+            "nbytes": len(blob),
+            "tables": sorted(list(t) for t in tables),
+            "snap": [list(s) for s in snap],
+            "watermark": watermark,
+            "family": ([family[0], family[1]]
+                       if family is not None else None),
+        }
+        with self._lock:
+            self._entries[key] = meta
+            self._seq += 1
+        self._write_manifest()
+
+    def forget(self, keys) -> None:
+        """Drop entries from the manifest (DML invalidation / stream
+        advance made them stale-by-construction) and delete their
+        payload files; called outside the store lock."""
+        doomed: List[str] = []
+        with self._lock:
+            for k in keys:
+                meta = self._entries.pop(k, None)
+                if meta is not None:
+                    doomed.append(meta["file"])
+                    self._seq += 1
+        for fname in doomed:
+            try:
+                os.unlink(os.path.join(self.directory, fname))
+            except OSError:
+                pass
+        if doomed:
+            self._write_manifest()
+
+    # ------------------------------------------------------ warm load
+    def warm_load(self, cache, catalogs) -> Tuple[int, int]:
+        """Re-admit every still-valid manifest entry into ``cache``;
+        returns (entries loaded, entries dropped). Runs at most once
+        per persister instance — store.configure() re-binds a fresh
+        persister on a directory change, which is what a restarted
+        process's first enabled session does."""
+        from presto_tpu.cache.rules import snapshot_of
+        from presto_tpu.dist.serde import PageWireError, \
+            deserialize_page
+
+        with self._lock:
+            if self._loaded:
+                return (0, 0)
+            self._loaded = True
+            snapshot = dict(self._entries)
+        loaded = 0
+        drops = 0
+        if self._broken is not None:
+            drops += max(1, int(self._broken_count))
+            log.warning("result-cache warm load: %s", self._broken)
+        dead: List[Tuple[str, bool]] = []  # (key, delete_file)
+        for key, meta in snapshot.items():
+            try:
+                tables = frozenset(
+                    (c, t) for c, t in meta["tables"])
+                snap = tuple(
+                    (c, t, v) for c, t, v in meta["snap"])
+                watermark = meta["watermark"]
+                family = (tuple(meta["family"])
+                          if meta.get("family") else None)
+                fname = meta["file"]
+            except (KeyError, TypeError, ValueError):
+                drops += 1
+                dead.append((key, False))
+                log.warning("result-cache warm load: malformed "
+                            "manifest row for %s dropped", key)
+                continue
+            stale = None
+            proven = False
+            for c, t, ver in snap:
+                cur = snapshot_of(catalogs.get(c), t)
+                if cur is None:
+                    stale = (f"{c}.{t} has no live snapshot "
+                             f"(connector absent or versionless)")
+                    break
+                if cur != ver:
+                    stale = (f"{c}.{t} snapshot moved "
+                             f"{ver!r} -> {cur!r}")
+                    proven = True
+                    break
+            if stale is not None:
+                drops += 1
+                dead.append((key, proven))
+                log.warning("result-cache warm load: %s dropped "
+                            "(%s)", key, stale)
+                continue
+            path = os.path.join(self.directory, fname)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                pages = [deserialize_page(b)
+                         for b in _unpack_frames(blob)]
+            except (OSError, ValueError, PageWireError) as e:
+                drops += 1
+                dead.append((key, True))
+                log.warning("result-cache warm load: payload for %s "
+                            "unreadable (%r) — dropped", key, e)
+                continue
+            cache.put_pages(key, pages, tables, watermark=watermark,
+                            snap=snap, family=family, persist=False)
+            loaded += 1
+        if dead:
+            with self._lock:
+                for key, _ in dead:
+                    if self._entries.pop(key, None) is not None:
+                        self._seq += 1
+            for key, delete in dead:
+                if delete:
+                    try:
+                        os.unlink(os.path.join(
+                            self.directory, _entry_file(key)))
+                    except OSError:
+                        pass
+            self._write_manifest()
+        if loaded or drops:
+            log.info("result-cache warm load from %s: %d entries "
+                     "loaded, %d dropped", self.directory, loaded,
+                     drops)
+        return (loaded, drops)

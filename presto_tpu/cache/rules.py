@@ -24,7 +24,16 @@ import dataclasses
 from typing import Dict, FrozenSet, Optional, Set, Tuple
 
 from presto_tpu.exec import plan as P
-from presto_tpu.expr.ir import Call, RowExpression
+from presto_tpu.expr.ir import (
+    AND,
+    BETWEEN,
+    IN,
+    Call,
+    Constant,
+    InputRef,
+    RowExpression,
+    SpecialForm,
+)
 from presto_tpu.obs.profile import plan_fingerprint, structural_fingerprint
 
 # SQL functions whose value depends on when/where they run, not on
@@ -214,22 +223,254 @@ def _worth_caching(node: P.PhysicalNode) -> bool:
     return any(_worth_caching(c) for c in node.children())
 
 
+# ---------------------------------------------------------------------
+# Overlapping subsumption (ISSUE 19): containment over single-column
+# range/IN predicates. A cached `WHERE d < 10` fragment answers
+# `WHERE d < 5` by replaying its pages through the narrower predicate
+# (the residual re-filter) — the materialized-view-rewrite direction's
+# row-expression domain machinery, restricted to the shapes the
+# containment test can PROVE: one column, closed-form range or IN list,
+# over the same scan + projection chain. Anything else stays
+# exact-match.
+
+_CMP_OPS = frozenset({"lt", "le", "gt", "ge", "eq"})
+
+
+def _scalar_const(x) -> Optional[tuple]:
+    """("v", value) for an orderable literal, None otherwise. Bools
+    are excluded (True < 10 is well-defined in Python but nonsense as
+    a range bound); the wrapper keeps a literal None distinguishable
+    from "not a constant"."""
+    if isinstance(x, Constant) and not isinstance(x.value, bool) \
+            and isinstance(x.value, (int, float, str)):
+        return ("v", x.value)
+    return None
+
+
+def _range_desc(channel: int, lo=None, hi=None, lo_strict=False,
+                hi_strict=False) -> Dict:
+    return {"channel": channel, "lo": lo, "hi": hi,
+            "lo_strict": lo_strict, "hi_strict": hi_strict}
+
+
+def _merge_ranges(a: Dict, b: Dict) -> Optional[Dict]:
+    """Conjunction of two range descriptors on the SAME channel —
+    the tighter bound on each side wins."""
+    if a["channel"] != b["channel"]:
+        return None
+    if "in" in a or "in" in b:
+        return None  # AND over IN lists stays exact-match
+    out = dict(a)
+    try:
+        for side, strict, keep in (("lo", "lo_strict", max),
+                                   ("hi", "hi_strict", min)):
+            av, bv = a[side], b[side]
+            if bv is None:
+                continue
+            if av is None or \
+                    (keep(av[1], bv[1]) == bv[1] and av != bv):
+                out[side], out[strict] = bv, b[strict]
+            elif av[1] == bv[1]:
+                out[strict] = a[strict] or b[strict]
+    except TypeError:
+        return None  # incomparable literal types (d < 1 AND d < 'x')
+    return out
+
+
+def filter_descriptor(pred: RowExpression) -> Optional[Dict]:
+    """Canonical containment descriptor for a predicate, or None when
+    it is not a single-column range/IN shape. Ranges carry optional
+    ("v", value) bounds with strictness; IN lists carry the literal
+    set. Comparable literal types only (int/float/str)."""
+    if isinstance(pred, Call) and pred.name in _CMP_OPS \
+            and len(pred.args) == 2:
+        a, b = pred.args
+        op = pred.name
+        if isinstance(b, InputRef) and isinstance(a, Constant):
+            # 10 > d  ==  d < 10: flip operand order
+            a, b = b, a
+            op = {"lt": "gt", "le": "ge", "gt": "lt",
+                  "ge": "le", "eq": "eq"}[op]
+        if not isinstance(a, InputRef):
+            return None
+        v = _scalar_const(b)
+        if v is None:
+            return None
+        c = a.channel
+        if op == "lt":
+            return _range_desc(c, hi=v, hi_strict=True)
+        if op == "le":
+            return _range_desc(c, hi=v)
+        if op == "gt":
+            return _range_desc(c, lo=v, lo_strict=True)
+        if op == "ge":
+            return _range_desc(c, lo=v)
+        return _range_desc(c, lo=v, hi=v)  # eq
+    if isinstance(pred, SpecialForm):
+        if pred.form == AND:
+            descs = [filter_descriptor(a) for a in pred.args]
+            if any(d is None for d in descs):
+                return None
+            out = descs[0]
+            for d in descs[1:]:
+                out = _merge_ranges(out, d)
+                if out is None:
+                    return None
+            return out
+        if pred.form == BETWEEN and len(pred.args) == 3:
+            v, lo, hi = pred.args
+            lov, hiv = _scalar_const(lo), _scalar_const(hi)
+            if isinstance(v, InputRef) and lov is not None \
+                    and hiv is not None:
+                return _range_desc(v.channel, lo=lov, hi=hiv)
+            return None
+        if pred.form == IN and len(pred.args) >= 2:
+            v, cands = pred.args[0], pred.args[1:]
+            vals = [_scalar_const(c) for c in cands]
+            if isinstance(v, InputRef) and all(x is not None
+                                               for x in vals):
+                return {"channel": v.channel,
+                        "in": sorted({x[1] for x in vals}, key=repr)}
+            return None
+    return None
+
+
+def _bound_covers_lo(cached: Dict, wanted: Dict) -> bool:
+    cl = cached["lo"]
+    if cl is None:
+        return True
+    wl = wanted["lo"]
+    if wl is None:
+        return False
+    try:
+        if cl[1] < wl[1]:
+            return True
+        if cl[1] > wl[1]:
+            return False
+    except TypeError:
+        return False  # incomparable literal types
+    # equal bound: a strict cached bound excludes the endpoint a
+    # non-strict wanted bound includes
+    return not (cached["lo_strict"] and not wanted["lo_strict"])
+
+
+def _bound_covers_hi(cached: Dict, wanted: Dict) -> bool:
+    ch = cached["hi"]
+    if ch is None:
+        return True
+    wh = wanted["hi"]
+    if wh is None:
+        return False
+    try:
+        if ch[1] > wh[1]:
+            return True
+        if ch[1] < wh[1]:
+            return False
+    except TypeError:
+        return False
+    return not (cached["hi_strict"] and not wanted["hi_strict"])
+
+
+def _in_range(v, desc: Dict) -> bool:
+    try:
+        if desc["lo"] is not None:
+            if v < desc["lo"][1]:
+                return False
+            if v == desc["lo"][1] and desc["lo_strict"]:
+                return False
+        if desc["hi"] is not None:
+            if v > desc["hi"][1]:
+                return False
+            if v == desc["hi"][1] and desc["hi_strict"]:
+                return False
+    except TypeError:
+        return False
+    return True
+
+
+def descriptor_contains(cached: Dict, wanted: Dict) -> bool:
+    """Whether every row the WANTED predicate keeps is provably kept
+    by the CACHED predicate too — the condition under which replaying
+    the cached pages through the wanted predicate yields exactly the
+    wanted fragment. False on any doubt."""
+    if cached is None or wanted is None:
+        return False
+    if cached["channel"] != wanted["channel"]:
+        return False
+    if "in" in cached:
+        if "in" in wanted:
+            return set(wanted["in"]) <= set(cached["in"])
+        # a range only fits an IN list when it degenerates to equality
+        lo, hi = wanted["lo"], wanted["hi"]
+        return (lo is not None and hi is not None and lo == hi
+                and not wanted["lo_strict"] and not wanted["hi_strict"]
+                and lo[1] in set(cached["in"]))
+    if "in" in wanted:
+        return all(_in_range(v, cached) for v in wanted["in"])
+    return _bound_covers_lo(cached, wanted) and \
+        _bound_covers_hi(cached, wanted)
+
+
+_FAM_CHAIN = (P.Project,)  # interior ops allowed under a family filter
+
+
+def family_key(node: P.PhysicalNode, catalogs) -> Optional[tuple]:
+    """(family key, descriptor, tables) for a Filter whose predicate
+    parses to a containment descriptor over a bare scan + projection
+    chain, else None. The family key is the subtree's canonical
+    fingerprint with the predicate MASKED to a sentinel constant —
+    every member of one family differs ONLY in its predicate (the
+    descriptor carries the channel, so one family can hold entries
+    over different columns without ambiguity), and the snapshot tokens
+    still ride in the key so a write retires the whole family."""
+    if not isinstance(node, P.Filter):
+        return None
+    desc = filter_descriptor(node.predicate)
+    if desc is None:
+        return None
+    below = node.source
+    while isinstance(below, _FAM_CHAIN):
+        below = below.source
+    if not isinstance(below, P.TableScan):
+        return None
+    if uncacheable_reason(node, catalogs) is not None:
+        return None
+    tables = frozenset(scan_tables(node))
+    snap = snapshot_tokens(tables, catalogs)
+    if snap is None:
+        return None
+    masked = dataclasses.replace(node, predicate=Constant("__fam__"))
+    fp = plan_fingerprint(masked, catalogs)
+    return (f"fam:{fp}:{structural_fingerprint(snap)}", desc, tables)
+
+
 def select_cache_points(root: P.PhysicalNode, catalogs, *,
-                        allow=None) -> Dict[int, tuple]:
+                        allow=None,
+                        subsumable: bool = False) -> Dict[int, tuple]:
     """Choose the subtrees whose page streams this query caches:
     the MAXIMAL cacheable subtrees that contain at least one
     materializing operator. A fully cacheable plan gets exactly one
     point (its root); a plan with one volatile/system branch still
     caches every clean expensive branch under it. Returns
-    {id(subnode): (key, subnode, tables)} — node references are held
-    in the values so ids stay stable for the query's lifetime.
+    {id(subnode): (key, subnode, tables, snap, fam)} — node references
+    are held in the values so ids stay stable for the query's
+    lifetime; ``snap`` is the snapshot-token tuple the key was built
+    from (persistence validates it at warm load), and ``fam`` is
+    (family key, descriptor) for subsumable Filter points, None
+    otherwise.
 
     ``allow`` (optional predicate) gates which subtrees may become
     points at all — the distributed executor passes its distribution
     test so only REPLICATED subtrees cache (their pages are ordinary
     single-stream Pages a host replay can reproduce; mesh-SHARDED
     mid-plan pages could not — the ISSUE 15 mesh-path residency
-    rule, replacing the old all-or-root restriction)."""
+    rule, replacing the old all-or-root restriction).
+
+    ``subsumable`` additionally selects every qualifying
+    single-predicate Filter-over-scan node (see family_key) as a
+    point, INSIDE already-selected subtrees too — those points are
+    what the overlapping-subsumption rewrite probes on an exact
+    miss."""
     points: Dict[int, tuple] = {}
 
     def consider(node) -> bool:
@@ -243,17 +484,35 @@ def select_cache_points(root: P.PhysicalNode, catalogs, *,
             keyed = subtree_key(node, catalogs)
             if keyed is not None:
                 key, tables = keyed
-                points[id(node)] = (key, node, tables)
+                snap = snapshot_tokens(tables, catalogs)
+                points[id(node)] = (key, node, tables, snap, None)
                 return True
         return False
-
-    if consider(root):
-        return points
 
     def descend(node):
         for c in node.children():
             if not consider(c):
                 descend(c)
 
-    descend(root)
+    if not consider(root):
+        descend(root)
+
+    if subsumable:
+        def families(node):
+            if id(node) not in points and \
+                    (allow is None or allow(node)):
+                fam = family_key(node, catalogs)
+                if fam is not None:
+                    fkey, desc, tables = fam
+                    keyed = subtree_key(node, catalogs)
+                    if keyed is not None:
+                        key, _ = keyed
+                        snap = snapshot_tokens(tables, catalogs)
+                        points[id(node)] = (key, node, tables, snap,
+                                            (fkey, desc))
+            for c in node.children():
+                families(c)
+
+        families(root)
+
     return points

@@ -47,11 +47,12 @@ _DISK_BUDGET_FACTOR = 4
 
 class _Entry:
     __slots__ = ("key", "kind", "nbytes", "tables", "created",
-                 "store", "payload", "watermark")
+                 "store", "payload", "watermark", "snap", "family")
 
     def __init__(self, key: str, kind: str, nbytes: int,
                  tables: FrozenSet[Tuple[str, str]], created: float,
-                 store=None, payload=None, watermark=None):
+                 store=None, payload=None, watermark=None,
+                 snap=None, family=None):
         self.key = key
         self.kind = kind          # "pages" | "rows"
         self.nbytes = nbytes
@@ -65,6 +66,13 @@ class _Entry:
         # PINNED-prefix readers and IVM view results, which a stream
         # append extends rather than invalidates
         self.watermark = watermark
+        # (catalog, table, version) snapshot tokens the key embeds —
+        # carried explicitly (ISSUE 19) so the persistent manifest can
+        # re-validate the entry against LIVE connectors at warm load
+        self.snap = snap
+        # (family_key, filter_descriptor) for subsumable Filter
+        # fragments (cache/rules.family_key), else None
+        self.family = family
 
     @property
     def on_disk(self) -> bool:
@@ -96,7 +104,9 @@ class ResultCache:
     # lock discipline (tools/lint `locks` rule): everything the
     # concurrent per-query runners mutate through one shared instance
     _shared_attrs = ("_entries", "budget_bytes", "ttl_ms", "spill_dir",
-                     "hits", "misses", "evictions", "invalidations")
+                     "hits", "misses", "evictions", "invalidations",
+                     "warm_loads", "remote_hits", "subsumed_hits",
+                     "manifest_drops", "_families", "_persister")
 
     def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES,
                  ttl_ms: int = 0, spill_dir: Optional[str] = None):
@@ -110,15 +120,41 @@ class ResultCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0
+        # fleet tallies (ISSUE 19)
+        self.warm_loads = 0
+        self.remote_hits = 0
+        self.subsumed_hits = 0
+        self.manifest_drops = 0
+        # family_key -> {entry_key: filter_descriptor} for the
+        # subsumption probe (cache/rules.descriptor_contains)
+        self._families: Dict[str, Dict[str, dict]] = {}
+        # cache/persist.CachePersister when a session configured
+        # result_cache_persist_dir; None keeps PR-10 behavior exactly
+        self._persister = None
         register_owner(self)
 
     # ------------------------------------------------------ configure
     def configure(self, budget_bytes: Optional[int] = None,
                   ttl_ms: Optional[int] = None,
-                  spill_dir: Optional[str] = None) -> None:
+                  spill_dir: Optional[str] = None,
+                  persist_dir: Optional[str] = None) -> None:
         """Re-apply session-level governance (last writer wins — the
         store is process-shared, so the newest session's budget/TTL
-        governs; shrinking the budget evicts immediately)."""
+        governs; shrinking the budget evicts immediately).
+        ``persist_dir``: None = no change, "" = detach persistence, a
+        path = (re)bind a CachePersister on that directory."""
+        persister = None
+        if persist_dir:
+            cur = self._persister
+            if cur is not None and cur.directory == persist_dir:
+                persister = cur
+            else:
+                # construct OUTSIDE the lock: the persister reads the
+                # manifest file at init (concheck: no file I/O under
+                # a registered lock)
+                from presto_tpu.cache.persist import CachePersister
+
+                persister = CachePersister(persist_dir)
         with self._lock:
             if budget_bytes is not None and int(budget_bytes) > 0:
                 self.budget_bytes = int(budget_bytes)
@@ -126,6 +162,8 @@ class ResultCache:
                 self.ttl_ms = int(ttl_ms)
             if spill_dir is not None:
                 self.spill_dir = spill_dir or None
+            if persist_dir is not None:
+                self._persister = persister
             self._maintain_locked()
 
     # ----------------------------------------------------- inspection
@@ -136,6 +174,10 @@ class ResultCache:
             "result_cache_misses": self.misses,
             "result_cache_evictions": self.evictions,
             "result_cache_invalidations": self.invalidations,
+            "cache_warm_loads": self.warm_loads,
+            "cache_remote_hits": self.remote_hits,
+            "cache_subsumed_hits": self.subsumed_hits,
+            "cache_manifest_drops": self.manifest_drops,
         }
 
     @property
@@ -168,19 +210,28 @@ class ResultCache:
             return list(e.store.host_pages())
 
     def put_pages(self, key: str, pages, tables,
-                  watermark: Optional[int] = None) -> int:
+                  watermark: Optional[int] = None,
+                  snap=None, family=None, persist: bool = True) -> int:
         """Publish one fragment's completed page stream. ``pages`` may
         be device or host pytrees (PageStore.put stages host-side
         either way — callers publish AFTER the attempt completes, so
         the D2H read happens off the deferred-sync hot path).
-        ``watermark`` marks a pinned-prefix stream entry (see _Entry).
-        Returns the number of entries evicted to admit it."""
+        ``watermark`` marks a pinned-prefix stream entry (see _Entry);
+        ``snap`` carries the key's snapshot tokens for the persistent
+        manifest; ``family`` is the (family_key, descriptor) pair for
+        subsumable Filter fragments; ``persist=False`` is the warm-load
+        re-admission path (the entry is ALREADY on disk). Returns the
+        number of entries evicted to admit it."""
         from presto_tpu.exec.pagestore import PageStore
 
         store = PageStore(tier="host")
         for p in pages:
             store.put(p)
+        # host-materialize BEFORE the lock: the persister serializes
+        # these same pytrees off-lock after publication
+        host_pages = list(store.host_pages())
         with self._lock:
+            persister = self._persister
             if store.bytes > self.budget_bytes:
                 store.close()  # oversized: never admitted (see above)
                 return 0
@@ -188,8 +239,77 @@ class ResultCache:
             self._entries[key] = _Entry(
                 key, "pages", store.bytes, frozenset(tables),
                 time.monotonic(), store=store, watermark=watermark,
+                snap=snap, family=family,
             )
-            return self._maintain_locked()
+            if family is not None:
+                self._families.setdefault(
+                    family[0], {})[key] = family[1]
+            evicted = self._maintain_locked()
+        if persist and persister is not None and snap is not None:
+            persister.persist(key, host_pages, tables, snap,
+                              watermark, family)
+        return evicted
+
+    def peek_pages(self, key: str) -> bool:
+        """Tally-free presence probe for a fragment key — the remote
+        cache probe (dist/cacheprobe.py) and fragment-level admission
+        discounts ask "would this hit?" without distorting the
+        hit/miss tallies or LRU order (same contract as peek_rows)."""
+        with self._lock:
+            e = self._expire_locked(key)
+            return e is not None and e.kind == "pages"
+
+    def pages_keys(self) -> List[str]:
+        """Every live fragment key (tally-free) — feeds the worker's
+        bloom-style cache summary shipped on /v1/info heartbeats."""
+        with self._lock:
+            return [k for k, e in self._entries.items()
+                    if e.kind == "pages"]
+
+    def probe_family(self, family_key: str, wanted) -> Optional[
+            Tuple[str, dict]]:
+        """Subsumption probe: the first cached sibling in ``family_key``
+        whose filter descriptor CONTAINS ``wanted`` (cache/rules.
+        descriptor_contains — pure dict comparison, fine under the
+        lock). Returns (entry_key, cached_descriptor) or None."""
+        from presto_tpu.cache.rules import descriptor_contains
+
+        with self._lock:
+            sibs = self._families.get(family_key)
+            if not sibs:
+                return None
+            for ekey, desc in sibs.items():
+                if ekey in self._entries and \
+                        descriptor_contains(desc, wanted):
+                    return (ekey, desc)
+            return None
+
+    # ------------------------------------------------- fleet tallies
+    def count_remote(self, n: int = 1) -> None:
+        with self._lock:
+            self.remote_hits += n
+
+    def count_subsumed(self, n: int = 1) -> None:
+        with self._lock:
+            self.subsumed_hits += n
+
+    def note_warm(self, loaded: int, drops: int) -> None:
+        with self._lock:
+            self.warm_loads += loaded
+            self.manifest_drops += drops
+
+    def warm_load(self, catalogs) -> Tuple[int, int]:
+        """One-shot warm-start pass (ISSUE 19): re-admit every still-
+        valid persisted entry against the LIVE connector snapshots.
+        The persister itself guards the once-per-instance semantics;
+        returns (loaded, dropped) and folds both into the tallies."""
+        persister = self._persister
+        if persister is None:
+            return (0, 0)
+        loaded, drops = persister.warm_load(self, catalogs)
+        if loaded or drops:
+            self.note_warm(loaded, drops)
+        return (loaded, drops)
 
     def peek_rows(self, key: str) -> bool:
         """Tally-free presence probe for a statement key — the
@@ -258,7 +378,10 @@ class ResultCache:
             for k in doomed:
                 self._drop_locked(k)
             self.invalidations += len(doomed)
-            return len(doomed)
+            persister = self._persister
+        if doomed and persister is not None:
+            persister.forget(doomed)  # file I/O outside the lock
+        return len(doomed)
 
     # --------------------------------------------------- invalidation
     def invalidate_tables(self, tables) -> int:
@@ -273,9 +396,15 @@ class ResultCache:
             for k in doomed:
                 self._drop_locked(k)
             self.invalidations += len(doomed)
-            return len(doomed)
+            persister = self._persister
+        if doomed and persister is not None:
+            persister.forget(doomed)  # file I/O outside the lock
+        return len(doomed)
 
     def clear(self) -> int:
+        """Drop every IN-MEMORY entry. Persisted files are deliberately
+        kept: clear models a process going away (its memory vanishes,
+        its manifest survives for the next boot's warm load)."""
         with self._lock:
             n = len(self._entries)
             for k in list(self._entries):
@@ -287,6 +416,12 @@ class ResultCache:
         e = self._entries.pop(key, None)
         if e is not None and e.store is not None:
             e.store.close()
+        if e is not None and e.family is not None:
+            sibs = self._families.get(e.family[0])
+            if sibs is not None:
+                sibs.pop(key, None)
+                if not sibs:
+                    self._families.pop(e.family[0], None)
 
     def _expire_locked(self, key: str) -> Optional[_Entry]:
         """TTL-aware lookup (caller holds the lock): an entry older

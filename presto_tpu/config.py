@@ -173,6 +173,9 @@ ETC_SESSION_KEYS: Dict[str, str] = {
     "result-cache.enabled": "result_cache_enabled",
     "result-cache.bytes": "result_cache_bytes",
     "result-cache.ttl-ms": "result_cache_ttl_ms",
+    "result-cache.persist-dir": "result_cache_persist_dir",
+    "result-cache.remote-probe": "result_cache_remote_probe",
+    "result-cache.subsumption": "result_cache_subsumption",
     "ivm.enabled": "ivm_enabled",
     "stream-tail.enabled": "stream_tail_enabled",
     "stream-poll.ms": "stream_poll_ms",

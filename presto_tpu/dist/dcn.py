@@ -191,6 +191,14 @@ class DcnRunner:
         self.heartbeat = HeartbeatFailureDetector(
             [f"{u}" for u in self.worker_uris]
         )
+        # fleet-cache index (ISSUE 19): per-worker bloom summaries of
+        # cached fragment keys, refreshed by every heartbeat ping —
+        # the scheduler's pre-dispatch probe consults it so the
+        # common cache miss never touches the wire
+        from presto_tpu.dist.cacheprobe import RemoteCacheIndex
+
+        self.cache_index = RemoteCacheIndex()
+        self.heartbeat.on_info = self.cache_index.update_from_info
         # background detector: dead-node connect timeouts are paid on
         # the daemon thread, never on the query path (the submit gate
         # reads CACHED state; reference: NodeScheduler consulting an
@@ -248,6 +256,53 @@ class DcnRunner:
             timeout=30,
         ) as resp:
             return json.loads(resp.read().decode())
+
+    def _probe_cached_task(self, partial, split_table: str,
+                           index: int, count: int, task_id: str,
+                           pool) -> Optional[str]:
+        """Fleet cache probe for the classic dispatch path (ISSUE
+        19): ask bloom-positive pool members to serve this split
+        share's fragment from their result cache. Returns the uri
+        that parked the pages as pre-finished task ``task_id`` (the
+        ordinary spool-fetch plane reads them), or None — every
+        failure here is advisory and reads as a miss. Round-robin
+        splits only: the hash split mode wraps connectors differently
+        on the worker, so its keys are not what this mirror computes."""
+        from presto_tpu.dist.cacheprobe import fragment_cache_key
+
+        ex = self.runner.executor
+        try:
+            key = fragment_cache_key(
+                partial, self.runner.catalogs,
+                split_table=split_table, split_index=index,
+                split_count=count, collect_k=ex.collect_k,
+                page_rows=ex.page_rows,
+            )
+        except Exception:  # noqa: BLE001 - advisory probe
+            return None
+        if key is None:
+            return None
+        idx = self.cache_index
+        for uri in pool:
+            if uri in self._excluded or \
+                    not idx.might_contain(uri, key):
+                continue
+            try:
+                with CONNPOOL.request(
+                    f"{uri}/v1/cache/task",
+                    method="POST",
+                    data=json.dumps(
+                        {"taskId": task_id, "key": key}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    timeout=5,
+                ) as r:
+                    out = json.loads(r.read().decode())
+            except (urllib.error.URLError, ConnectionError,
+                    OSError, ValueError):
+                continue  # bloom false positive / slow peer: dispatch
+            if out.get("hit"):
+                return uri
+        return None
 
     @staticmethod
     def _raise_if_task_error(e: BaseException, uri: str,
@@ -687,6 +742,17 @@ class DcnRunner:
         tasks: List[_TaskState] = []
         key = f"dcn-{qid}"
         check_payloads = ex._plan_check_on()
+        # fleet cache probe (ISSUE 19), classic-path edition: gated
+        # so the common miss is free (bloom summaries answer
+        # "definitely not cached" locally). Round-robin splits only —
+        # the hash split mode's worker-side wrap computes other keys.
+        sess = self.runner.session
+        probe_on = (
+            partition_cols is None and split_table is not None
+            and self.cache_index.known()
+            and bool(sess.get("result_cache_enabled"))
+            and bool(sess.get("result_cache_remote_probe"))
+        )
         try:
             for w, uri in enumerate(pool):
                 payload = {
@@ -714,17 +780,38 @@ class DcnRunner:
                 st = _TaskState(uri=uri, task_id=payload["taskId"],
                                 payload=payload)
                 d0 = trace.now() if trace is not None else 0.0
-                try:
-                    self._post_task(uri, payload)
-                except (urllib.error.URLError, OSError) as e:
-                    if retry_attempts <= 0:
-                        raise DcnQueryFailed(
-                            f"worker {uri}: task submit failed: {e}"
-                        ) from e
-                    # submit retry: re-dispatch this split share to a
-                    # different ALIVE worker (it runs two tasks)
-                    self._recover_task(st, pool, retry_attempts,
-                                       deadline, e)
+                hit_uri = (self._probe_cached_task(
+                    partial, split_table, w, len(pool),
+                    payload["taskId"], pool) if probe_on else None)
+                if hit_uri is not None:
+                    # some fleet member already holds this split
+                    # share's pages — no dispatch; the supplier
+                    # fetches the parked pre-finished task over the
+                    # ordinary pooled plane (and a mid-fetch loss
+                    # still recovers: the payload carries the full
+                    # fragment for re-dispatch on a survivor)
+                    st.uri = hit_uri
+                    ex.cache_remote_hits += 1
+                    if trace is not None:
+                        now = trace.now()
+                        trace.complete("cache",
+                                       f"remote-hit:{st.task_id}",
+                                       now, now, uri=hit_uri)
+                        ex.trace_spans += 1
+                else:
+                    try:
+                        self._post_task(uri, payload)
+                    except (urllib.error.URLError, OSError) as e:
+                        if retry_attempts <= 0:
+                            raise DcnQueryFailed(
+                                f"worker {uri}: task submit failed: "
+                                f"{e}"
+                            ) from e
+                        # submit retry: re-dispatch this split share
+                        # to a different ALIVE worker (it runs two
+                        # tasks)
+                        self._recover_task(st, pool, retry_attempts,
+                                           deadline, e)
                 if trace is not None:
                     st.trace_t0 = d0
                     trace.complete("dispatch", st.task_id, d0,

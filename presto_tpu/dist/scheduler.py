@@ -143,6 +143,10 @@ class StageScheduler:
         self._mesh_fids: set = set()
         self._mesh_placement: Dict[int, _Placement] = {}
         self._mesh_stats: Dict[int, object] = {}
+        # fleet cache probe (ISSUE 19): deserialized fragment roots
+        # for coordinator-side key computation, one loads() per
+        # fragment instead of per task
+        self._probe_roots: Dict[int, object] = {}
 
     # ------------------------------------------------------ plumbing
     def _retry_attempts(self) -> int:
@@ -558,6 +562,89 @@ class StageScheduler:
                 self._delete(pl)
 
     # ------------------------------------------------------- stages
+    def _probe_key(self, t: _SchedTask, frag) -> Optional[str]:
+        """The fragment-cache key THIS task's execution would compute
+        on a worker (dist/cacheprobe.fragment_cache_key mirrors the
+        worker's split wrap + salt), or None when the fragment is not
+        root-cacheable. Advisory: any failure here reads as a miss."""
+        root = self._probe_roots.get(t.fid)
+        if root is None:
+            try:
+                root = plan_serde.loads(self._frag_blob[t.fid])
+            except Exception:  # noqa: BLE001 - advisory probe
+                return None
+            self._probe_roots[t.fid] = root
+        from presto_tpu.dist.cacheprobe import fragment_cache_key
+
+        try:
+            return fragment_cache_key(
+                root, self.coord.runner.catalogs,
+                split_table=frag.split_table, split_index=t.index,
+                split_count=self._ntasks[t.fid],
+                collect_k=self.ex.collect_k,
+                page_rows=self.ex.page_rows,
+            )
+        except Exception:  # noqa: BLE001 - advisory probe
+            return None
+
+    def _probe_cache(self, t: _SchedTask, pool) -> bool:
+        """Pre-dispatch fleet cache probe (ISSUE 19): True iff some
+        fleet member served this leaf task's fragment from its result
+        cache (the task is then already placed + done). Gated so the
+        common miss is FREE: bloom summaries refreshed on heartbeats
+        answer "definitely not cached" without a round trip; only a
+        "maybe" costs one pooled POST. Leaf split fragments with
+        single-partition output only — a repartition producer's P-way
+        spool and the mesh plane's raw-page contract are not what the
+        cache holds."""
+        coord = self.coord
+        idx = getattr(coord, "cache_index", None)
+        if idx is None or not idx.known():
+            return False
+        sess = coord.runner.session
+        if not (bool(sess.get("result_cache_enabled"))
+                and bool(sess.get("result_cache_remote_probe"))):
+            return False
+        frag = self.dag.fragment(t.fid)
+        if frag.inputs or frag.split_table is None \
+                or t.fid in self._mesh_fids \
+                or frag.output_kind == "repartition":
+            return False
+        key = self._probe_key(t, frag)
+        if key is None:
+            return False
+        for uri in pool:
+            if uri in coord._excluded or \
+                    not idx.might_contain(uri, key):
+                continue
+            try:
+                with CONNPOOL.request(
+                    f"{uri}/v1/cache/task",
+                    method="POST",
+                    data=json.dumps(
+                        {"taskId": t.base_id, "key": key}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    timeout=5,
+                ) as r:
+                    out = json.loads(r.read().decode())
+            except (urllib.error.URLError, ConnectionError,
+                    OSError, ValueError):
+                continue  # bloom false positive / slow peer: dispatch
+            if out.get("hit"):
+                t.placement = _Placement(uri, t.base_id)
+                t.dispatched_at = time.monotonic()
+                t.done = True
+                t.counted = True
+                self.ex.cache_remote_hits += 1
+                tr = self.trace
+                if tr is not None:
+                    now = tr.now()
+                    tr.complete("cache", f"remote-hit:{t.base_id}",
+                                now, now, uri=uri, key=key)
+                    self.ex.trace_spans += 1
+                return True
+        return False
+
     def _run_stage(self, fid: int) -> None:
         # pool recomputed per stage: an excluded node whose heartbeat
         # recovered rejoins HERE, mid-query (re-admission probes are
@@ -579,6 +666,12 @@ class StageScheduler:
                              tasks=len(stage), pool=len(pool))
             self.ex.trace_spans += 1
         for t in stage:
+            if self._probe_cache(t, pool):
+                # fleet cache hit (ISSUE 19): some worker already
+                # holds this split fragment's pages — the task is
+                # DONE without dispatch; consumers/gather read the
+                # parked spool over the ordinary fetch plane
+                continue
             if pool[t.index % len(pool)] in self.coord._excluded:
                 # an earlier submit in THIS wave excluded a node:
                 # refresh the pool so the remaining tasks neither

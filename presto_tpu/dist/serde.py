@@ -138,6 +138,16 @@ class PageWireError(ValueError):
     never as rows."""
 
 
+def wire_fingerprint() -> str:
+    """Identity of the wire serde FORMAT (magic + version) — the
+    persistent result-cache manifest records it so a cache directory
+    written by one serde version is dropped loudly, not misdecoded,
+    by another (cache/persist.py). Mode is deliberately excluded:
+    every mode decodes every mode's frames (the codec byte rides in
+    each frame), only the encode choice differs."""
+    return (_MAGIC + _VERSION).decode("ascii")
+
+
 def set_wire_mode(mode: str) -> str:
     """Select the wire codec mode ("full" | "zlib" | "raw"); returns
     the previous mode. Test/bench surface for A/B wire-bytes grading

@@ -153,6 +153,27 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "invalidation hook after DML/CTAS to their scanned tables "
         "(staleness itself is structural: snapshot_version rides in "
         "every key)"),
+    "cache_warm_loads": (
+        "counter", "persisted result-cache entries re-admitted at the "
+        "warm-start pass (cache/persist.py manifest load): snapshot "
+        "tokens re-validated against live connectors, pages decoded "
+        "from the wire-serde payload files"),
+    "cache_manifest_drops": (
+        "counter", "persisted result-cache entries dropped LOUDLY at "
+        "warm load: snapshot token moved, payload file missing or "
+        "corrupt, manifest truncated, or wire-serde fingerprint "
+        "mismatch — never served, never a crash"),
+    "cache_remote_hits": (
+        "counter", "leaf tasks short-circuited by a FLEET member's "
+        "fragment cache: the coordinator's pre-dispatch probe "
+        "(dist/cacheprobe.py) found the fragment's pages on a worker "
+        "and replayed them over the pooled spool-fetch plane instead "
+        "of executing the task"),
+    "cache_subsumed_hits": (
+        "counter", "fragments served by CONTAINMENT rewrite "
+        "(cache/rules.py): a cached sibling with a wider single-"
+        "column range/IN filter replayed through this fragment's own "
+        "predicate as a residual re-filter"),
     "h2d_bytes": (
         "gauge", "bytes staged host->device through the exec/xfer.py "
         "choke points this query (0 on a cache replay served from "

@@ -560,10 +560,22 @@ class Executor:
         self.result_cache_misses = 0
         self.result_cache_evictions = 0
         self.result_cache_invalidations = 0
+        # fleet-cache tallies (ISSUE 19), lifetime-cumulative like the
+        # four above: warm-start manifest loads/drops (runner boot
+        # pass), coordinator-probed remote hits (dist/scheduler.py),
+        # and containment-rewrite hits (cache/rules.py subsumption)
+        self.cache_warm_loads = 0
+        self.cache_manifest_drops = 0
+        self.cache_remote_hits = 0
+        self.cache_subsumed_hits = 0
+        # serve contained filters from wider cached siblings (session
+        # result_cache_subsumption; runner.apply_session resolves)
+        self.cache_subsumption = False
         # per-query cache-point state: id(subtree) -> (key, node,
-        # tables) — node refs held so ids stay stable; inflight guards
-        # the miss path's re-entrant pages() call; pending holds
-        # completed-but-unpublished streams until the attempt succeeds
+        # tables, watermark, snap, family) — node refs held so ids
+        # stay stable; inflight guards the miss path's re-entrant
+        # pages() call; pending holds completed-but-unpublished
+        # streams until the attempt succeeds
         self._cache_points: Dict[int, tuple] = {}
         self._cache_inflight: set = set()
         self._cache_pending: List = []
@@ -1289,6 +1301,17 @@ class Executor:
         if walked is None:
             return None
         cur, chain = walked
+        # a chain member that is a live result-cache point must stay
+        # an observable pages() boundary (fusing through it would
+        # bypass _cached_pages entirely — no hit, no population);
+        # an INFLIGHT point is its own miss-path collection, where
+        # fusion is exactly what we want
+        if self._cache_points:
+            for link in chain + [cur]:
+                n = link[0] if isinstance(link, tuple) else link
+                if id(n) in self._cache_points and \
+                        id(n) not in self._cache_inflight:
+                    return None
         if not chain and agg_tail is None:
             return None  # a bare scan already runs as one program
         conn = self.catalogs[cur.catalog]
@@ -2187,12 +2210,27 @@ class Executor:
         salt = f"k{self.collect_k}.p{self.page_rows}"
         self._cache_points = {
             i: (f"{key}:{salt}", n, tables,
-                stream_watermark(tables, self.catalogs))
-            for i, (key, n, tables) in select_cache_points(
+                stream_watermark(tables, self.catalogs),
+                snap,
+                # family keys carry the same executor salt as entry
+                # keys: siblings under different collect_k/page_rows
+                # must never answer each other
+                (f"{fam[0]}:{salt}", fam[1])
+                if fam is not None else None)
+            for i, (key, n, tables, snap, fam) in select_cache_points(
                 node, self.catalogs,
                 allow=self._cache_subtree_ok,
+                subsumable=self.cache_subsumption,
             ).items()
         }
+
+    def count_warm_load(self, loaded: int, drops: int) -> None:
+        """Fold one warm-start pass's outcome onto this executor's
+        counter surface (runner.apply_session drives the pass; the
+        counters live here so EXPLAIN ANALYZE / /metrics render them
+        through the one registry snapshot)."""
+        self.cache_warm_loads += loaded
+        self.cache_manifest_drops += drops
 
     def _cache_subtree_ok(self, node: P.PhysicalNode) -> bool:
         """Whether a subtree's page stream may become a cache point.
@@ -2211,7 +2249,7 @@ class Executor:
         An abandoned stream (downstream Limit stopped consuming) never
         reaches the staging append, so partial page sets cannot be
         published."""
-        key, _node_ref, tables, watermark = entry
+        key, _node_ref, tables, watermark, snap, family = entry
         tr = self.trace
         t0 = tr.now() if tr is not None else 0.0
         host_pages = self.result_cache.get_pages(key)
@@ -2250,6 +2288,46 @@ class Executor:
                             pages=len(host_pages), key=key)
                 self.trace_spans += 1
             return
+        if family is not None:
+            # subsumption rewrite (ISSUE 19): a cached SIBLING whose
+            # filter descriptor CONTAINS this one answers by replaying
+            # its (wider) pages through this node's own predicate — a
+            # residual re-filter over cached pages instead of a rescan
+            sib = self.result_cache.probe_family(family[0], family[1])
+            wider = (self.result_cache.get_pages(sib[0])
+                     if sib is not None else None)
+            if wider is not None:
+                self.result_cache_hits += 1
+                self.cache_subsumed_hits += 1
+                self.result_cache.count_subsumed()
+                if tr is not None:
+                    tr.complete("cache", f"subsume:{label}", t0,
+                                tr.now(), key=key, wider=sib[0])
+                    self.trace_spans += 1
+                # stitch the wider pages UNDER this Filter via the
+                # RemoteSource supplier path (the same ingest the
+                # exchange plane replays through), then run the node's
+                # own predicate over them — the residual filter
+                skey = f"subsume:{id(node)}"
+                rs = P.RemoteSource(
+                    types=tuple(self.output_types(node.source)),
+                    key=skey, origin=node.source,
+                )
+                synthetic = dataclasses.replace(node, source=rs)
+                self.remote_sources[skey] = (
+                    lambda pages=wider: iter(pages))
+                collected: List = []
+                try:
+                    for page in self.pages(synthetic):
+                        collected.append(page)
+                        yield page
+                finally:
+                    self.remote_sources.pop(skey, None)
+                # the narrow result publishes under its EXACT key, so
+                # the next identical query hits without the rewrite
+                self._cache_pending.append(
+                    (key, collected, tables, watermark, snap, family))
+                return
         self.result_cache_misses += 1
         if tr is not None:
             tr.complete("cache", f"miss:{label}", t0, tr.now(),
@@ -2257,13 +2335,14 @@ class Executor:
             self.trace_spans += 1
         self._cache_inflight.add(id(node))
         try:
-            collected: List = []
+            collected = []
             for page in self.pages(node):
                 collected.append(page)
                 yield page
         finally:
             self._cache_inflight.discard(id(node))
-        self._cache_pending.append((key, collected, tables, watermark))
+        self._cache_pending.append(
+            (key, collected, tables, watermark, snap, family))
 
     def _stage_replay(self, page: Page) -> Page:
         """Re-stage one replayed host page for a DEVICE consumer —
@@ -2281,9 +2360,10 @@ class Executor:
         cache = self.result_cache
         if cache is None:
             return
-        for key, pages, tables, watermark in pending:
+        for key, pages, tables, watermark, snap, family in pending:
             self.result_cache_evictions += cache.put_pages(
-                key, pages, tables, watermark=watermark
+                key, pages, tables, watermark=watermark,
+                snap=snap, family=family,
             )
 
     def _overflow_flagged(self) -> bool:

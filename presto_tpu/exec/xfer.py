@@ -209,6 +209,13 @@ TRANSFER_REGISTRY: Dict[str, Tuple[str, str, str]] = {
         "d2h", "data",
         "fragment output leaves the device exactly once, at the "
         "serialization boundary (spooled and legacy emit paths)"),
+    "server.worker.TaskRuntime.serve_cached_fragment": (
+        "d2h", "data",
+        "fleet cache serve (ISSUE 19): row-count readback of the "
+        "replayed pages' validity masks while parking them as a "
+        "pre-finished task spool — cached pages are host-resident, "
+        "so np_host meters ZERO bytes unless a demoted entry "
+        "rehydrated device-side"),
     # ---- distributed executor (mesh staging)
     "dist.executor.DistExecutor._scan_sharded": (
         "h2d", "data",

@@ -64,6 +64,16 @@ LOCK_REGISTRY: Dict[str, str] = {
         "accounting, LRU order, tallies",
     "cache.store._shared_lock":
         "creation of THE per-process shared ResultCache instance",
+    "cache.persist.CachePersister._lock":
+        "the persistent result-cache manifest's in-memory entry map "
+        "and publish sequence number — manifest/payload file I/O "
+        "runs OUTSIDE it on a seq-loop (snapshot under lock, write "
+        "tmp + atomic rename outside, re-check sequence)",
+    "dist.cacheprobe.RemoteCacheIndex._lock":
+        "per-worker bloom summaries of cached fragment keys: "
+        "heartbeat threads write (update_from_info), scheduler "
+        "dispatch threads read (might_contain) — pure bytes ops, "
+        "probes themselves go over connpool OUTSIDE the lock",
     "connectors.stream.StreamConnector._cv":
         "the append-log table map + offset advance; appends "
         "notify_all so tailing long-pollers (wait_for_offset) wake",

@@ -328,10 +328,21 @@ class LocalRunner:
                     self.session.get("result_cache_bytes")),
                 ttl_ms=int(self.session.get("result_cache_ttl_ms")),
                 spill_dir=self.session.get("spill_path") or None,
+                persist_dir=self.session.get(
+                    "result_cache_persist_dir"),
             )
+            # warm-start pass (ISSUE 19): once per persister binding,
+            # re-admit persisted entries whose snapshot tokens still
+            # match the live connectors; the persister's own guard
+            # makes repeat sessions free
+            if self.session.get("result_cache_persist_dir"):
+                loaded, drops = rc.warm_load(self.catalogs)
+                ex.count_warm_load(loaded, drops)
             ex.result_cache = rc
         else:
             ex.result_cache = None
+        ex.cache_subsumption = bool(
+            self.session.get("result_cache_subsumption"))
 
     def prewarm(self, sql: str) -> Dict:
         """Compile a query's program set ahead of timing: plan + execute
@@ -368,8 +379,41 @@ class LocalRunner:
         ex = self.executor
         total = 0
 
+        # fragment-level cache-aware admission (ISSUE 19): a subtree
+        # whose fragment cache entry is RESIDENT replays host pages —
+        # it materializes no join build / agg state / sort buffer, so
+        # the arbiter should not reserve HBM for it. Advisory like
+        # statement_cache_probe: peek_pages takes no tally and the
+        # execute path re-probes, so a racing eviction just runs (and
+        # sizes) the query for real under the executor's own budget.
+        hit_roots = set()
+        if bool(self.session.get("result_cache_enabled")):
+            from presto_tpu.cache import shared_cache_if_exists
+
+            rc = shared_cache_if_exists()
+            if rc is not None:
+                try:
+                    from presto_tpu.cache.rules import \
+                        select_cache_points
+
+                    salt = f"k{ex.collect_k}.p{ex.page_rows}"
+                    for key, node, _t, _s, _f in \
+                            select_cache_points(
+                                plan, self.catalogs).values():
+                        if rc.peek_pages(f"{key}:{salt}"):
+                            hit_roots.add(id(node))
+                except Exception:  # noqa: BLE001 - advisory discount
+                    pass
+
         def walk(n):
             nonlocal total
+            if id(n) in hit_roots:
+                # replayed fragment: one streamed page of its output
+                # is the peak footprint, same charge as a scan
+                total += min(
+                    ex.estimate_rows(n), self.executor.page_rows
+                ) * _row_bytes(ex.output_types(n))
+                return
             if isinstance(n, P.HashJoin):
                 total += ex.estimate_rows(n.right) * _row_bytes(
                     ex.output_types(n.right)

@@ -73,6 +73,11 @@ class HeartbeatFailureDetector:
         }
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # optional (uri, info_dict) callback fired on every successful
+        # ping OUTSIDE self._lock — the fleet-cache index rides the
+        # heartbeat plane this way (dist/cacheprobe.RemoteCacheIndex.
+        # update_from_info) without a detector->index lock ordering
+        self.on_info = None
         self._lock = make_lock(
             "server.heartbeat.HeartbeatFailureDetector._lock")
         register_owner(self)
@@ -118,7 +123,14 @@ class HeartbeatFailureDetector:
         stats — direct probes (e.g. the DCN re-admission path) stay
         visible in /v1/node snapshots instead of bypassing the
         bookkeeping."""
-        ok, err = self._ping(uri)
+        ok, err, info = self._ping(uri)
+        if ok and self.on_info is not None:
+            # outside the lock by design (see __init__); a listener
+            # failure must not poison the health bookkeeping
+            try:
+                self.on_info(uri, info)
+            except Exception:  # noqa: BLE001 - advisory plane
+                pass
         with self._lock:
             n = self.nodes.get(uri)
             if n is None:
@@ -138,13 +150,24 @@ class HeartbeatFailureDetector:
         return ok
 
     def _ping(self, uri: str):
+        """(ok, error, info_dict) — the body parse is best-effort:
+        health detection needs only the status code, the parsed body
+        feeds the optional on_info listener (cacheSummary etc.)."""
         try:
             with urllib.request.urlopen(
                 uri.rstrip("/") + "/v1/info", timeout=self.timeout_s
             ) as resp:
-                return resp.status == 200, ""
+                body = resp.read()
+                info = None
+                try:
+                    import json
+
+                    info = json.loads(body)
+                except (ValueError, UnicodeDecodeError):
+                    info = None
+                return resp.status == 200, "", info
         except (urllib.error.URLError, OSError, ValueError) as e:
-            return False, str(e)[:200]
+            return False, str(e)[:200], None
 
     def _loop(self) -> None:  # pragma: no cover - timing loop
         while not self._stop.wait(self.interval_s):

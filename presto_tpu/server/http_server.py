@@ -465,6 +465,10 @@ def _result_cache_totals():
             "result_cache_misses": 0,
             "result_cache_evictions": 0,
             "result_cache_invalidations": 0,
+            "cache_warm_loads": 0,
+            "cache_remote_hits": 0,
+            "cache_subsumed_hits": 0,
+            "cache_manifest_drops": 0,
         }
     return rc.counters()
 

@@ -572,6 +572,15 @@ def route_task_post(app, path: str, body: bytes):
             k: int(v) for k, v in json.loads(body or b"{}").items()
         })
         return _jresp({"ok": True, "fault": app.fault_config})
+    if path.startswith("/v1/cache/task"):
+        # fleet cache probe (ISSUE 19, dist/cacheprobe.py): serve one
+        # fragment key from THIS process's result cache by parking
+        # the cached host pages in a pre-finished task spool — the
+        # consumer then fetches them over the ordinary pooled
+        # spool-fetch plane, indistinguishable from an executed task
+        req = json.loads(body)
+        return _jresp(app.serve_cached_fragment(
+            str(req.get("taskId") or ""), str(req.get("key") or "")))
     if not path.startswith("/v1/task"):
         return None
     if app.maybe_inject_submit_fault():
@@ -815,6 +824,20 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 # polls each worker's violation count at the end of a
                 # run (the worker process has no other reporting plane)
                 info["sanitizerViolations"] = SAN.violation_count()
+            # fleet-cache advertisement (ISSUE 19): a bloom summary of
+            # this process's cached fragment keys rides every
+            # heartbeat poll, so the coordinator's RemoteCacheIndex
+            # stays fresh without a dedicated plane; absent when the
+            # store doesn't exist or holds nothing (probe-free)
+            from presto_tpu.cache import shared_cache_if_exists
+
+            rc = shared_cache_if_exists()
+            if rc is not None:
+                keys = rc.pages_keys()
+                if keys:
+                    from presto_tpu.dist.cacheprobe import bloom_summary
+
+                    info["cacheSummary"] = bloom_summary(keys)
             self._write(_jresp(info))
             return
         resp = route_task_get(self.app, split.path, split.query)
@@ -897,6 +920,32 @@ class TaskRuntime:
             t.done = True
         with self._tasks_lock:
             self.tasks[task_id] = t
+
+    def serve_cached_fragment(self, task_id: str, key: str) -> Dict:
+        """Fleet cache probe target (ISSUE 19): if this process's
+        result cache holds ``key``, park its host pages in a
+        pre-finished single-partition task spool (the
+        register_finished_task landing surface) and report the hit —
+        the prober then reads the pages over the ordinary pooled
+        spool-fetch plane. A miss is one cheap dict probe."""
+        from presto_tpu.cache import shared_cache_if_exists
+
+        rc = shared_cache_if_exists()
+        if rc is None or not task_id or not key:
+            return {"hit": False}
+        pages = rc.get_pages(key)
+        if pages is None:
+            return {"hit": False}
+        spool = _TaskSpool(1, 0)
+        for page in pages:
+            spool.put_page(
+                0, page,
+                rows=int(XF.np_host(
+                    page.valid, label="cache-remote-serve").sum()))
+        self.register_finished_task(task_id, spool)
+        rc.count_remote()
+        return {"hit": True, "taskId": task_id,
+                "pages": len(pages)}
 
     def task_count(self) -> int:
         with self._tasks_lock:

@@ -428,6 +428,45 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0,
         ),
         PropertyMetadata(
+            "result_cache_persist_dir",
+            "directory for the persistent warm-start tier of the "
+            "result cache (cache/persist.py): completed fragment "
+            "entries publish a wire-serde payload file plus a row in "
+            "an atomically-renamed versioned manifest (entry key, "
+            "snapshot tokens, stream watermark, serde fingerprint), "
+            "and the first enabled session after a process boot "
+            "warm-loads every entry whose snapshot tokens still "
+            "match the live connectors (cache_warm_loads counter); "
+            "stale/corrupt/mismatched entries drop loudly "
+            "(cache_manifest_drops). Empty = memory-only (the PR-10 "
+            "behavior)",
+            str, "",
+        ),
+        PropertyMetadata(
+            "result_cache_remote_probe",
+            "let the DCN coordinator probe fleet members' fragment "
+            "caches before dispatching a leaf task "
+            "(dist/cacheprobe.py): any worker's cached fragment "
+            "short-circuits the task (cache_remote_hits) and its "
+            "pages replay over the existing pooled spool-fetch "
+            "plane; probes are gated by bloom-style summaries "
+            "refreshed with heartbeats, so the common miss costs "
+            "nothing on the wire",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "result_cache_subsumption",
+            "serve a fragment whose single-column range/IN filter is "
+            "CONTAINED by an already-cached sibling (same scan + "
+            "projection chain) by re-filtering the cached pages "
+            "(cache/rules.py descriptor containment): WHERE d < 5 "
+            "replays the cached WHERE d < 10 pages through a "
+            "residual filter instead of rescanning "
+            "(cache_subsumed_hits); anything beyond single-column "
+            "range/IN stays exact-match",
+            bool, False,
+        ),
+        PropertyMetadata(
             "ivm_enabled",
             "maintain registered materialized views incrementally "
             "(streaming/ivm.py): a refresh folds ONLY the pages "

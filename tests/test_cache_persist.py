@@ -1,0 +1,371 @@
+"""ISSUE 19: fleet-wide result reuse — the persistence + subsumption
+halves of the tentpole (the DCN probe half lives in
+tests/test_fleet_cache.py).
+
+Covers the acceptance contracts:
+  - warm-start pin: cacheable deck -> process "restart" (shared store
+    torn down, fresh LocalRunner) -> rerun completes with
+    cache_warm_loads >= 1, result_cache_hits >= 1 and
+    program_launches == 0 on the hit path; rows identical to the cold
+    run AND to the sqlite oracle;
+  - DML between runs forces a miss with fresh rows (warm-loaded entry
+    invalidated by the write like any live entry);
+  - out-of-band snapshot bump + restart: warm load PROVES the token
+    moved, drops the entry loudly (cache_manifest_drops), recomputes;
+  - manifest corruption trio: truncated manifest / missing entry file
+    / serde-fingerprint mismatch each load ZERO entries, count drops,
+    and never crash or serve stale rows;
+  - stream watermarks (ISSUE 14) survive the persist round trip;
+  - overlapping-predicate subsumption: a cached WHERE d < 10 fragment
+    answers WHERE d < 5 via residual re-filter (cache_subsumed_hits,
+    oracle-identical rows); non-contained predicates miss.
+"""
+
+import collections
+import json
+import os
+
+import pytest
+
+from presto_tpu.cache import ResultCache, shared_cache_if_exists
+from presto_tpu.cache import store as cache_store
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runner import LocalRunner
+
+SF = 0.01
+PAGE_ROWS = 1 << 13
+
+AGG_Q = ("select l_returnflag, l_linestatus, count(*) c, "
+         "sum(l_quantity) q from lineitem "
+         "group by l_returnflag, l_linestatus "
+         "order by l_returnflag, l_linestatus")
+
+
+def _rows_equal(a, b):
+    return collections.Counter(map(repr, a)) == collections.Counter(
+        map(repr, b))
+
+
+@pytest.fixture(autouse=True)
+def _clean_shared_cache():
+    """Persistence tests simulate process restarts by tearing the
+    process-shared store down; leave no store (and no persister bound
+    to a deleted tmp dir) behind for other tests."""
+    rc = shared_cache_if_exists()
+    if rc is not None:
+        rc.configure(persist_dir="")
+        rc.clear()
+    cache_store._shared = None
+    yield
+    rc = shared_cache_if_exists()
+    if rc is not None:
+        rc.configure(persist_dir="")
+        rc.clear()
+    cache_store._shared = None
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(SF)
+
+
+def _persist_runner(conn, persist_dir, **extra):
+    r = LocalRunner({"tpch": conn}, page_rows=PAGE_ROWS)
+    r.session.set("result_cache_enabled", True)
+    r.session.set("result_cache_persist_dir", str(persist_dir))
+    for k, v in extra.items():
+        r.session.set(k, v)
+    return r
+
+
+def _restart():
+    """Simulate process death: the shared store (and its in-memory
+    entries) vanish; the manifest + payload files on disk survive."""
+    cache_store._shared = None
+
+
+# ----------------------------------------------------- warm-start pin
+def test_warm_start_pin(tmp_path, conn):
+    """THE restart acceptance contract, plus oracle parity on the
+    warm-served rows."""
+    d = tmp_path / "rc"
+    r1 = _persist_runner(conn, d)
+    cold = r1.execute(AGG_Q).rows
+    assert r1.executor.result_cache_misses >= 1
+    assert os.path.exists(d / "manifest.json")
+
+    _restart()
+    r2 = _persist_runner(conn, d)
+    warm = r2.execute(AGG_Q).rows
+    ex = r2.executor
+    assert ex.cache_warm_loads >= 1, "manifest entries must re-admit"
+    assert ex.result_cache_hits >= 1
+    assert ex.program_launches == 0, (
+        "a warm-start hit must not launch fused-scan programs")
+    assert warm == cold
+
+    from tests.oracle import load_sqlite
+
+    db = load_sqlite(conn, ["lineitem"])
+    want = db.execute(
+        "select l_returnflag, l_linestatus, count(*), "
+        "sum(l_quantity) from lineitem "
+        "group by l_returnflag, l_linestatus "
+        "order by l_returnflag, l_linestatus").fetchall()
+    assert [tuple(x) for x in want] == [tuple(x) for x in warm]
+
+
+def test_warm_load_runs_once_per_persister(tmp_path, conn):
+    r1 = _persist_runner(conn, tmp_path / "rc")
+    r1.execute(AGG_Q)
+    _restart()
+    r2 = _persist_runner(conn, tmp_path / "rc")
+    r2.execute(AGG_Q)
+    loads0 = r2.executor.cache_warm_loads
+    r2.execute(AGG_Q)  # same persister: no second load pass
+    assert r2.executor.cache_warm_loads == loads0
+    rc = shared_cache_if_exists()
+    assert rc.warm_loads == loads0
+
+
+# -------------------------------------------------- DML interactions
+def test_dml_between_runs_forces_miss(tmp_path):
+    mem = MemoryConnector()
+    r1 = LocalRunner({"mem": mem}, default_catalog="mem")
+    r1.session.set("result_cache_enabled", True)
+    r1.session.set("result_cache_persist_dir", str(tmp_path / "rc"))
+    r1.execute("create table t as select 1 x, 10 y")
+    q = "select count(*) c, sum(y) s from t"
+    assert r1.execute(q).rows == [(1, 10)]
+
+    _restart()
+    r2 = LocalRunner({"mem": mem}, default_catalog="mem")
+    r2.session.set("result_cache_enabled", True)
+    r2.session.set("result_cache_persist_dir", str(tmp_path / "rc"))
+    # the INSERT's apply_session warm-loads the persisted entry, then
+    # the write invalidates it — exactly a live entry's lifecycle
+    r2.execute("insert into t select 2, 20")
+    hits0 = r2.executor.result_cache_hits
+    assert r2.execute(q).rows == [(2, 30)], "fresh rows, never stale"
+    assert r2.executor.result_cache_hits == hits0
+
+
+def test_out_of_band_snapshot_bump_drops_on_warm_load(tmp_path):
+    """The snapshot token moved while no cache-enabled session was
+    watching (no invalidation hook ran): warm load must PROVE the
+    mismatch against the live connector and drop loudly."""
+    mem = MemoryConnector()
+    r1 = LocalRunner({"mem": mem}, default_catalog="mem")
+    r1.session.set("result_cache_enabled", True)
+    r1.session.set("result_cache_persist_dir", str(tmp_path / "rc"))
+    r1.execute("create table t as select 1 x, 10 y")
+    q = "select count(*) c, sum(y) s from t"
+    assert r1.execute(q).rows == [(1, 10)]
+
+    _restart()
+    # cache-blind writer (result cache off): snapshot bumps, manifest
+    # does not hear about it
+    blind = LocalRunner({"mem": mem}, default_catalog="mem")
+    blind.execute("insert into t select 2, 20")
+
+    r2 = LocalRunner({"mem": mem}, default_catalog="mem")
+    r2.session.set("result_cache_enabled", True)
+    r2.session.set("result_cache_persist_dir", str(tmp_path / "rc"))
+    assert r2.execute(q).rows == [(2, 30)]
+    assert r2.executor.cache_manifest_drops >= 1
+    assert r2.executor.result_cache_hits == 0
+
+
+# ---------------------------------------------- manifest corruption
+def _seed_persisted(tmp_path, conn):
+    d = tmp_path / "rc"
+    r = _persist_runner(conn, d)
+    cold = r.execute(AGG_Q).rows
+    assert os.path.exists(d / "manifest.json")
+    _restart()
+    return d, cold
+
+
+def test_truncated_manifest_loads_zero_loudly(tmp_path, conn):
+    d, cold = _seed_persisted(tmp_path, conn)
+    blob = (d / "manifest.json").read_bytes()
+    (d / "manifest.json").write_bytes(blob[:len(blob) // 2])
+    r = _persist_runner(conn, d)
+    rows = r.execute(AGG_Q).rows
+    assert rows == cold                      # recomputed, not crashed
+    assert r.executor.cache_warm_loads == 0
+    assert r.executor.cache_manifest_drops >= 1
+
+
+def test_missing_entry_file_drops_that_entry(tmp_path, conn):
+    d, cold = _seed_persisted(tmp_path, conn)
+    doc = json.loads((d / "manifest.json").read_text())
+    assert doc["entries"], "seed must have persisted entries"
+    for meta in doc["entries"].values():
+        os.unlink(d / meta["file"])
+    r = _persist_runner(conn, d)
+    rows = r.execute(AGG_Q).rows
+    assert rows == cold
+    assert r.executor.cache_warm_loads == 0
+    assert r.executor.cache_manifest_drops >= len(doc["entries"])
+    # the dead rows were pruned, then the recompute re-published its
+    # fragment: every manifest row's payload file exists again
+    doc2 = json.loads((d / "manifest.json").read_text())
+    for meta in doc2["entries"].values():
+        assert os.path.exists(d / meta["file"])
+
+
+def test_serde_fingerprint_mismatch_drops_all(tmp_path, conn):
+    d, cold = _seed_persisted(tmp_path, conn)
+    doc = json.loads((d / "manifest.json").read_text())
+    n = len(doc["entries"])
+    assert n >= 1
+    doc["serde"] = "XXX0"
+    (d / "manifest.json").write_text(json.dumps(doc))
+    r = _persist_runner(conn, d)
+    rows = r.execute(AGG_Q).rows
+    assert rows == cold
+    assert r.executor.cache_warm_loads == 0
+    assert r.executor.cache_manifest_drops >= n
+
+
+def test_manifest_version_skew_drops_loudly(tmp_path, conn):
+    d, cold = _seed_persisted(tmp_path, conn)
+    doc = json.loads((d / "manifest.json").read_text())
+    doc["version"] = 99
+    (d / "manifest.json").write_text(json.dumps(doc))
+    r = _persist_runner(conn, d)
+    assert r.execute(AGG_Q).rows == cold
+    assert r.executor.cache_warm_loads == 0
+    assert r.executor.cache_manifest_drops >= 1
+
+
+# ------------------------------------------------ watermark roundtrip
+def test_stream_watermark_survives_roundtrip(tmp_path, conn):
+    """ISSUE 14 watermarks ride the manifest: a pinned-prefix entry
+    re-admits with its append-log offset intact."""
+    from presto_tpu.cache.rules import snapshot_of
+
+    d = str(tmp_path / "rc")
+    rc1 = ResultCache()
+    rc1.configure(persist_dir=d)
+    r = LocalRunner({"tpch": conn}, page_rows=PAGE_ROWS)
+    plan = r.plan("select l_returnflag from lineitem "
+                  "where l_quantity < 1")
+    pages = [pg for pg in r.executor.pages(plan)]
+    snap = (("tpch", "lineitem",
+             snapshot_of(conn, "lineitem")),)
+    rc1.put_pages("frag:wmtest:k1.p1", [p for p in pages],
+                  frozenset({("tpch", "lineitem")}), watermark=4096,
+                  snap=snap)
+    assert rc1.entry_count == 1
+
+    rc2 = ResultCache()
+    rc2.configure(persist_dir=d)
+    loaded, drops = rc2.warm_load({"tpch": conn})
+    assert (loaded, drops) == (1, 0)
+    with rc2._lock:
+        e = rc2._entries["frag:wmtest:k1.p1"]
+        assert e.watermark == 4096
+        assert e.snap == snap
+
+
+# --------------------------------------------------- subsumption pin
+NARROW_Q = ("select l_orderkey, l_quantity from lineitem "
+            "where l_quantity < 5 order by l_orderkey, l_quantity")
+WIDE_Q = ("select l_orderkey, l_quantity from lineitem "
+          "where l_quantity < 10 order by l_orderkey, l_quantity")
+DISJOINT_Q = ("select l_orderkey, l_quantity from lineitem "
+              "where l_quantity < 20 order by l_orderkey, "
+              "l_quantity")
+
+
+@pytest.fixture()
+def sub_runner(conn):
+    r = LocalRunner({"tpch": conn}, page_rows=PAGE_ROWS)
+    r.session.set("result_cache_enabled", True)
+    r.session.set("result_cache_subsumption", True)
+    return r
+
+
+def test_subsumption_pin(sub_runner, conn):
+    """THE subsumption acceptance contract: WHERE d < 10 cached, then
+    WHERE d < 5 serves from it via residual re-filter — >=1
+    cache_subsumed_hits, rows identical to the sqlite oracle."""
+    r = sub_runner
+    wide = r.execute(WIDE_Q).rows
+    assert r.executor.cache_subsumed_hits == 0
+    narrow = r.execute(NARROW_Q).rows
+    ex = r.executor
+    assert ex.cache_subsumed_hits >= 1
+    assert ex.result_cache_hits >= 1
+
+    from tests.oracle import load_sqlite
+
+    db = load_sqlite(conn, ["lineitem"])
+    # l_quantity is decimal(12,2): unscaled ints on the oracle side
+    want = db.execute(
+        "select l_orderkey, l_quantity from lineitem "
+        "where l_quantity < 500 "
+        "order by l_orderkey, l_quantity").fetchall()
+    assert [tuple(x) for x in want] == [tuple(x) for x in narrow]
+    want_wide = db.execute(
+        "select l_orderkey, l_quantity from lineitem "
+        "where l_quantity < 1000 "
+        "order by l_orderkey, l_quantity").fetchall()
+    assert [tuple(x) for x in want_wide] == [tuple(x) for x in wide]
+
+
+def test_subsumption_noncontained_misses(sub_runner):
+    """d < 20 is NOT contained in the cached d < 10 — it must compute
+    (no subsumed hit, correct rows)."""
+    r = sub_runner
+    r.execute(WIDE_Q)
+    sub0 = r.executor.cache_subsumed_hits
+    got = r.execute(DISJOINT_Q).rows
+    assert r.executor.cache_subsumed_hits == sub0
+    fresh = LocalRunner({"tpch": r.catalogs["tpch"]},
+                        page_rows=PAGE_ROWS)
+    assert _rows_equal(got, fresh.execute(DISJOINT_Q).rows)
+
+
+def test_subsumed_result_republishes_exact_key(sub_runner):
+    """The narrow answer is published under its exact key: a repeat
+    of the narrow query is an ordinary exact hit, not a second
+    subsumption replay."""
+    r = sub_runner
+    r.execute(WIDE_Q)
+    r.execute(NARROW_Q)
+    sub0 = r.executor.cache_subsumed_hits
+    hits0 = r.executor.result_cache_hits
+    rows = r.execute(NARROW_Q).rows
+    assert r.executor.cache_subsumed_hits == sub0
+    assert r.executor.result_cache_hits > hits0
+    assert rows == r.execute(NARROW_Q).rows
+
+
+# ------------------------------------------ cache-aware admission
+def test_estimate_memory_discounts_cached_fragments(conn):
+    """ISSUE 19 admission satellite: the membudget arbiter sizes a
+    query by estimate_memory — a plan whose fragments are RESIDENT in
+    the cache replays host pages and must not reserve join-build/sort
+    HBM. Advisory: clearing the cache restores the full estimate."""
+    r = LocalRunner({"tpch": conn}, page_rows=PAGE_ROWS)
+    r.session.set("result_cache_enabled", True)
+    q = ("select * from orders join lineitem "
+         "on o_orderkey = l_orderkey order by o_totalprice")
+    cold = r.estimate_memory(q)
+    r.execute(q)
+    warm = r.estimate_memory(q)
+    assert warm < cold, (cold, warm)
+    shared_cache_if_exists().clear()
+    assert r.estimate_memory(q) == cold
+
+
+def test_subsumption_off_by_default(conn):
+    r = LocalRunner({"tpch": conn}, page_rows=PAGE_ROWS)
+    r.session.set("result_cache_enabled", True)
+    r.execute(WIDE_Q)
+    r.execute(NARROW_Q)
+    assert r.executor.cache_subsumed_hits == 0

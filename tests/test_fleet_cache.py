@@ -1,0 +1,232 @@
+"""ISSUE 19: DCN-shared fragment cache hits (the fleet half of the
+tentpole; persistence + subsumption live in tests/test_cache_persist.py).
+
+Covers:
+  - the coordinator-side key mirror (dist/cacheprobe.fragment_cache_key)
+    computes EXACTLY the keys worker-side executions store;
+  - bloom summaries: the common miss is free (no round trip without a
+    positive bloom), absent summaries fail closed;
+  - probe end-to-end over BOTH dispatch planes (classic cuts and the
+    stage-DAG scheduler): second run serves every leaf task from the
+    fleet cache with cache_remote_hits >= 1 and identical rows;
+  - the cross-process acceptance pin (subprocess workers, disjoint
+    caches): a fragment computed on worker A serves a later query
+    whose dispatch would have sent that split share to worker B.
+"""
+
+import collections
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from presto_tpu.cache import shared_cache_if_exists
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.dist.cacheprobe import (
+    RemoteCacheIndex,
+    bloom_summary,
+    fragment_cache_key,
+)
+from presto_tpu.dist.dcn import DcnRunner
+from presto_tpu.runner import LocalRunner
+from presto_tpu.server.worker import WorkerServer
+
+SF = 0.01
+PAGE_ROWS = 1 << 13
+
+AGG_Q = ("select l_returnflag, count(*) c, sum(l_quantity) q "
+         "from lineitem group by l_returnflag")
+
+
+def rows_equal(a, b):
+    return collections.Counter(map(repr, a)) == collections.Counter(
+        map(repr, b))
+
+
+@pytest.fixture(autouse=True)
+def _clean_shared_cache():
+    rc = shared_cache_if_exists()
+    if rc is not None:
+        rc.configure(persist_dir="")
+        rc.clear()
+    yield
+    rc = shared_cache_if_exists()
+    if rc is not None:
+        rc.configure(persist_dir="")
+        rc.clear()
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(SF)
+
+
+# ------------------------------------------------------- bloom index
+def test_bloom_index_contract():
+    idx = RemoteCacheIndex()
+    keys = [f"frag:abc:{i}:k1.p1" for i in range(8)]
+    idx.update("http://a", bloom_summary(keys))
+    for k in keys:
+        assert idx.might_contain("http://a", k)
+    # no summary for an unknown peer: FAIL CLOSED (no probe traffic)
+    assert not idx.might_contain("http://b", keys[0])
+    assert idx.known()
+    # garbage summaries un-register the peer rather than crash
+    idx.update("http://a", "not base64!!")
+    assert not idx.might_contain("http://a", keys[0])
+
+
+def test_bloom_negative_is_free():
+    idx = RemoteCacheIndex()
+    idx.update("http://a", bloom_summary(["frag:only:1:k1.p1"]))
+    miss = sum(
+        idx.might_contain("http://a", f"frag:other:{i}:k1.p1")
+        for i in range(64)
+    )
+    # 1024 bits / 4 hashes over one inserted key: essentially every
+    # foreign key answers "definitely not" locally
+    assert miss <= 2
+
+
+# -------------------------------------------------- key mirror + e2e
+def _fleet(session_props):
+    w1 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w1",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    w2 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w2",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    uris = [f"http://127.0.0.1:{w1.start()}",
+            f"http://127.0.0.1:{w2.start()}"]
+    coord = DcnRunner({"tpch": TpchConnector(SF)}, uris,
+                      default_catalog="tpch", page_rows=PAGE_ROWS,
+                      session_props=session_props)
+    return coord, (w1, w2)
+
+
+def test_probe_key_mirror_matches_worker_keys(conn):
+    """fragment_cache_key (coordinator side, no dispatch) computes
+    the exact keys the workers' executions stored."""
+    coord, ws = _fleet({"result_cache_enabled": "true"})
+    try:
+        coord.execute(AGG_Q)
+        rc = shared_cache_if_exists()
+        stored = set(rc.pages_keys())
+        assert stored, "worker executions must have cached fragments"
+        from presto_tpu.dist.fragmenter import fragment_dag
+
+        dag = fragment_dag(coord.runner.executor,
+                           coord.runner.plan(AGG_Q),
+                           coord.runner.catalogs)
+        mirrored = set()
+        for frag in dag.fragments:
+            if frag.split_table and not frag.inputs:
+                for i in range(2):
+                    k = fragment_cache_key(
+                        frag.root, coord.runner.catalogs,
+                        split_table=frag.split_table,
+                        split_index=i, split_count=2,
+                        collect_k=coord.runner.executor.collect_k,
+                        page_rows=coord.runner.executor.page_rows)
+                    assert k is not None
+                    mirrored.add(k)
+        assert mirrored == stored
+    finally:
+        coord.close()
+        for w in ws:
+            w.stop()
+
+
+@pytest.mark.parametrize("props", [
+    {"result_cache_enabled": "true"},                       # classic
+    {"result_cache_enabled": "true",
+     "stage_scheduler": "true"},                            # DAG
+])
+def test_fleet_hit_short_circuits_dispatch(props):
+    coord, ws = _fleet(props)
+    try:
+        r1 = coord.execute(AGG_Q)
+        assert coord.runner.executor.cache_remote_hits == 0
+        coord.heartbeat.check_once()      # pull cacheSummary blooms
+        r2 = coord.execute(AGG_Q)
+        assert coord.runner.executor.cache_remote_hits >= 1
+        assert rows_equal(r1, r2)
+        rc = shared_cache_if_exists()
+        assert rc.remote_hits >= 1        # workers counted the serve
+    finally:
+        coord.close()
+        for w in ws:
+            w.stop()
+
+
+def test_probe_disabled_by_session_prop(conn):
+    coord, ws = _fleet({"result_cache_enabled": "true",
+                        "result_cache_remote_probe": "false"})
+    try:
+        r1 = coord.execute(AGG_Q)
+        coord.heartbeat.check_once()
+        r2 = coord.execute(AGG_Q)
+        assert coord.runner.executor.cache_remote_hits == 0
+        assert rows_equal(r1, r2)
+    finally:
+        coord.close()
+        for w in ws:
+            w.stop()
+
+
+# ------------------------------------------- cross-process pin (slow)
+def _boot_subprocess_worker():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in ("FAULT_DELAY_MS", "FAULT_DROP_EVERY",
+              "FAULT_KILL_AFTER_FETCHES", "FAULT_SUBMIT_DROP_EVERY"):
+        env.pop(k, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.server.worker",
+         "--port", "0", "--suite", "tpch", "--scale", str(SF),
+         "--page-rows", str(PAGE_ROWS)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        text=True,
+    )
+    info = json.loads(proc.stdout.readline())
+    return proc, f"http://127.0.0.1:{info['port']}"
+
+
+@pytest.mark.slow
+def test_cross_worker_fleet_pin():
+    """THE fleet acceptance contract with REAL disjoint caches: after
+    [A, B] computes the deck, a coordinator whose dispatch order is
+    [B, A] still serves every split share — split 0's pages live only
+    on A while B would have recomputed them, so the serve is
+    cross-worker by construction (blooms route the probe to the
+    holder)."""
+    pa, ua = _boot_subprocess_worker()
+    pb, ub = _boot_subprocess_worker()
+    c1 = c2 = None
+    try:
+        c1 = DcnRunner({"tpch": TpchConnector(SF)}, [ua, ub],
+                       default_catalog="tpch", page_rows=PAGE_ROWS,
+                       session_props={"result_cache_enabled": "true"})
+        want = c1.execute(AGG_Q)
+        assert c1.runner.executor.cache_remote_hits == 0
+
+        c2 = DcnRunner({"tpch": TpchConnector(SF)}, [ub, ua],
+                       default_catalog="tpch", page_rows=PAGE_ROWS,
+                       session_props={"result_cache_enabled": "true"})
+        c2.heartbeat.check_once()
+        got = c2.execute(AGG_Q)
+        assert c2.runner.executor.cache_remote_hits >= 1
+        assert rows_equal(want, got)
+
+        oracle = LocalRunner({"tpch": TpchConnector(SF)},
+                             page_rows=PAGE_ROWS)
+        assert rows_equal(got, oracle.execute(AGG_Q).rows)
+    finally:
+        for c in (c1, c2):
+            if c is not None:
+                c.close()
+        for p in (pa, pb):
+            p.terminate()
+            p.wait(timeout=10)

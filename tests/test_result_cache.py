@@ -367,13 +367,13 @@ def test_fragment_key_salted_by_session_config(runner):
     ex.result_cache = ResultCache()
     plan = runner.plan(AGG_Q)
     ex._select_cache_points(plan)
-    keys1 = {k for k, _n, _t, _w in ex._cache_points.values()}
+    keys1 = {e[0] for e in ex._cache_points.values()}
     ex.collect_k = ex.collect_k * 2
     ex._select_cache_points(plan)
-    keys2 = {k for k, _n, _t, _w in ex._cache_points.values()}
+    keys2 = {e[0] for e in ex._cache_points.values()}
     ex.page_rows = ex.page_rows * 2
     ex._select_cache_points(plan)
-    keys3 = {k for k, _n, _t, _w in ex._cache_points.values()}
+    keys3 = {e[0] for e in ex._cache_points.values()}
     ex._cache_points = {}
     assert keys1 and keys1.isdisjoint(keys2)
     assert keys2.isdisjoint(keys3)
