@@ -1,36 +1,46 @@
-"""Persistent warm-start tier for the result cache (ISSUE 19).
+"""Persistent warm-start tier for the result cache (ISSUE 19) and the
+shared generation-numbered manifest layer (ISSUE 20).
 
 Reference: the compile-cache story applied to RESULTS — presto's
 materialized-artifact reuse survives process restarts because the
 artifact carries enough identity to prove it still matches its
 inputs. The result cache's disk tier already holds serializable host
 pytrees; this module adds the missing identity layer: a versioned
-JSON manifest (entry key, snapshot tokens, stream watermark, byte
-size, wire-serde fingerprint) published atomically next to one
-payload file per entry (the spool wire format: dist/serde frames
-under dist/spool length-prefix framing — the SAME bytes the exchange
-plane ships, so there is exactly one page serialization in the
-engine).
+manifest (entry key, snapshot tokens, stream watermark, byte size,
+wire-serde fingerprint) published next to one payload file per entry
+(the spool wire format: dist/serde frames under dist/spool
+length-prefix framing — the SAME bytes the exchange plane ships, so
+there is exactly one page serialization in the engine).
 
-Warm load runs once per process when a session configures
-``result_cache_persist_dir`` (the ``shared_cache()`` boot pass):
-every manifest entry whose snapshot tokens still match the live
-connectors is re-admitted through the ordinary ``put_pages`` path
-(budget, LRU, demotion all apply); everything else drops LOUDLY —
-counted on ``cache_manifest_drops``, logged with the reason, and a
-PROVEN-stale payload (the connector answered with a different token)
-is deleted from disk so the next boot does not re-litigate it. A
-truncated manifest, a missing payload file, or a serde-fingerprint
-mismatch each load zero entries and count drops; none of them can
-crash the boot or serve stale rows (validation happens before any
-byte is decoded into the store).
+Manifest format (ISSUE 20 — ROADMAP item 3(ii)): a long-lived persist
+dir used to rewrite the WHOLE manifest JSON on every publish, an
+O(entries) wall per publish that grows forever. ``ManifestStore`` now
+keeps generation-numbered JSON-lines files:
 
-Concurrency: ``CachePersister._lock`` guards only the in-memory
-manifest map and its sequence number. ALL file I/O happens outside
-the lock on a seq-loop: snapshot the manifest under the lock, write
-tmp + atomic rename outside it, then re-check the sequence — a racing
-publish simply triggers one more rewrite (concheck: no blocking I/O
-under a registered lock).
+    <stem>.g000001.jsonl
+        {"version": V, "gen": 1, ...extra header fields}
+        {"k": "<key>", "v": {...meta...}}     one line per publish
+        {"k": "<key>", "v": null}             one line per removal
+
+A publish APPENDS one line (O(1)); when a generation accumulates more
+than ``compact_threshold`` record lines the store compacts: the live
+entry map is written as the next generation (tmp + atomic rename) and
+older generations are unlinked. Recovery is loud at every seam: a
+torn trailing line (append interrupted by a crash) drops that line
+and keeps the prefix; an unreadable newest generation (partial
+compaction on a corrupt disk) falls back to the previous generation;
+a version or fingerprint skew drops every record — all counted and
+logged, none able to crash the boot or serve stale state. The SAME
+implementation backs the result-cache warm tier here and the
+coordinator checkpoint journal (dist/checkpoint.py), so both planes
+ride one tested manifest lifecycle.
+
+Concurrency: the store lock guards only the in-memory entry map and
+the pending-append queue. ALL file I/O happens outside the lock on a
+drain loop: take the pending batch under the lock (marking the writer
+busy), append/compact outside it, re-check for records that arrived
+while writing — a racing publish simply extends the drain (concheck:
+no blocking I/O under a registered lock).
 """
 
 from __future__ import annotations
@@ -39,16 +49,21 @@ import hashlib
 import json
 import logging
 import os
+import re
 import struct
 import tempfile
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from presto_tpu.obs.sanitizer import make_lock, register_owner
 
 log = logging.getLogger("presto_tpu.cache")
 
-MANIFEST_VERSION = 1
-_MANIFEST = "manifest.json"
+MANIFEST_VERSION = 2
+# record lines per generation before the store compacts into a fresh
+# generation snapshot (size governance for long-lived persist dirs)
+COMPACT_THRESHOLD = 256
+
+_GEN_RE = re.compile(r"\.g(\d{6,})\.jsonl$")
 
 
 def _entry_file(key: str) -> str:
@@ -74,106 +89,364 @@ def _unpack_frames(blob: bytes) -> List[bytes]:
     return out
 
 
+def manifest_files(directory: str, stem: str = "manifest"):
+    """(gen, path) pairs for every generation file of ``stem`` in
+    ``directory``, newest first. Shared with tests/tools so nothing
+    re-derives the file-name convention."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        if not name.startswith(stem + ".g"):
+            continue
+        m = _GEN_RE.search(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def read_manifest_doc(directory: str,
+                      stem: str = "manifest") -> Optional[Dict]:
+    """Parse the newest generation file into the classic whole-doc
+    shape ({header fields..., "entries": {...}}) for tests and
+    tooling. Returns None when no generation file exists; raises
+    ValueError on a file this engine cannot parse at all."""
+    files = manifest_files(directory, stem)
+    if not files:
+        return None
+    gen, path = files[0]
+    with open(path, "rb") as f:
+        lines = f.read().decode("utf-8").splitlines()
+    if not lines:
+        raise ValueError(f"empty manifest generation file {path}")
+    doc = json.loads(lines[0])
+    entries: Dict[str, Dict] = {}
+    for line in lines[1:]:
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        if rec.get("v") is None:
+            entries.pop(rec["k"], None)
+        else:
+            entries[rec["k"]] = rec["v"]
+    doc["entries"] = entries
+    doc["path"] = path
+    return doc
+
+
+def rewrite_manifest_doc(directory: str, doc: Dict,
+                         stem: str = "manifest") -> None:
+    """Write ``doc`` (the read_manifest_doc shape) back as the newest
+    generation's content — the corruption-injection hook the manifest
+    tests use to skew version/fingerprint headers in place."""
+    files = manifest_files(directory, stem)
+    if not files:
+        raise ValueError(f"no manifest generation under {directory}")
+    _, path = files[0]
+    header = {k: v for k, v in doc.items()
+              if k not in ("entries", "path")}
+    lines = [json.dumps(header)]
+    lines.extend(json.dumps({"k": k, "v": v})
+                 for k, v in doc.get("entries", {}).items())
+    with open(path, "wb") as f:
+        f.write(("\n".join(lines) + "\n").encode("utf-8"))
+
+
+class ManifestStore:
+    """One generation-numbered manifest: in-memory entry map + durable
+    JSON-lines journal with threshold compaction. Shared by the
+    result-cache warm tier (CachePersister) and the coordinator
+    checkpoint journal (dist/checkpoint.CheckpointJournal) — one
+    tested manifest lifecycle for both planes."""
+
+    # lock discipline (tools/lint `locks` rule): the entry map and the
+    # pending-append queue are mutated by concurrent publishers, the
+    # drain loop, and once-per-process claim flags
+    _shared_attrs = ("_entries", "_pending", "_io_busy", "_gen",
+                     "_gen_records", "_claimed")
+
+    def __init__(self, directory: str, *, stem: str = "manifest",
+                 version: int = MANIFEST_VERSION,
+                 header_extra: Optional[Dict] = None,
+                 header_check: Optional[Callable[[Dict],
+                                                 Optional[str]]] = None,
+                 compact_threshold: int = COMPACT_THRESHOLD):
+        self.directory = directory
+        self.stem = stem
+        self.version = version
+        self._header_extra = dict(header_extra or {})
+        self._header_check = header_check
+        self.compact_threshold = int(compact_threshold)
+        self._lock = make_lock("cache.persist.ManifestStore._lock")
+        self._entries: Dict[str, Dict] = {}
+        self._pending: List[Dict] = []
+        self._io_busy = False
+        self._gen = 0
+        self._gen_records = 0
+        self._claimed: set = set()
+        # load outcome, settled at construction (single-threaded: the
+        # instance is not shared until the constructor returns); the
+        # owning plane reports these as loud drops
+        self.broken_reasons: List[str] = []
+        self.broken_count = 0
+        os.makedirs(directory, exist_ok=True)
+        self._load()
+        register_owner(self)
+
+    # ------------------------------------------------------------ load
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.directory,
+                            f"{self.stem}.g{gen:06d}.jsonl")
+
+    def _parse_gen(self, path: str) -> Tuple[Dict[str, Dict], int, int]:
+        """(entries, record_lines, torn_tail_drops) for one generation
+        file. Raises ValueError/OSError when the file is unusable as a
+        whole (missing/corrupt header, version or fingerprint skew) —
+        the caller falls back to an older generation, loudly."""
+        with open(path, "rb") as f:
+            blob = f.read()
+        lines = blob.decode("utf-8", errors="replace").splitlines()
+        if not lines or not lines[0].strip():
+            raise ValueError("empty generation file")
+        header = json.loads(lines[0])
+        if int(header.get("version", -1)) != self.version:
+            raise ValueError(
+                f"manifest version {header.get('version')!r} "
+                f"(this engine writes {self.version})")
+        if self._header_check is not None:
+            why = self._header_check(header)
+            if why:
+                # undecodable records behind a skewed header: count
+                # every record line so the drop is sized honestly
+                nrec = sum(1 for ln in lines[1:] if ln.strip())
+                raise ValueError(f"{why}: {nrec} records dropped")
+        entries: Dict[str, Dict] = {}
+        nrec = 0
+        torn = 0
+        for ln in lines[1:]:
+            if not ln.strip():
+                continue
+            try:
+                rec = json.loads(ln)
+                key = rec["k"]
+            except (ValueError, KeyError, TypeError):
+                # torn trailing append (crash mid-write): keep the
+                # parsed prefix, drop the tail loudly
+                torn += 1
+                break
+            nrec += 1
+            if rec.get("v") is None:
+                entries.pop(key, None)
+            else:
+                entries[key] = rec["v"]
+        return entries, nrec, torn
+
+    def _load(self) -> None:
+        files = manifest_files(self.directory, self.stem)
+        for gen, path in files:
+            try:
+                entries, nrec, torn = self._parse_gen(path)
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                # unreadable generation (partial compaction / skew):
+                # record the loud drop and fall back one generation
+                self.broken_reasons.append(  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+                    f"{os.path.basename(path)}: {e}")
+                self.broken_count += self._drop_weight(e)  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+                continue
+            self._entries = entries  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+            self._gen = gen  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+            self._gen_records = nrec  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+            if torn:
+                self.broken_reasons.append(  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+                    f"{os.path.basename(path)}: torn trailing "
+                    f"record dropped")
+                self.broken_count += torn  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+                # the file ends mid-record with no newline: appending
+                # would concatenate onto the torn bytes and the new
+                # record would be lost on the NEXT load — force the
+                # first flush to compact into a fresh generation
+                # (atomic tmp+rename) instead of appending
+                self._gen_records = self.compact_threshold  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+            return
+        if files:
+            # every generation was unreadable: start a fresh one ABOVE
+            # the corpses so their stale content can never win a
+            # newest-generation race later
+            self._gen = files[0][0] + 1  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
+
+    @staticmethod
+    def _drop_weight(e: BaseException) -> int:
+        """Honest drop sizing: a skew message carrying 'N records
+        dropped' counts N; anything else counts 1."""
+        m = re.search(r"(\d+) records dropped", str(e))
+        return int(m.group(1)) if m else 1
+
+    # --------------------------------------------------------- publish
+    def publish(self, key: str, meta: Dict) -> None:
+        """Upsert one entry: O(1) append to the current generation
+        (compaction amortizes the rewrite)."""
+        with self._lock:
+            self._entries[key] = meta
+            self._pending.append({"k": key, "v": meta})
+        self._flush()
+
+    def remove(self, keys) -> Dict[str, Dict]:
+        """Drop entries; returns the removed key -> meta map so the
+        owner can delete payload files outside any lock."""
+        removed: Dict[str, Dict] = {}
+        with self._lock:
+            for k in keys:
+                meta = self._entries.pop(k, None)
+                if meta is not None:
+                    removed[k] = meta
+                    self._pending.append({"k": k, "v": None})
+        if removed:
+            self._flush()
+        return removed
+
+    def entries_snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            return dict(self._entries)
+
+    def claim_once(self, tag: str) -> bool:
+        """True exactly once per (store, tag) — the warm-load /
+        re-attach single-shot gate."""
+        with self._lock:
+            if tag in self._claimed:
+                return False
+            self._claimed.add(tag)
+            return True
+
+    def _header_line(self, gen: int) -> bytes:
+        doc = {"version": self.version, "gen": gen}
+        doc.update(self._header_extra)
+        return (json.dumps(doc) + "\n").encode("utf-8")
+
+    def _flush(self) -> None:
+        """Drain pending records to disk — append lines, or compact
+        into the next generation past the threshold. See module
+        docstring for the lock discipline."""
+        while True:
+            with self._lock:
+                if self._io_busy or not self._pending:
+                    return
+                batch = self._pending
+                self._pending = []
+                self._io_busy = True
+                self._gen_records += len(batch)
+                compact = self._gen_records >= self.compact_threshold
+                snapshot = dict(self._entries) if compact else None
+                gen = self._gen
+            try:
+                if compact:
+                    self._compact(gen, snapshot)
+                else:
+                    self._append(gen, batch)
+            finally:
+                with self._lock:
+                    self._io_busy = False
+
+    def _append(self, gen: int, batch: List[Dict]) -> None:
+        path = self._gen_path(gen)
+        payload = b"".join(
+            (json.dumps(rec) + "\n").encode("utf-8") for rec in batch)
+        try:
+            if not os.path.exists(path):
+                # first record of a fresh store: seed the header with
+                # the same tmp+rename publish compaction uses, then
+                # append — a crash between the two leaves a valid
+                # empty generation
+                fd, tmp = tempfile.mkstemp(
+                    prefix=self.stem + ".tmp", dir=self.directory)
+                with os.fdopen(fd, "wb") as f:
+                    f.write(self._header_line(gen))
+                os.replace(tmp, path)
+            # one O_APPEND write per drain: complete lines, so a
+            # concurrent reader (or a crash) sees a parseable prefix
+            # plus at most one torn tail the loader drops loudly
+            fd = os.open(path, os.O_WRONLY | os.O_APPEND)
+            try:
+                os.write(fd, payload)
+            finally:
+                os.close(fd)
+        except OSError as e:
+            log.warning("manifest append failed in %s: %r",
+                        self.directory, e)
+
+    def _compact(self, gen: int, snapshot: Dict[str, Dict]) -> None:
+        """Write the live map as generation gen+1 (tmp + atomic
+        rename), then unlink older generations. A crash anywhere
+        leaves either the old generation intact or both — the loader's
+        newest-readable-wins scan recovers either way."""
+        new_gen = gen + 1
+        path = self._gen_path(new_gen)
+        fd, tmp = tempfile.mkstemp(prefix=self.stem + ".tmp",
+                                   dir=self.directory)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(self._header_line(new_gen))
+                for k, v in snapshot.items():
+                    f.write(
+                        (json.dumps({"k": k, "v": v}) + "\n")
+                        .encode("utf-8"))
+            os.replace(tmp, path)
+        except OSError as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            log.warning("manifest compaction failed in %s: %r",
+                        self.directory, e)
+            return  # disk trouble: persistence is best-effort
+        with self._lock:
+            self._gen = new_gen
+            self._gen_records = len(snapshot)
+        for old_gen, old_path in manifest_files(self.directory,
+                                                self.stem):
+            if old_gen < new_gen:
+                try:
+                    os.unlink(old_path)
+                except OSError:
+                    pass
+
+
+def _serde_header_check(header: Dict) -> Optional[str]:
+    from presto_tpu.dist.serde import wire_fingerprint
+
+    if header.get("serde") != wire_fingerprint():
+        return (f"serde fingerprint {header.get('serde')!r} != "
+                f"{wire_fingerprint()!r}")
+    return None
+
+
 class CachePersister:
     """Manifest + payload-file lifecycle for one persist directory.
     One instance per configured directory, owned by the ResultCache
     (store.configure re-binds on a directory change, the same
-    last-writer-wins governance every other store knob follows)."""
+    last-writer-wins governance every other store knob follows).
+    All shared state lives in the ManifestStore; this class only
+    composes payload-file I/O around it."""
 
-    # lock discipline (tools/lint `locks` rule): the manifest map and
-    # its publish sequence are mutated by concurrent per-query
-    # publishers and the warm-load pass
-    _shared_attrs = ("_entries", "_seq", "_loaded", "_written_seq")
+    def __init__(self, directory: str,
+                 compact_threshold: int = COMPACT_THRESHOLD):
+        from presto_tpu.dist.serde import wire_fingerprint
 
-    def __init__(self, directory: str):
         self.directory = directory
-        self._lock = make_lock("cache.persist.CachePersister._lock")
-        self._entries: Dict[str, Dict] = {}
-        self._seq = 0
-        self._written_seq = 0
-        self._loaded = False
-        # manifest parse outcome, settled at construction (single-
-        # threaded: the instance is not shared until configure
-        # returns); warm_load reports it as a loud drop
-        self._broken: Optional[str] = None
-        os.makedirs(directory, exist_ok=True)
-        self._read_manifest()
-        register_owner(self)
-
-    # ------------------------------------------------------- manifest
-    def _read_manifest(self) -> None:
-        from presto_tpu.dist.serde import wire_fingerprint
-
-        path = os.path.join(self.directory, _MANIFEST)
-        if not os.path.exists(path):
-            return
-        try:
-            with open(path, "rb") as f:
-                doc = json.loads(f.read().decode("utf-8"))
-            if int(doc.get("version", -1)) != MANIFEST_VERSION:
-                raise ValueError(
-                    f"manifest version {doc.get('version')!r} "
-                    f"(this engine writes {MANIFEST_VERSION})")
-            entries = doc["entries"]
-            if not isinstance(entries, dict):
-                raise ValueError("manifest entries not a map")
-            if doc.get("serde") != wire_fingerprint():
-                # every payload predates this serde format: the
-                # entries are undecodable here, so the in-memory
-                # manifest starts empty (files stay on disk for a
-                # rolled-back engine; a re-publish of the same key
-                # overwrites its payload file in place)
-                self._broken = (
-                    f"serde fingerprint {doc.get('serde')!r} != "
-                    f"{wire_fingerprint()!r}: {len(entries)} "
-                    f"entries dropped")
-                self._broken_count = len(entries)
-                return
-            self._entries = dict(entries)  # lint: unlocked-ok - __init__-only path: the instance is not shared until the constructor returns
-        except (OSError, ValueError, KeyError, TypeError) as e:
-            self._broken = f"unreadable manifest: {e}"
-            self._broken_count = 1
-
-    _broken_count = 0
-
-    def _write_manifest(self) -> None:
-        """Atomic manifest publish on a seq-loop — see module
-        docstring for the lock discipline."""
-        from presto_tpu.dist.serde import wire_fingerprint
-
-        path = os.path.join(self.directory, _MANIFEST)
-        while True:
-            with self._lock:
-                if self._written_seq == self._seq:
-                    return
-                seq = self._seq
-                doc = {
-                    "version": MANIFEST_VERSION,
-                    "serde": wire_fingerprint(),
-                    "entries": dict(self._entries),
-                }
-            blob = json.dumps(doc).encode("utf-8")
-            fd, tmp = tempfile.mkstemp(
-                prefix=_MANIFEST + ".tmp", dir=self.directory)
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    f.write(blob)
-                os.replace(tmp, path)
-            except OSError:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                return  # disk trouble: persistence is best-effort
-            with self._lock:
-                if self._written_seq < seq:
-                    self._written_seq = seq
+        self._store = ManifestStore(
+            directory, stem="manifest", version=MANIFEST_VERSION,
+            header_extra={"serde": wire_fingerprint()},
+            header_check=_serde_header_check,
+            compact_threshold=compact_threshold,
+        )
 
     # -------------------------------------------------------- publish
     def persist(self, key: str, host_pages, tables, snap,
                 watermark: Optional[int],
                 family: Optional[tuple]) -> None:
-        """Write one entry's payload file + manifest row. Called by
+        """Write one entry's payload file + manifest record. Called by
         the store AFTER it released its own lock (file I/O and the
         per-page serialization never run under the store lock)."""
         from presto_tpu.dist.serde import serialize_page
@@ -201,7 +474,7 @@ class CachePersister:
             except OSError:
                 pass
             return
-        meta = {
+        self._store.publish(key, {
             "file": fname,
             "nbytes": len(blob),
             "tables": sorted(list(t) for t in tables),
@@ -209,30 +482,18 @@ class CachePersister:
             "watermark": watermark,
             "family": ([family[0], family[1]]
                        if family is not None else None),
-        }
-        with self._lock:
-            self._entries[key] = meta
-            self._seq += 1
-        self._write_manifest()
+        })
 
     def forget(self, keys) -> None:
         """Drop entries from the manifest (DML invalidation / stream
         advance made them stale-by-construction) and delete their
         payload files; called outside the store lock."""
-        doomed: List[str] = []
-        with self._lock:
-            for k in keys:
-                meta = self._entries.pop(k, None)
-                if meta is not None:
-                    doomed.append(meta["file"])
-                    self._seq += 1
-        for fname in doomed:
+        removed = self._store.remove(keys)
+        for meta in removed.values():
             try:
-                os.unlink(os.path.join(self.directory, fname))
+                os.unlink(os.path.join(self.directory, meta["file"]))
             except OSError:
                 pass
-        if doomed:
-            self._write_manifest()
 
     # ------------------------------------------------------ warm load
     def warm_load(self, cache, catalogs) -> Tuple[int, int]:
@@ -245,16 +506,15 @@ class CachePersister:
         from presto_tpu.dist.serde import PageWireError, \
             deserialize_page
 
-        with self._lock:
-            if self._loaded:
-                return (0, 0)
-            self._loaded = True
-            snapshot = dict(self._entries)
+        if not self._store.claim_once("warm_load"):
+            return (0, 0)
+        snapshot = self._store.entries_snapshot()
         loaded = 0
         drops = 0
-        if self._broken is not None:
-            drops += max(1, int(self._broken_count))
-            log.warning("result-cache warm load: %s", self._broken)
+        if self._store.broken_count:
+            drops += self._store.broken_count
+            for why in self._store.broken_reasons:
+                log.warning("result-cache warm load: %s", why)
         dead: List[Tuple[str, bool]] = []  # (key, delete_file)
         for key, meta in snapshot.items():
             try:
@@ -307,10 +567,7 @@ class CachePersister:
                             snap=snap, family=family, persist=False)
             loaded += 1
         if dead:
-            with self._lock:
-                for key, _ in dead:
-                    if self._entries.pop(key, None) is not None:
-                        self._seq += 1
+            self._store.remove(k for k, _ in dead)
             for key, delete in dead:
                 if delete:
                     try:
@@ -318,7 +575,6 @@ class CachePersister:
                             self.directory, _entry_file(key)))
                     except OSError:
                         pass
-            self._write_manifest()
         if loaded or drops:
             log.info("result-cache warm load from %s: %d entries "
                      "loaded, %d dropped", self.directory, loaded,

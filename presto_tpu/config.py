@@ -181,6 +181,8 @@ ETC_SESSION_KEYS: Dict[str, str] = {
     "stream-poll.ms": "stream_poll_ms",
     "cross-query-batching": "cross_query_batching",
     "cross-query-batch.wait-ms": "cross_query_batch_wait_ms",
+    "checkpoint.enabled": "checkpoint_enabled",
+    "checkpoint.dir": "checkpoint_dir",
 }
 
 # consumed structurally by server_from_etc (constructor args /
@@ -191,6 +193,7 @@ ETC_SESSION_KEYS: Dict[str, str] = {
 # every query's apply_session)
 _ETC_STRUCTURAL_KEYS = frozenset({
     "page-rows", "query.max-memory-bytes", "compile-cache.dir",
+    "checkpoint.dir",
 })
 
 
@@ -241,6 +244,12 @@ def server_from_etc(etc_dir: str, port: Optional[int] = None, **kw):
             continue
         if conf.get(etc_key):
             session_defaults.setdefault(prop, conf[etc_key])
+    # durable coordinator journal directory (structural: bound ONCE to
+    # the server process; the checkpoint_dir session prop covers the
+    # per-session override path)
+    ckpt_dir = conf.get("checkpoint.dir", "")
+    if ckpt_dir:
+        kw.setdefault("checkpoint_dir", ckpt_dir)
     return PrestoTpuServer(
         catalogs, port=port, default_catalog=default_catalog,
         memory_budget_bytes=mem, page_rows=page_rows,

@@ -172,6 +172,15 @@ class DcnRunner:
         # fault injection)
         self.last_scheduler = None
         self._stage_hook = None
+        # coordinator HA (ISSUE 20): the active query's checkpoint
+        # handle (dist/checkpoint.QueryCheckpoint) — the stage
+        # scheduler journals placements/root/drain through it; None =
+        # checkpointing off
+        self.checkpoint_handle = None
+        # output column names of the last execute() (every path —
+        # DAG, legacy cuts, local fallback): the serving layer needs
+        # them for the protocol's columns block
+        self.last_output_names: Optional[List[str]] = None
         self.session_props = dict(session_props or {})
         self.listeners = list(listeners)
         # fault-tolerance bookkeeping: nodes excluded after a mid-query
@@ -271,6 +280,9 @@ class DcnRunner:
         from presto_tpu.dist.cacheprobe import fragment_cache_key
 
         ex = self.runner.executor
+        timeout = self._probe_budget(ex)
+        if timeout is None:
+            return None
         try:
             key = fragment_cache_key(
                 partial, self.runner.catalogs,
@@ -294,7 +306,7 @@ class DcnRunner:
                     data=json.dumps(
                         {"taskId": task_id, "key": key}).encode(),
                     headers={"Content-Type": "application/json"},
-                    timeout=5,
+                    timeout=timeout,
                 ) as r:
                     out = json.loads(r.read().decode())
             except (urllib.error.URLError, ConnectionError,
@@ -352,13 +364,45 @@ class DcnRunner:
         if delay > 0:
             time.sleep(delay)
 
+    def _probe_budget(self, ex) -> Optional[float]:
+        """Deadline-aware retry budget for the remote-cache probe
+        plane (ISSUE 20 satellite): a probe against a dying holder
+        must not burn wall clock the query doesn't have. Returns the
+        probe timeout — capped at a fraction of the remaining
+        query_max_run_time — or None (counted) when the deadline
+        can't afford one; the caller falls back to normal dispatch."""
+        deadline = ex.query_deadline
+        if deadline is None:
+            return 5.0
+        remaining = deadline - time.monotonic()
+        if remaining < 2.0:
+            ex.probe_deadline_skips += 1
+            return None
+        return min(5.0, 0.25 * remaining)
+
+    @staticmethod
+    def _deadline_timeout(deadline: Optional[float],
+                          cap: float = 60.0) -> float:
+        """Per-request timeout bounded by the query's remaining
+        deadline (ISSUE 20 satellite: a fetch against a dying worker
+        must not block past query_max_run_time — the deadline check on
+        the next loop iteration then fails the query on time)."""
+        if deadline is None:
+            return cap
+        return max(1.0, min(cap, deadline - time.monotonic()))
+
     def _fetch_pages(self, st: _TaskState,
                      deadline: Optional[float]):
         """Token-acked page fetch with bounded, backed-off retries (the
         HttpPageBufferClient protocol: at-least-once + dedupe by
         token). Starts at st.next_token — a re-dispatched task resumes
         where the dead worker left off. Raises _TaskLost when this
-        placement is unreachable; the caller decides recovery."""
+        placement is unreachable; the caller decides recovery. A
+        corrupt frame (PageWireError — bit rot or a fault-injected
+        flip on the wire) retries the SAME token bounded times (the
+        token only advances on a decoded frame), then surfaces as
+        _TaskLost so the replay ladder re-pulls from a survivor — the
+        PR-16 loud-fail contract: never garbage rows."""
         while True:
             attempt = 0
             while True:
@@ -378,7 +422,7 @@ class DcnRunner:
                         f"{st.uri}/v1/task/{st.task_id}/results/"
                         f"{st.next_token}"
                         f"?max={SPOOL.FETCH_WINDOW_BYTES}",
-                        timeout=60,
+                        timeout=self._deadline_timeout(deadline),
                     ) as r:
                         if r.status == 204:
                             if r.headers.get("X-Done") == "1":
@@ -390,6 +434,18 @@ class DcnRunner:
                             st.next_token += 1
                             yield page
                         break
+                except serde.PageWireError as e:
+                    # decode failed BEFORE the token advanced: the
+                    # re-request resumes at the first unconsumed page
+                    attempt += 1
+                    if attempt > self.fetch_retries:
+                        raise _TaskLost(
+                            f"worker {st.uri} task {st.task_id}: "
+                            f"corrupt page frame at token "
+                            f"{st.next_token} after "
+                            f"{self.fetch_retries} retries: {e}"
+                        ) from e
+                    self._sleep_backoff(attempt, deadline)
                 except (urllib.error.URLError, urllib.error.HTTPError,
                         ConnectionError, OSError) as e:
                     self._raise_if_task_error(e, st.uri, st.task_id)
@@ -620,7 +676,10 @@ class DcnRunner:
                                stage_hook=self._stage_hook)
         self.last_scheduler = sched
         try:
-            return sched.run()
+            rows = sched.run()
+            self.last_output_names = getattr(sched, "root_names",
+                                             None)
+            return rows
         finally:
             if trace is not None:
                 OBS.finalize(self.runner.executor, trace,
@@ -698,7 +757,9 @@ class DcnRunner:
                 # probe timeouts)
                 self.last_distribution = "local"
                 self.last_pool = []
-                return self.runner.execute(sql).rows
+                res = self.runner.execute(sql)
+                self.last_output_names = list(res.column_names)
+                return res.rows
             partition_cols = hash_fanout_source(
                 ucut, self.runner.catalogs,
                 partition_threshold=self.partition_threshold,
@@ -867,7 +928,8 @@ class DcnRunner:
                             st.trace_t0, trace.now())
 
             ex.remote_sources[key] = supplier
-            _, rows = ex.execute(coord_plan)
+            names, rows = ex.execute(coord_plan)
+            self.last_output_names = list(names)
             return rows
         finally:
             ex.remote_sources.pop(key, None)

@@ -48,6 +48,8 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import logging
+import os
 import time
 import urllib.error
 from typing import Dict, List, Optional
@@ -524,6 +526,7 @@ class StageScheduler:
         if self._adaptive_on():
             self.replanner = self._make_replanner()
         dispatched: set = set()
+        ckpt = getattr(self.coord, "checkpoint_handle", None)
         try:
             for f in dag.fragments:
                 self._run_stage(f.fid)
@@ -532,11 +535,22 @@ class StageScheduler:
                     self.stage_hook(f.fid)
                 if self.replanner is not None:
                     self._maybe_replan(f.fid, dispatched)
+                if ckpt is not None:
+                    # spooled-stage barrier checkpoint (ISSUE 20):
+                    # placements + re-dispatchable payloads; the
+                    # payload rebuild reads CURRENT placements, so a
+                    # restarted coordinator re-POSTs exactly what a
+                    # live replay would have
+                    self._checkpoint_stage(ckpt, f.fid)
             # coordinator-side root fragment over the final stages
+            if ckpt is not None:
+                self._checkpoint_root(ckpt)
+            self._pre_root_hook()
             for fid in dag.root_inputs:
                 ex.remote_sources[stage_key(fid)] = \
                     self._root_supplier(fid)
-            _, rows = ex.execute(dag.root)
+            names, rows = ex.execute(dag.root)
+            self.root_names = list(names)
             self._root_done = True
             # settle worker-side ladder outcomes onto the coordinator
             # gauges AFTER execute() (which resets them): EXPLAIN
@@ -560,6 +574,58 @@ class StageScheduler:
             # placement (task expiry frees their partition pages)
             for pl in self._mesh_placement.values():
                 self._delete(pl)
+
+    # ------------------------------------------- checkpoint barriers
+    def _checkpoint_stage(self, ckpt, fid: int) -> None:
+        """Journal one completed stage: every live placement + the
+        full re-dispatchable payload (ISSUE 20). Best-effort — a
+        serialization failure drops THIS barrier loudly (counted) and
+        the query runs on; recovery then falls back to the re-run
+        rung instead of the spool-resume rung."""
+        try:
+            tasks = [
+                {"uri": t.placement.uri,
+                 "task_id": t.placement.task_id,
+                 "payload": self._payload_for(
+                     t, t.placement.task_id)}
+                for t in self.tasks[fid] if t.placement is not None
+            ]
+            ckpt.record_stage(
+                fid, key=stage_key(fid),
+                parts=self._spooled_parts.get(fid, 1),
+                tasks=tasks, replan_gen=self.ex.adaptive_replans)
+        except Exception as e:  # noqa: BLE001 - checkpoint barriers
+            # are best-effort: the QUERY must never fail because its
+            # journal write did; the drop is counted and logged
+            self.ex.checkpoint_drops += 1
+            logging.getLogger("presto_tpu.dist").warning(
+                "stage %d checkpoint dropped: %r", fid, e)
+
+    def _checkpoint_root(self, ckpt) -> None:
+        """Final-stage registration barrier: the coordinator-side
+        root fragment blob + which stages feed it."""
+        try:
+            blob = plan_serde.dumps(clip_for_shipping(self.dag.root))
+        except Exception as e:  # noqa: BLE001 - same best-effort
+            # contract as _checkpoint_stage: count, log, run on
+            blob = None
+            self.ex.checkpoint_drops += 1
+            logging.getLogger("presto_tpu.dist").warning(
+                "root checkpoint blob dropped: %r", e)
+        ckpt.record_root(blob, list(self.dag.root_inputs))
+
+    def _pre_root_hook(self) -> None:
+        """Deterministic fault window between the last stage barrier
+        and the final drain: FAULT_COORD_STALL_MS parks the
+        coordinator here (the chaos harness SIGKILLs it mid-stall
+        with every producer spool live), and a test-installed
+        coord._root_hook can park or kill synchronously."""
+        stall = os.environ.get("FAULT_COORD_STALL_MS")
+        if stall:
+            time.sleep(int(stall) / 1000.0)
+        hook = getattr(self.coord, "_root_hook", None)
+        if hook is not None:
+            hook(self)
 
     # ------------------------------------------------------- stages
     def _probe_key(self, t: _SchedTask, frag) -> Optional[str]:
@@ -613,6 +679,9 @@ class StageScheduler:
         key = self._probe_key(t, frag)
         if key is None:
             return False
+        timeout = coord._probe_budget(self.ex)
+        if timeout is None:
+            return False  # deadline can't afford a probe: dispatch
         for uri in pool:
             if uri in coord._excluded or \
                     not idx.might_contain(uri, key):
@@ -624,7 +693,7 @@ class StageScheduler:
                     data=json.dumps(
                         {"taskId": t.base_id, "key": key}).encode(),
                     headers={"Content-Type": "application/json"},
-                    timeout=5,
+                    timeout=timeout,
                 ) as r:
                     out = json.loads(r.read().decode())
             except (urllib.error.URLError, ConnectionError,
@@ -1012,6 +1081,7 @@ class StageScheduler:
         from presto_tpu.dist.dcn import _TaskState
 
         stage = self.tasks[fid]
+        ckpt = getattr(self.coord, "checkpoint_handle", None)
 
         def supplier():
             from presto_tpu.dist import spool as SPOOL
@@ -1061,6 +1131,11 @@ class StageScheduler:
                         if self._retry_attempts() <= 0:
                             raise DcnQueryFailed(str(e)) from e
                         self._recover_root_fetch(t, st, e)
+                if ckpt is not None:
+                    # final-drain barrier: consumed token + rolling
+                    # prefix digest for this task (ISSUE 20)
+                    ckpt.record_drain(fid, t.index, st.next_token,
+                                      st.hasher.hexdigest())
                 if tr is not None:
                     # root-parented: the drain happens AFTER the task
                     # span closed (task completion ≠ consumption) — a

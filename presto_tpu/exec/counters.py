@@ -163,6 +163,32 @@ QUERY_COUNTERS: Dict[str, tuple] = {
         "warm load: snapshot token moved, payload file missing or "
         "corrupt, manifest truncated, or wire-serde fingerprint "
         "mismatch — never served, never a crash"),
+    "checkpoints_written": (
+        "counter", "durable coordinator checkpoint records published "
+        "to the query journal (dist/checkpoint.py): admission, stage "
+        "barriers, root registration, drain progress, client-token "
+        "advances (coordinator lifetime)"),
+    "coordinator_reattaches": (
+        "counter", "journaled queries a RESTARTED coordinator "
+        "recovered — final-stage suppliers re-registered from "
+        "persisted placements (spool resume) or the statement re-run "
+        "from the journal (coordinator lifetime)"),
+    "reattach_redispatches": (
+        "counter", "dead final-stage placements re-dispatched from "
+        "persisted payloads during coordinator re-attach (the lost "
+        "suffix, through the normal replay ladder; coordinator "
+        "lifetime)"),
+    "checkpoint_drops": (
+        "counter", "checkpoint records dropped LOUDLY: journal "
+        "generations unreadable at boot (version/fingerprint skew, "
+        "torn appends, partial compaction) or barrier writes that "
+        "failed to serialize — recovery degrades to the re-run rung, "
+        "never a crash, never stale state served"),
+    "probe_deadline_skips": (
+        "counter", "remote-cache probes skipped because the query's "
+        "remaining query_max_run_time could not afford the probe "
+        "wall (deadline-aware retry budget; the task dispatched "
+        "normally instead)"),
     "cache_remote_hits": (
         "counter", "leaf tasks short-circuited by a FLEET member's "
         "fragment cache: the coordinator's pre-dispatch probe "

@@ -491,6 +491,17 @@ class Executor:
         # analyze_rung — reads one registry off one object;
         # exec/counters.py)
         self.release_skips = 0
+        # Coordinator HA (ISSUE 20, dist/checkpoint.py), lifetime-
+        # cumulative on the coordinator's executor: journal records
+        # published, queries recovered across a restart, dead
+        # placements re-dispatched during re-attach, checkpoint
+        # records dropped loudly, and remote-cache probes skipped by
+        # the deadline-aware retry budget.
+        self.checkpoints_written = 0
+        self.coordinator_reattaches = 0
+        self.reattach_redispatches = 0
+        self.checkpoint_drops = 0
+        self.probe_deadline_skips = 0
         # Stage-DAG scheduling (ISSUE 7, dist/scheduler.py): the
         # general fragment-DAG coordinator maintains these on ITS
         # executor, lifetime-cumulative like the task-retry counters.
@@ -742,6 +753,19 @@ class Executor:
         no HTTP, no serde, and zero metered crossings when the spool
         is device-resident (ISSUE 13)."""
         self.mesh_local_exchanges += 1
+
+    def count_reattach(self) -> None:
+        """Registry-counter sink for one query carried across a
+        coordinator restart (dist/checkpoint.reattach_query) — either
+        the spooled fast path or the re-run-from-SQL rung."""
+        self.coordinator_reattaches += 1
+
+    def count_reattach_redispatch(self) -> None:
+        """Registry-counter sink for one dead-spool re-dispatch during
+        crash re-attach (dist/checkpoint._redispatch_dead): a persisted
+        placement stopped answering and its persisted payload was
+        re-POSTed onto the live pool."""
+        self.reattach_redispatches += 1
 
     def count_cache_invalidations(self, n: int) -> None:
         """Registry-counter sink for the runner's write-path result-

@@ -81,6 +81,11 @@ SPAN_KINDS: Dict[str, str] = {
              "the span's interval — compile+launch skipped; "
              "miss:<Node> marks the lookup, the real execution "
              "follows as ordinary attempt/operator spans",
+    "checkpoint": "one durable coordinator-journal publish "
+                  "(dist/checkpoint.py): attrs carry the record "
+                  "state and serialized bytes — the barrier-write "
+                  "cost the ROOFLINE §18 model prices against the "
+                  "stage wall it rides on",
 }
 
 

@@ -64,11 +64,17 @@ LOCK_REGISTRY: Dict[str, str] = {
         "accounting, LRU order, tallies",
     "cache.store._shared_lock":
         "creation of THE per-process shared ResultCache instance",
-    "cache.persist.CachePersister._lock":
-        "the persistent result-cache manifest's in-memory entry map "
-        "and publish sequence number — manifest/payload file I/O "
-        "runs OUTSIDE it on a seq-loop (snapshot under lock, write "
-        "tmp + atomic rename outside, re-check sequence)",
+    "cache.persist.ManifestStore._lock":
+        "the generation-numbered manifest's in-memory entry map + "
+        "pending-append queue (shared by the result-cache warm tier "
+        "and the coordinator checkpoint journal) — append/compaction "
+        "file I/O runs OUTSIDE it on a drain loop (take batch under "
+        "lock marking the writer busy, write outside, re-check)",
+    "dist.checkpoint.CheckpointJournal._lock":
+        "the coordinator checkpoint journal's per-query record map "
+        "(protocol threads noting client tokens vs scheduler threads "
+        "recording stage barriers on the same query) — durable "
+        "publishes go through the ManifestStore OUTSIDE this lock",
     "dist.cacheprobe.RemoteCacheIndex._lock":
         "per-worker bloom summaries of cached fragment keys: "
         "heartbeat threads write (update_from_info), scheduler "
@@ -151,6 +157,10 @@ THREAD_REGISTRY: Dict[str, str] = {
     "server.worker:self._run_task":
         "one thread per task: fragment execution into the spool/page "
         "buffers",
+    "server.http_server:self._reattach_run":
+        "one thread per journaled query on a restarted coordinator: "
+        "recover via dist.checkpoint.reattach_query, verify the "
+        "delivered-page digests, settle FINISHED/FAILED",
     "server.worker:self._httpd.serve_forever":
         "the worker's HTTP accept loop",
 }

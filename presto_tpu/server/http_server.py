@@ -69,6 +69,10 @@ class _Query:
         # tailing cursor (ISSUE 14): non-None turns this query into a
         # never-finishing stream cursor served by _tail_results
         self.tail: Optional["TailCursor"] = None
+        # durable journal handle (ISSUE 20): non-None means this
+        # query's lifecycle + protocol-token advances are journaled
+        # for crash re-attach (dist/checkpoint.QueryCheckpoint)
+        self.checkpoint = None
 
     def _finish_clock(self) -> None:
         if self.finished_at is None:
@@ -484,7 +488,7 @@ class QueryManager:
                      "rows_returned_total", "query_wall_ms_total",
                      "cache_admission_bypasses",
                      "exec_counter_totals",
-                     "queued_now", "peak_queued")
+                     "queued_now", "peak_queued", "journal")
 
     # launch/batch counters accumulated across the concurrent path's
     # per-query executors at completion (ISSUE 17): those executors
@@ -500,10 +504,19 @@ class QueryManager:
 
     def __init__(self, runner_factory, listeners=(),
                  resource_groups=None, memory_arbiter=None,
-                 listener_error_counter=None):
+                 listener_error_counter=None, journal=None,
+                 counter_executor=None, dcn=None):
         from presto_tpu.obs.histo import Histogram
 
         self._runner_factory = runner_factory
+        # durable coordinator journal (ISSUE 20): server-configured
+        # (checkpoint.dir etc key) or lazily bound from the
+        # checkpoint_dir session property at first enabled submit
+        self.journal = journal
+        self._counter_ex = counter_executor
+        # the DCN dispatch plane whose scheduler barriers the per-query
+        # checkpoint handle is attached to for stage-boundary journaling
+        self._dcn = dcn
         self._queries: Dict[str, _Query] = {}
         self._seq = 0
         self._lock = make_lock(
@@ -545,6 +558,27 @@ class QueryManager:
         self.stage_histo = Histogram()
         register_owner(self)
 
+    def _journal_for(self, session: Session):
+        """The journal this query's barriers record to: the server-
+        configured one (checkpoint.dir etc key / constructor kwarg),
+        or one bound lazily from the checkpoint_dir session property.
+        None = journaling off (checkpoint_enabled false, or no
+        directory anywhere)."""
+        if not bool(session.get("checkpoint_enabled")):
+            return None
+        if self.journal is not None:
+            return self.journal
+        d = session.get("checkpoint_dir")
+        if not d:
+            return None
+        from presto_tpu.dist.checkpoint import CheckpointJournal
+
+        j = CheckpointJournal(d, counter_ex=self._counter_ex)
+        with self._lock:
+            if self.journal is None:
+                self.journal = j
+        return self.journal
+
     def submit(self, sql: str, session: Session) -> _Query:
         from presto_tpu import events as E
 
@@ -560,6 +594,14 @@ class QueryManager:
             q = _Query(qid, sql, session)
             q.resource_group = group
             self._queries[qid] = q
+        j = self._journal_for(session)
+        if j is not None:
+            # admission barrier (ISSUE 20): statement + session +
+            # group land durably before the execution thread exists
+            q.checkpoint = j.admit(
+                qid, sql, _session_snapshot(session),
+                str(group.paths[-1]) if group is not None else None,
+            )
         E.dispatch(self.listeners, "query_created", E.QueryCreatedEvent(
             query_id=q.id, sql=sql, user=session.user,
             create_time=q.created,
@@ -735,12 +777,22 @@ class QueryManager:
 
     def _execute(self, q: _Query, runner=None) -> None:
             self._queue_exit(q)
+            ckpt = q.checkpoint
             if q.cancelled:
                 # canceled while queued: still record completion so event
                 # listeners and /metrics see every created query finish
                 self._record_completion(q)
+                if ckpt is not None:
+                    ckpt.delivered()  # nothing left to recover
                 return
             q.state = "RUNNING"
+            if ckpt is not None:
+                ckpt.running()
+                # stage-boundary barriers ride the DCN scheduler
+                # (dist/scheduler._checkpoint_stage reads this handle);
+                # serial path only, so one query owns it at a time
+                if self._dcn is not None:
+                    self._dcn.checkpoint_handle = ckpt
             prev_trace = None
             try:
                 if runner is None:
@@ -768,6 +820,12 @@ class QueryManager:
                         q.set_session[stmt.name] = str(stmt.value)
                 if not q.cancelled:
                     q.state = "FINISHED"
+                    if ckpt is not None:
+                        # results exist but the client hasn't drained
+                        # them: the record survives (with columns +
+                        # row count) until the stream completes, so a
+                        # restart mid-delivery can regenerate + verify
+                        ckpt.finished(q.columns or [], len(q.rows))
             except Exception as e:  # noqa: BLE001 - the protocol
                 # surfaces EVERY query failure as a FAILED state with
                 # an error body (reference: QueryResults.error), never
@@ -778,7 +836,11 @@ class QueryManager:
                         "errorName": type(e).__name__,
                     }
                     q.state = "FAILED"
+                    if ckpt is not None:
+                        ckpt.failed(str(e), type(e).__name__)
             finally:
+                if ckpt is not None and self._dcn is not None:
+                    self._dcn.checkpoint_handle = None
                 q._finish_clock()
                 if runner is not None:
                     # snapshot the finished trace before the serial
@@ -1015,6 +1077,65 @@ def _json_value(v):
     return str(v)
 
 
+def _session_snapshot(session: Session) -> Dict:
+    """JSON-safe session state for the checkpoint journal: user/
+    catalog/schema plus the EXPLICITLY set properties (typed values
+    are already JSON-shaped) — enough to reconstruct an equivalent
+    Session on a restarted coordinator."""
+    return {
+        "user": session.user,
+        "catalog": session.catalog,
+        "schema": session.schema,
+        "values": {
+            k: v for k, v in session._values.items()
+            if v is None or isinstance(v, (bool, int, float, str))
+        },
+    }
+
+
+class _DcnServerRunner:
+    """The serial path's runner when a worker fleet is configured
+    (ISSUE 20): plain queries dispatch through the DcnRunner (stage
+    DAG / legacy cuts / local fallback), everything else — SET, DDL,
+    SHOW, EXPLAIN, prepared statements — runs on the local engine
+    directly. The DCN coordinator's final stage executes on the SAME
+    bootstrap runner/executor, so sessions, traces and counters are
+    one surface either way."""
+
+    def __init__(self, dcn, local):
+        self._dcn = dcn
+        self._local = local
+
+    @property
+    def session(self):
+        return self._local.session
+
+    @property
+    def executor(self):
+        return self._local.executor
+
+    @property
+    def last_trace(self):
+        return getattr(self._local, "last_trace", None)
+
+    def execute(self, sql: str):
+        from presto_tpu.runner import QueryResult
+        from presto_tpu.sql import ast_nodes as N
+        from presto_tpu.sql.parser import parse
+
+        try:
+            stmt = parse(sql)
+        except Exception:  # noqa: BLE001 - not dispatchable: the
+            stmt = None    # local path raises the proper error body
+        if isinstance(stmt, N.Query):
+            rows = self._dcn.execute(sql)
+            return QueryResult(
+                column_names=self._dcn.last_output_names or [],
+                rows=rows,
+            )
+        return self._local.execute(sql)
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "presto-tpu/0.2"
     protocol_version = "HTTP/1.1"
@@ -1178,12 +1299,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(info)
             return
         if parts == ["v1", "info"] or parts == ["v1", "status"]:
-            self._send_json({
+            info = {
                 "nodeId": "presto-tpu-coordinator",
                 "coordinator": True,
                 "uptime": time.time() - self.app.started,
                 "backend": self.app.backend_name,
-            })
+            }
+            from presto_tpu.obs import sanitizer as SAN
+
+            if SAN.is_armed():
+                # sanitized chaos runs poll the coordinator subprocess
+                # the same way they poll workers (worker.py /v1/info)
+                info["sanitizerViolations"] = SAN.violation_count()
+            self._send_json(info)
             return
         if parts == ["v1", "resourceGroup"]:
             rg = self.app.manager.resource_groups
@@ -1301,8 +1429,20 @@ class _Handler(BaseHTTPRequestHandler):
         chunk = q.rows[lo:hi]
         if chunk:
             out["data"] = chunk
+        ckpt = q.checkpoint
         if hi < len(q.rows):
             out["nextUri"] = f"{base}/v1/statement/{q.id}/{token + 1}"
+            if ckpt is not None:
+                # protocol-token barrier (ISSUE 20): this page is now
+                # in the client's hands — a restarted coordinator must
+                # resume the stream AT token+1 with this page's digest
+                # verified against the regenerated rows
+                from presto_tpu.dist.checkpoint import page_digest
+
+                ckpt.note_client_token(token + 1, page_digest(chunk))
+        elif ckpt is not None:
+            # stream fully delivered: nothing left to recover
+            ckpt.delivered()
         return out
 
 
@@ -1323,6 +1463,8 @@ class PrestoTpuServer:
         memory_budget_bytes: Optional[int] = None,
         session_defaults=None,
         worker_tasks: bool = False,
+        worker_uris=(),
+        checkpoint_dir: str = "",
     ):
         from presto_tpu.runner import LocalRunner
 
@@ -1364,6 +1506,33 @@ class PrestoTpuServer:
         self._page_rows = page_rows
         self._default_catalog = default_catalog
 
+        # distributed dispatch plane (ISSUE 20): a configured worker
+        # fleet makes this server a DCN coordinator — plain queries on
+        # the serial path execute through DcnRunner (stage DAG, legacy
+        # cuts, local fallback), with the coordinator-side final stage
+        # running on THE bootstrap runner/executor so sessions, traces
+        # and every dist counter surface on /metrics + system.metrics
+        self._dcn = None
+        if worker_uris:
+            from presto_tpu.dist.dcn import DcnRunner
+
+            self._dcn = DcnRunner(
+                self.catalogs, list(worker_uris),
+                default_catalog=default_catalog,
+                page_rows=page_rows,
+            )
+            self._dcn.runner = self._runner
+        # durable coordinator journal (ISSUE 20 tentpole): configured
+        # via the checkpoint.dir etc key / this kwarg; a bare
+        # checkpoint_dir SESSION property instead binds lazily in the
+        # manager at first enabled submit
+        self._journal = None
+        if checkpoint_dir:
+            from presto_tpu.dist.checkpoint import CheckpointJournal
+
+            self._journal = CheckpointJournal(
+                checkpoint_dir, counter_ex=self._runner.executor)
+
         memory_arbiter = None
         # cross-query launch batching (ISSUE 17): ONE shared batch
         # point for the concurrent path's per-query executors —
@@ -1403,8 +1572,12 @@ class PrestoTpuServer:
             if not session.is_set("query_trace_enabled"):
                 session.set("query_trace_enabled", True)
             if memory_arbiter is None:
-                # serial path: one engine, re-sessioned per query
+                # serial path: one engine, re-sessioned per query;
+                # with a worker fleet, plain queries route through the
+                # DCN dispatch plane on that same engine
                 self._runner.session = session
+                if self._dcn is not None:
+                    return _DcnServerRunner(self._dcn, self._runner)
                 return self._runner
             # the concurrent server defaults the result cache ON
             # (ISSUE 17): the process-shared store is what collapses
@@ -1445,6 +1618,9 @@ class PrestoTpuServer:
             # executor's listener_errors registry counter
             listener_error_counter=(
                 self._runner.executor.count_listener_error),
+            journal=self._journal,
+            counter_executor=self._runner.executor,
+            dcn=self._dcn,
         )
         if self._launch_batcher is not None:
             # gather only when there is someone to gang with: a lone
@@ -1474,6 +1650,111 @@ class PrestoTpuServer:
         self._install_runtime_tables()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # crash re-attach (ISSUE 20): pick up every query a previous
+        # coordinator process journaled but never delivered. Claimed
+        # once per journal+process so a double-constructed server
+        # can't run the pass twice.
+        if self._journal is not None and self._journal.claim_reattach():
+            self._reattach_pending()
+
+    def _reattach_pending(self) -> None:
+        """Register a _Query stub (under its ORIGINAL id — the
+        client's persisted nextUri names it) for every journaled
+        in-flight query and recover each on a daemon thread through
+        dist.checkpoint.reattach_query: surviving producer spools
+        resume, dead placements re-dispatch from persisted payloads,
+        anything non-recoverable fails loudly with
+        CoordinatorRestarted — never a hang."""
+        pending = self._journal.pending()
+        if not pending:
+            return
+        mgr = self.manager
+        for qid in sorted(pending):
+            rec = pending[qid]
+            sess = rec.get("session") or {}
+            try:
+                session = Session(
+                    user=sess.get("user", "presto"),
+                    catalog=(sess.get("catalog")
+                             or self._default_catalog),
+                    schema=sess.get("schema", "default"),
+                    properties=sess.get("values") or None,
+                )
+            except Exception:  # noqa: BLE001 - version skew on a
+                # persisted property must not kill the whole pass:
+                # recover the query under a default session instead
+                session = Session(catalog=self._default_catalog)
+            from presto_tpu.dist.checkpoint import QueryCheckpoint
+
+            q = _Query(qid, rec.get("sql") or "", session)
+            q.state = "RUNNING"
+            q.checkpoint = QueryCheckpoint(self._journal, qid)
+            with mgr._lock:
+                mgr._queries[qid] = q
+            threading.Thread(
+                target=self._reattach_run, args=(q, rec), daemon=True
+            ).start()
+
+    def _reattach_run(self, q: _Query, rec: Dict) -> None:
+        from presto_tpu.dist import checkpoint as CKPT
+
+        ckpt = q.checkpoint
+        try:
+            if rec.get("state") == "failed":
+                # the query had already failed: resurface the SAME
+                # error body at the client's persisted nextUri
+                q.error = rec.get("error") or {
+                    "message": "query failed before the restart",
+                    "errorName": "QueryFailed",
+                }
+                q.state = "FAILED"
+                return
+            # serialize against live queries: the recovery re-executes
+            # on the shared serial engine
+            with self.manager._exec_lock:
+                self._runner.session = q.session
+                res = CKPT.reattach_query(
+                    rec, self._dcn, self._runner.executor)
+            cols = rec.get("columns")
+            if not cols:
+                cols = [{"name": n, "type": "unknown"}
+                        for n in res.column_names]
+            types = [c["type"] for c in cols]
+            rows = [_json_row(r, types) for r in res.rows]
+            # verify every page the OLD process already handed the
+            # client against the regenerated rows — the stream only
+            # resumes when the delivered prefix is byte-identical
+            page_sha = rec.get("page_sha") or {}
+            for i in range(int(rec.get("token") or 0)):
+                want = page_sha.get(str(i))
+                got = CKPT.page_digest(
+                    rows[i * _PAGE_ROWS:(i + 1) * _PAGE_ROWS])
+                if want is not None and got != want:
+                    raise CKPT.CoordinatorRestarted(
+                        f"resumed result stream diverges at page {i}"
+                        " (digest mismatch with the delivered prefix)"
+                    )
+            q.columns = cols
+            q.rows = rows
+            q.state = "FINISHED"
+            if ckpt is not None:
+                ckpt.finished(cols, len(rows))
+        except Exception as e:  # noqa: BLE001 - the loud-fail leg of
+            # the recovery contract: any non-recoverable state becomes
+            # a FAILED query at the client's nextUri, never a hang
+            q.error = {
+                "message": str(e)[:2000],
+                "errorName": ("CoordinatorRestarted"
+                              if isinstance(e, CKPT.CoordinatorRestarted)
+                              else type(e).__name__),
+            }
+            q.state = "FAILED"
+            if ckpt is not None:
+                ckpt.failed(str(e), q.error["errorName"])
+        finally:
+            q._finish_clock()
+            q.done.set()
+            self.manager._record_completion(q)
 
     def _install_runtime_tables(self) -> None:
         """system.runtime_queries / nodes / metrics over live server
@@ -1710,6 +1991,8 @@ class PrestoTpuServer:
             unregister_local_runtime(f"http://127.0.0.1:{self.port}")
         if self.failure_detector:
             self.failure_detector.stop()
+        if self._dcn is not None:
+            self._dcn.close()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -1721,3 +2004,45 @@ class PrestoTpuServer:
                 time.sleep(3600)
         except KeyboardInterrupt:
             self.stop()
+
+
+def main() -> int:  # pragma: no cover - subprocess entry
+    """Coordinator subprocess entry (the kill-coordinator chaos mode's
+    victim): boots a PrestoTpuServer over a configured worker fleet
+    with a durable checkpoint journal, prints its port as one JSON
+    line, then serves until killed — the harness SIGKILLs this process
+    mid-query and boots a successor on the same --checkpoint-dir."""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--suite", default="tpch")
+    parser.add_argument("--scale", type=float, default=0.01)
+    parser.add_argument("--page-rows", type=int, default=1 << 16)
+    parser.add_argument("--workers", default="",
+                        help="comma-separated worker base uris")
+    parser.add_argument("--checkpoint-dir", default="")
+    args = parser.parse_args()
+
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+    from presto_tpu.connectors.tpch import TpchConnector
+
+    cls = TpchConnector if args.suite == "tpch" else TpcdsConnector
+    srv = PrestoTpuServer(
+        {args.suite: cls(scale=args.scale)}, port=args.port,
+        default_catalog=args.suite, page_rows=args.page_rows,
+        worker_uris=[u for u in args.workers.split(",") if u],
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    port = srv.start()
+    print(json.dumps({"port": port}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -45,8 +45,11 @@ task-retry path re-dispatches the fragment to a survivor);
 FAULT_SUBMIT_DROP_EVERY=n returns HTTP 500 on every nth task submit
 (exercises the coordinator's submit retry);
 FAULT_TASK_EXEC_DELAY_MS stalls task EXECUTION (a deterministic
-straggler for the stage scheduler's speculation policy). Each knob
-reads the
+straggler for the stage scheduler's speculation policy);
+FAULT_SPOOL_CORRUPT_EVERY=n bit-flips a byte inside every nth served
+results body (framing intact, page content corrupt — proves the
+consumer-side PageWireError loud-fail + replay ladder end to end).
+Each knob reads the
 runtime `fault_config` posted via POST /v1/fault as an OVERLAY on the
 environment: posted keys win (an explicit 0 disables an env-seeded
 fault), absent keys fall back to the environment, and `{}` restores
@@ -663,6 +666,10 @@ def route_task_get(app, path: str, query: str):
                         {"error": f"spool partition {part} released "
                                   f"(already acked)"}, 410)
             if blob is not None:
+                # fault injection point: the flip lands INSIDE one
+                # page body (framing stays intact), so the consumer's
+                # decode — not the transport — catches it
+                blob = app.maybe_corrupt_blob(blob)
                 if max_bytes <= 0:
                     # legacy single-blob response shape
                     return (200, [("X-Next-Token", str(token + 1)),
@@ -862,7 +869,7 @@ class TaskRuntime:
     # fetch handlers and expiry sweeps read it — guarded by
     # _tasks_lock; the fault overlay + its call counters by _fault_lock
     _shared_attrs = ("tasks", "fault_config", "_results_calls",
-                     "_submit_calls")
+                     "_submit_calls", "_corrupt_calls")
 
     def __init__(self, catalogs, *, node_id: str = "w0",
                  default_catalog: Optional[str] = None,
@@ -882,6 +889,7 @@ class TaskRuntime:
             "server.worker.TaskRuntime._fault_lock")
         self._results_calls = 0
         self._submit_calls = 0
+        self._corrupt_calls = 0
         # runtime-settable fault injection (POST /v1/fault): posted
         # keys OVERRIDE the environment (an explicit 0 disables an
         # env-seeded fault); absent keys fall back to the environment,
@@ -961,6 +969,7 @@ class TaskRuntime:
             self.fault_config = cfg
             self._results_calls = 0
             self._submit_calls = 0
+            self._corrupt_calls = 0
 
     def _fault(self, name: str) -> int:
         if name in self.fault_config:
@@ -988,6 +997,25 @@ class TaskRuntime:
         if drop and calls % drop == 0:
             return True
         return False
+
+    def maybe_corrupt_blob(self, blob: bytes) -> bytes:
+        """FAULT_SPOOL_CORRUPT_EVERY=n: bit-flip one byte of every nth
+        served results body (ISSUE 20 satellite) — proves the PR-16
+        PageWireError loud-fail contract END TO END: the consumer's
+        decode rejects the frame BEFORE its token advances, retries
+        the same token boundedly, then climbs the replay ladder to a
+        surviving replica or fails the query cleanly. Never garbage
+        rows."""
+        every = self._fault("FAULT_SPOOL_CORRUPT_EVERY")
+        if not every or not blob:
+            return blob
+        with self._fault_lock:
+            self._corrupt_calls += 1
+            if self._corrupt_calls % every:
+                return blob
+        flipped = bytearray(blob)
+        flipped[len(flipped) // 2] ^= 0x01
+        return bytes(flipped)
 
     def maybe_inject_submit_fault(self) -> bool:
         """HTTP 500 on every nth /v1/task submit — exercises the

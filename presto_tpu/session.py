@@ -467,6 +467,35 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             bool, False,
         ),
         PropertyMetadata(
+            "checkpoint_enabled",
+            "journal coordinator query state durably at natural "
+            "barriers (dist/checkpoint.py): admission, every "
+            "spooled-stage boundary (placements + spool tokens + "
+            "page digests), final-stage supplier registration, and "
+            "client-protocol token advances — so a restarted "
+            "coordinator re-attaches RUNNING queries whose producer "
+            "spools still answer instead of losing them "
+            "(coordinator_reattaches / checkpoints_written). "
+            "Effective only when a journal directory is configured "
+            "(checkpoint_dir session prop or the server's "
+            "checkpoint.dir etc key); false disables journaling "
+            "even when a directory is set",
+            bool, True,
+        ),
+        PropertyMetadata(
+            "checkpoint_dir",
+            "directory for the durable coordinator journal "
+            "(dist/checkpoint.py): one generation-numbered manifest "
+            "(shared cache/persist.py ManifestStore discipline — "
+            "atomic tmp+rename publishes, O(1) appends, compaction "
+            "past a record threshold) holding one record per "
+            "in-flight query; on restart the server replays the "
+            "journal and re-attaches or loudly fails each pending "
+            "query (never a hang, never duplicate or missing rows). "
+            "Empty = checkpointing off (the pre-restart behavior)",
+            str, "",
+        ),
+        PropertyMetadata(
             "ivm_enabled",
             "maintain registered materialized views incrementally "
             "(streaming/ivm.py): a refresh folds ONLY the pages "

@@ -52,3 +52,26 @@ def test_chaos_kill_nonleaf_recovers_via_spool_replay():
         f"kill-nonleaf chaos failed:\n{proc.stdout}\n{proc.stderr}"
     )
     assert "nonleaf_replays=" in proc.stdout
+
+
+@pytest.mark.slow
+def test_chaos_kill_coordinator_reattaches():
+    """ISSUE 20: the coordinator-loss schedule — the coordinator
+    subprocess is SIGKILLed mid-query with every producer stage
+    spooled, a successor boots on the same checkpoint journal, and the
+    client's nextUri stream resumes with single-process-identical rows
+    (the harness exits nonzero on any wrong result, hang, missing
+    re-attach, or sanitizer violation)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--iterations", "2", "--seed", "2", "--scale", "0.005",
+         "--mode", "kill-coordinator", "--sanitize"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"kill-coordinator chaos failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "coordinator_reattaches=" in proc.stdout

@@ -1,0 +1,538 @@
+"""ISSUE 20: durable coordinator query-state checkpointing + re-attach.
+
+Covers the tentpole ring by ring:
+  - journal round-trip on the generation-numbered ManifestStore
+    (admission / stage / root / token barriers, delivered-record
+    removal, reload into a fresh process-stand-in journal);
+  - loud-drop recovery: corrupt record line, truncated tail, and
+    version-skewed header all reload what survives and count
+    checkpoint_drops — never a crash, never silent loss;
+  - concurrent barrier writers under the armed lock sanitizer;
+  - the kill-the-coordinator acceptance pin: a multi-stage spooled
+    query parked at the final drain survives the coordinator being
+    replaced — the client's nextUri stream resumes with IDENTICAL
+    rows, coordinator_reattaches == 1, and ZERO producer re-launches;
+  - dead-spool re-dispatch of only the lost suffix (.ra task ids);
+  - mid-stream restart (FINISHED but undelivered): the protocol token
+    resumes after sha256 page-digest verification of the delivered
+    prefix;
+  - non-recoverable records surface FAILED/CoordinatorRestarted —
+    loudly, never a hang;
+  - FAULT_SPOOL_CORRUPT_EVERY proves the PR-16 PageWireError path:
+    sparse corruption recovers via same-token re-fetch, total
+    corruption fails the query cleanly (satellite 3).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.dist.checkpoint import (
+    CheckpointJournal,
+    CoordinatorRestarted,
+    page_digest,
+)
+from presto_tpu.runner import LocalRunner
+from presto_tpu.server import PrestoTpuServer
+from presto_tpu.server.worker import WorkerServer
+
+SF = 0.01
+PAGE_ROWS = 1 << 13
+
+# the 3-stage Q13-family shape (test_stagedag.DAG_QUERY): every
+# producer stage spools, the root agg drains stage 2 — the spooled
+# surface a coordinator restart must re-attach to
+DAG_QUERY = (
+    "select n_name, count(*), sum(top.c_count) from nation join ("
+    "  select c_nationkey nk, c_custkey ck, count(o_orderkey) c_count"
+    "  from customer left join orders on c_custkey = o_custkey"
+    "  group by c_nationkey, c_custkey) top on n_nationkey = top.nk "
+    "group by n_name order by n_name"
+)
+
+HDRS = {"X-Presto-Session": "stage_scheduler=true",
+        "Content-Type": "text/plain"}
+
+
+# ------------------------------------------------------------ helpers
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return json.loads(r.read().decode())
+
+
+def _post_statement(port, sql, headers=HDRS):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/statement",
+        data=sql.encode(), headers=headers)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read().decode())
+
+
+def _drain(doc):
+    """Follow nextUri to the end; returns all rows."""
+    rows = []
+    while True:
+        if doc.get("error"):
+            raise RuntimeError(str(doc["error"]))
+        rows.extend(doc.get("data") or [])
+        nxt = doc.get("nextUri")
+        if not nxt:
+            return rows
+        time.sleep(0.01)
+        doc = _get(nxt)
+
+
+def _sorted(rows):
+    return sorted(tuple(r) for r in rows)
+
+
+def _post_fault(uri, **cfg):
+    req = urllib.request.Request(
+        f"{uri}/v1/fault", data=json.dumps(cfg).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=5).close()
+
+
+class _CreateCounter:
+    """Record every task the workers are asked to create from arming
+    until restore — the producer-re-launch pin's measurement point
+    (TaskRuntime._submit_calls only counts under a fault knob, so the
+    choke point itself is wrapped)."""
+
+    def __init__(self, workers):
+        self.created = []
+        self._saved = []
+        for _, w in workers:
+            orig = w.create_task
+            self._saved.append((w, orig))
+
+            def counting(req, _orig=orig):
+                self.created.append(req.get("taskId"))
+                return _orig(req)
+
+            w.create_task = counting
+
+    def restore(self):
+        for w, orig in self._saved:
+            w.create_task = orig
+
+
+# ------------------------------------------------------------ fixtures
+
+
+@pytest.fixture(scope="module")
+def workers():
+    w1 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w1",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    w2 = WorkerServer({"tpch": TpchConnector(SF)}, node_id="w2",
+                      default_catalog="tpch", page_rows=PAGE_ROWS)
+    uris = [f"http://127.0.0.1:{w.start()}" for w in (w1, w2)]
+    yield list(zip(uris, (w1, w2)))
+    for w in (w1, w2):
+        w.stop()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return LocalRunner({"tpch": TpchConnector(SF)},
+                       page_rows=PAGE_ROWS)
+
+
+def _server(workers, ckdir):
+    srv = PrestoTpuServer(
+        {"tpch": TpchConnector(SF)}, port=0, page_rows=PAGE_ROWS,
+        worker_uris=[u for u, _ in workers],
+        checkpoint_dir=str(ckdir))
+    srv.start()
+    return srv
+
+
+def _park_query_at_root(srv, sql=DAG_QUERY, timeout=90):
+    """Submit ``sql`` and park its scheduler just before the final
+    drain (every producer stage spooled, nothing consumed) — the
+    deterministic coordinator-kill window. The hook RAISES once
+    released, so the superseded coordinator's thread dies instead of
+    re-draining spools the successor owns. Returns (qid, journal
+    record, release-event)."""
+    park = threading.Event()
+
+    def hook(sched):
+        park.wait(timeout)
+        raise RuntimeError("superseded coordinator: parked root "
+                           "drain aborted by the test")
+
+    srv._dcn._root_hook = hook
+    doc = _post_statement(srv.port, sql)
+    qid = doc["id"]
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        rec = srv._journal.pending().get(qid)
+        if rec and rec.get("root") and rec.get("root_inputs") and \
+                all(str(f) in rec["stages"]
+                    for f in rec["root_inputs"]):
+            return qid, rec, park
+        time.sleep(0.05)
+    raise AssertionError("stage/root barriers never reached the journal")
+
+
+def _kill(srv, qid):
+    """Simulate the crash: void the zombie thread's journal handle
+    (a dead process cannot write) and take the server down. The park
+    event stays UNSET so the thread sits harmlessly until teardown."""
+    q = srv.manager.get(qid)
+    if q is not None and q.checkpoint is not None:
+        q.checkpoint.detach()
+    srv.stop()
+
+
+# ------------------------------------------------- journal round-trip
+
+
+def test_journal_roundtrip_and_reload(tmp_path):
+    j = CheckpointJournal(str(tmp_path))
+    h = j.admit("q1", "select 1", {"user": "alice"}, "global")
+    h.running()
+    h.record_stage(0, key="stage0", parts=2, tasks=[
+        {"uri": "http://w", "task_id": "q.f0.t0", "payload": {"a": 1}},
+    ], replan_gen=0)
+    h.record_root("BLOB", [0])
+    h.record_drain(0, 0, 3, "abc")
+    h.note_client_token(1, page_digest([[1]]))
+    h.finished([{"name": "x", "type": "bigint"}], 1)
+
+    j2 = CheckpointJournal(str(tmp_path))  # fresh process stand-in
+    rec = j2.pending()["q1"]
+    assert rec["state"] == "finished"
+    assert rec["sql"] == "select 1"
+    assert rec["session"] == {"user": "alice"}
+    assert rec["stages"]["0"]["tasks"][0]["task_id"] == "q.f0.t0"
+    assert rec["root"] == "BLOB" and rec["root_inputs"] == [0]
+    assert rec["drain"]["0"]["0"] == {"next_token": 3, "sha": "abc"}
+    assert rec["token"] == 1
+    assert rec["page_sha"]["0"] == page_digest([[1]])
+
+    # claim_once: the re-attach pass runs exactly once per boot
+    assert j2.claim_reattach()
+    assert not j2.claim_reattach()
+
+    h.delivered()
+    assert "q1" not in CheckpointJournal(str(tmp_path)).pending()
+
+
+def test_detached_handle_never_writes(tmp_path):
+    j = CheckpointJournal(str(tmp_path))
+    h = j.admit("q1", "select 1", {}, None)
+    h.detach()
+    h.note_client_token(5, "x")  # must be a no-op, not a crash
+    h.failed("boom")
+    assert CheckpointJournal(str(tmp_path)).pending()["q1"]["token"] == 0
+
+
+class _Ctr:
+    def __init__(self):
+        self.checkpoint_drops = 0
+        self.checkpoints_written = 0
+
+
+def test_journal_corrupt_record_drops_loudly(tmp_path):
+    j = CheckpointJournal(str(tmp_path))
+    j.admit("q1", "select 1", {}, None)
+    j.admit("q2", "select 2", {}, None)
+    from presto_tpu.cache.persist import manifest_files
+
+    _, path = manifest_files(str(tmp_path), stem="journal")[0]
+    lines = open(path).read().splitlines()
+    # bit-rot q2's record line; WAL recovery keeps the intact prefix
+    # (header + q1) and drops from the first unparseable line on
+    garbled = [ln[: len(ln) // 2] + "#GARBAGE#" if '"q2"' in ln else ln
+               for ln in lines]
+    open(path, "w").write("\n".join(garbled) + "\n")
+
+    ctr = _Ctr()
+    j2 = CheckpointJournal(str(tmp_path), counter_ex=ctr)
+    assert "q1" in j2.pending() and "q2" not in j2.pending()
+    assert ctr.checkpoint_drops >= 1
+
+
+def test_journal_truncated_tail_drops_loudly(tmp_path):
+    j = CheckpointJournal(str(tmp_path))
+    j.admit("q1", "select 1", {}, None)
+    j.admit("q2", "select 2", {}, None)
+    from presto_tpu.cache.persist import manifest_files
+
+    _, path = manifest_files(str(tmp_path), stem="journal")[0]
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) - 7])  # torn final record
+
+    ctr = _Ctr()
+    j2 = CheckpointJournal(str(tmp_path), counter_ex=ctr)
+    # the intact prefix survives; the torn tail drops loudly
+    assert "q1" in j2.pending()
+    assert ctr.checkpoint_drops >= 1
+    # and the journal keeps working after recovery
+    j2.admit("q3", "select 3", {}, None)
+    assert "q3" in CheckpointJournal(str(tmp_path)).pending()
+
+
+def test_journal_version_skew_drops_loudly(tmp_path):
+    j = CheckpointJournal(str(tmp_path))
+    j.admit("q1", "select 1", {}, None)
+    from presto_tpu.cache.persist import (
+        read_manifest_doc,
+        rewrite_manifest_doc,
+    )
+
+    doc = read_manifest_doc(str(tmp_path), stem="journal")
+    doc["version"] = 99
+    rewrite_manifest_doc(str(tmp_path), doc, stem="journal")
+
+    ctr = _Ctr()
+    j2 = CheckpointJournal(str(tmp_path), counter_ex=ctr)
+    assert j2.pending() == {}
+    assert ctr.checkpoint_drops >= 1
+
+
+def test_concurrent_checkpoint_writers(tmp_path):
+    from presto_tpu.obs import sanitizer as SAN
+
+    was = SAN.is_armed()
+    SAN.arm()
+    before = len(SAN.violations())
+    try:
+        j = CheckpointJournal(str(tmp_path))
+
+        def write(i):
+            for n in range(20):
+                h = j.admit(f"q{i}_{n}", f"select {n}", {}, None)
+                h.running()
+                h.note_client_token(1, "sha")
+                if n % 3 == 0:
+                    h.delivered()
+                else:
+                    h.finished([], 0)
+
+        threads = [threading.Thread(target=write, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(SAN.violations()) == before
+        reloaded = CheckpointJournal(str(tmp_path))
+        # n in {0,3,6,9,12,15,18} delivered per writer: 7 of 20 gone
+        assert len(reloaded.pending()) == 6 * (20 - 7)
+        assert reloaded._store.broken_count == 0
+    finally:
+        if not was:
+            SAN.disarm()
+
+
+# ------------------------------------------------- crash re-attach
+
+
+@pytest.mark.slow
+def test_reattach_identical_rows_zero_relaunches(
+        workers, oracle, tmp_path):
+    """THE acceptance pin: coordinator replaced mid-query (all
+    producer stages spooled, final drain not started) — the client's
+    nextUri stream resumes with identical rows, coordinator_reattaches
+    == 1, and the resumed suffix launches ZERO producer tasks."""
+    want = _sorted(oracle.execute(DAG_QUERY).rows)
+    srv = _server(workers, tmp_path)
+    park = None
+    ctr = None
+    srv2 = None
+    try:
+        qid, rec, park = _park_query_at_root(srv)
+        _kill(srv, qid)
+
+        ctr = _CreateCounter(workers)
+        srv2 = _server(workers, tmp_path)
+        doc = _get(f"http://127.0.0.1:{srv2.port}/v1/statement/{qid}/0")
+        got = _drain(doc)
+        assert _sorted(got) == want
+        ex = srv2._runner.executor
+        assert ex.coordinator_reattaches == 1
+        assert ex.reattach_redispatches == 0
+        # zero producer re-launches: every stage was served from the
+        # surviving spools
+        assert ctr.created == []
+        # stream delivered -> record dropped (size governance)
+        assert qid not in srv2._journal.pending()
+    finally:
+        if ctr is not None:
+            ctr.restore()
+        if park is not None:
+            park.set()
+        if srv2 is not None:
+            srv2.stop()
+
+
+@pytest.mark.slow
+def test_reattach_redispatches_dead_spool(workers, oracle, tmp_path):
+    """One final-stage spool killed between the crash and the restart:
+    ONLY that task re-dispatches (a .ra id from its persisted
+    payload); rows stay identical."""
+    want = _sorted(oracle.execute(DAG_QUERY).rows)
+    srv = _server(workers, tmp_path)
+    park = None
+    ctr = None
+    srv2 = None
+    try:
+        qid, rec, park = _park_query_at_root(srv)
+        _kill(srv, qid)
+
+        fid = rec["root_inputs"][0]
+        victim = rec["stages"][str(fid)]["tasks"][0]
+        req = urllib.request.Request(
+            f"{victim['uri']}/v1/task/{victim['task_id']}",
+            method="DELETE")
+        urllib.request.urlopen(req, timeout=5).close()
+
+        ctr = _CreateCounter(workers)
+        srv2 = _server(workers, tmp_path)
+        doc = _get(f"http://127.0.0.1:{srv2.port}/v1/statement/{qid}/0")
+        got = _drain(doc)
+        assert _sorted(got) == want
+        ex = srv2._runner.executor
+        assert ex.coordinator_reattaches == 1
+        assert ex.reattach_redispatches >= 1
+        # only the lost suffix re-dispatched: .ra task ids, and no
+        # other producer re-launched
+        assert ctr.created and all(".ra" in t for t in ctr.created)
+    finally:
+        if ctr is not None:
+            ctr.restore()
+        if park is not None:
+            park.set()
+        if srv2 is not None:
+            srv2.stop()
+
+
+@pytest.mark.slow
+def test_mid_stream_restart_resumes_at_token(workers, oracle, tmp_path):
+    """FINISHED but not fully delivered: the restarted coordinator
+    regenerates the rows, verifies the delivered prefix against the
+    persisted page digests, and the client resumes AT its token —
+    no duplicate and no missing rows."""
+    sql = ("select l_orderkey, l_linenumber, l_quantity from lineitem "
+           "order by l_orderkey, l_linenumber")
+    want = _sorted(oracle.execute(sql).rows)
+    srv = _server(workers, tmp_path)
+    srv2 = None
+    try:
+        doc = _post_statement(srv.port, sql)
+        qid = doc["id"]
+        # consume EXACTLY one data page, remember where we stopped
+        rows, nxt = [], None
+        while True:
+            if doc.get("error"):
+                raise RuntimeError(str(doc["error"]))
+            chunk = doc.get("data") or []
+            rows.extend(chunk)
+            nxt = doc.get("nextUri")
+            if chunk or not nxt:
+                break
+            time.sleep(0.01)
+            doc = _get(nxt)
+        assert rows and nxt, "need a multi-page stream to test resume"
+        token = int(nxt.rstrip("/").rsplit("/", 1)[1])
+        srv.stop()
+
+        srv2 = _server(workers, tmp_path)
+        doc = _get(f"http://127.0.0.1:{srv2.port}"
+                   f"/v1/statement/{qid}/{token}")
+        got = rows + _drain(doc)
+        assert len(got) == len(want)
+        assert _sorted(got) == want  # no duplicate, no missing rows
+        assert srv2._runner.executor.coordinator_reattaches == 1
+        assert qid not in srv2._journal.pending()
+    finally:
+        if srv2 is not None:
+            srv2.stop()
+
+
+def test_nonrecoverable_surfaces_failed(tmp_path):
+    """A journaled query with no spools and no re-runnable statement
+    must become FAILED/CoordinatorRestarted — loudly, never a hang."""
+    j = CheckpointJournal(str(tmp_path))
+    j.admit("deadq", "", {}, None)
+    del j
+
+    srv = PrestoTpuServer({"tpch": TpchConnector(SF)}, port=0,
+                          page_rows=PAGE_ROWS,
+                          checkpoint_dir=str(tmp_path))
+    try:
+        q = srv.manager.get("deadq")
+        assert q is not None
+        assert q.done.wait(30), "re-attach hung instead of failing"
+        assert q.state == "FAILED"
+        assert q.error["errorName"] == "CoordinatorRestarted"
+        # and the journal remembers the failure for the next boot
+        rec = CheckpointJournal(str(tmp_path)).pending()["deadq"]
+        assert rec["state"] == "failed"
+    finally:
+        srv.stop()
+
+
+def test_reattach_query_no_plane_raises():
+    from presto_tpu.dist.checkpoint import reattach_query
+
+    class _Ex:
+        coordinator_reattaches = 0
+
+        def count_reattach(self):
+            self.coordinator_reattaches += 1
+
+    with pytest.raises(CoordinatorRestarted):
+        reattach_query({"sql": "select 1"}, None, _Ex())
+
+
+# ------------------------------------------- spool-corruption fault
+
+
+@pytest.mark.slow
+def test_spool_corrupt_fault_recovers_and_fails_loudly(
+        workers, oracle, tmp_path):
+    """FAULT_SPOOL_CORRUPT_EVERY (satellite 3): sparse wire corruption
+    recovers via same-token re-fetch through the PageWireError path;
+    total corruption climbs the replay ladder and fails the query
+    CLEANLY — never garbage rows. Must run over real HTTP: the
+    mesh-local fast path has no wire to corrupt."""
+    from presto_tpu.dist.dcn import DcnQueryFailed, DcnRunner
+    from presto_tpu.server.worker import unregister_local_runtime
+
+    uris = [u for u, _ in workers]
+    for u in uris:
+        unregister_local_runtime(u)
+    coord = DcnRunner(
+        {"tpch": TpchConnector(SF)}, uris, default_catalog="tpch",
+        page_rows=PAGE_ROWS,
+        session_props={"stage_scheduler": "true",
+                       "retry_backoff_ms": 20},
+    )
+    try:
+        want = _sorted(oracle.execute(DAG_QUERY).rows)
+        # sparse corruption: every 7th served body flips a bit —
+        # bounded same-token retries absorb it
+        for u in uris:
+            _post_fault(u, FAULT_SPOOL_CORRUPT_EVERY=7)
+        got = coord.execute(DAG_QUERY)
+        assert _sorted(got) == want
+
+        # total corruption: every fetch is garbage — the query fails
+        # loudly through the ladder, with the corrupt-frame cause
+        for u in uris:
+            _post_fault(u, FAULT_SPOOL_CORRUPT_EVERY=1)
+        with pytest.raises(DcnQueryFailed, match="PageWireError|corrupt"):
+            coord.execute(DAG_QUERY)
+    finally:
+        for u in uris:
+            _post_fault(u)  # {} restores env-ruled (off) fault mode
+        coord.close()

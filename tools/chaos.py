@@ -50,10 +50,15 @@ PAGE_ROWS = 1 << 13
 FAULT_KEYS = (
     "FAULT_DELAY_MS", "FAULT_DROP_EVERY", "FAULT_KILL_AFTER_FETCHES",
     "FAULT_SUBMIT_DROP_EVERY", "FAULT_DEVICE_OOM",
-    "FAULT_TASK_EXEC_DELAY_MS",
+    "FAULT_TASK_EXEC_DELAY_MS", "FAULT_SPOOL_CORRUPT_EVERY",
+    "FAULT_COORD_STALL_MS",
 )
 FAULT_MODES = ("none", "delay", "drop", "kill", "submit-drop",
-               "kill-nonleaf")
+               "kill-nonleaf", "corrupt")
+# kill-coordinator is not a per-iteration worker fault: it SIGKILLs
+# the coordinator subprocess mid-query and re-attaches on a successor
+# (run_kill_coordinator below), so it is --mode-only, never random
+ALL_MODES = FAULT_MODES + ("kill-coordinator",)
 
 # the 3-stage DAG shape (left join -> hash agg -> join -> agg) the
 # legacy agg/union cuts fall back local on; the stage scheduler
@@ -148,6 +153,222 @@ class Worker:
             self.proc.wait(timeout=10)
 
 
+class Coordinator:
+    """One coordinator subprocess over a worker fleet + a durable
+    checkpoint journal — the kill-coordinator mode's victim. A
+    ``stall_ms`` boot parks every stage-DAG query between the last
+    stage barrier and the final drain (FAULT_COORD_STALL_MS,
+    dist/scheduler._pre_root_hook): the deterministic window where
+    every producer spool is live and nothing was consumed."""
+
+    def __init__(self, scale: float, worker_uris, ckdir: str,
+                 stall_ms: int = 0):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        for k in FAULT_KEYS:
+            env.pop(k, None)
+        if stall_ms:
+            env["FAULT_COORD_STALL_MS"] = str(stall_ms)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "presto_tpu.server.http_server",
+             "--port", "0", "--scale", str(scale),
+             "--page-rows", str(PAGE_ROWS),
+             "--workers", ",".join(worker_uris),
+             "--checkpoint-dir", ckdir],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=REPO, text=True,
+        )
+        self.port = json.loads(self.proc.stdout.readline())["port"]
+        self.uri = f"http://127.0.0.1:{self.port}"
+
+    def submit(self, sql: str) -> dict:
+        req = urllib.request.Request(
+            f"{self.uri}/v1/statement", data=sql.encode(),
+            headers={"X-Presto-Session": "stage_scheduler=true",
+                     "Content-Type": "text/plain"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode())
+
+    def metric(self, name: str) -> float:
+        """One counter off /metrics (Prometheus text)."""
+        with urllib.request.urlopen(f"{self.uri}/metrics",
+                                    timeout=10) as r:
+            for ln in r.read().decode().splitlines():
+                if ln.startswith(f"presto_tpu_{name}"):
+                    return float(ln.rsplit(None, 1)[1])
+        return 0.0
+
+    def sanitizer_violations(self) -> int:
+        try:
+            with urllib.request.urlopen(f"{self.uri}/v1/info",
+                                        timeout=5) as r:
+                return int(json.load(r).get(
+                    "sanitizerViolations", 0) or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def sigkill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def _wait_for_journal_barriers(ckdir: str, qid: str,
+                               timeout: float = 60.0) -> None:
+    """Poll the journal directory (read-only, from the parent) until
+    ``qid`` has its root fragment + every feeding stage checkpointed —
+    the coordinator is then inside its stall window."""
+    from presto_tpu.cache.persist import read_manifest_doc
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            doc = read_manifest_doc(ckdir, stem="journal")
+        except ValueError:
+            doc = None
+        rec = ((doc or {}).get("entries") or {}).get(qid)
+        if rec and rec.get("root") and rec.get("root_inputs") and \
+                all(str(f) in rec.get("stages", {})
+                    for f in rec["root_inputs"]):
+            return
+        time.sleep(0.05)
+    raise RuntimeError(
+        f"query {qid}: stage/root barriers never reached the journal")
+
+
+def _drain_statement(uri: str, qid: str, deadline_s: float):
+    """Restart-tolerant protocol drain from token 0: the successor
+    coordinator may still be re-attaching when the first poll lands,
+    so transient refusals retry until the deadline."""
+    rows = []
+    url = f"{uri}/v1/statement/{qid}/0"
+    deadline = time.monotonic() + deadline_s
+    while True:
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"query {qid}: drain past deadline")
+        try:
+            with urllib.request.urlopen(url, timeout=30) as r:
+                doc = json.loads(r.read().decode())
+        except (OSError, ValueError):
+            time.sleep(0.2)
+            continue
+        if doc.get("error"):
+            raise RuntimeError(str(doc["error"]))
+        rows.extend(doc.get("data") or [])
+        nxt = doc.get("nextUri")
+        if not nxt:
+            return rows
+        url = nxt
+        time.sleep(0.02)
+
+
+def run_kill_coordinator(args, san) -> int:
+    """The ISSUE-20 acceptance loop: a multi-stage distributed query
+    with every producer stage spooled survives the coordinator being
+    SIGKILLed mid-query — the successor process on the same
+    --checkpoint-dir re-attaches, the client's nextUri stream resumes,
+    rows equal the single-process oracle, coordinator_reattaches >= 1.
+    Exits nonzero on any wrong result, error, hang, or (with
+    --sanitize) any sanitizer violation in any process."""
+    import shutil
+    import tempfile
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runner import LocalRunner
+
+    print(f"# oracle: single-process run at SF{args.scale}", flush=True)
+    single = LocalRunner({"tpch": TpchConnector(args.scale)},
+                         page_rows=PAGE_ROWS)
+    # tuples -> lists: protocol rows arrive as JSON arrays and
+    # rows_equal compares reprs
+    want = [list(r) for r in single.execute(DAG_QUERY).rows]
+
+    workers = [Worker(args.scale) for _ in range(args.workers)]
+    for w in workers:
+        w.boot()
+    uris = [w.uri for w in workers]
+    failures = 0
+    violations = 0
+    reattaches_total = 0
+    try:
+        for i in range(args.iterations):
+            for w in workers:
+                w.ensure()
+            ckdir = tempfile.mkdtemp(prefix="presto-tpu-ckpt-")
+            status = "ok"
+            t0 = time.monotonic()
+            coord = succ = None
+            try:
+                # boot A stalled wide open, submit, wait for the
+                # barriers, SIGKILL mid-stall: every producer spool is
+                # live, nothing consumed, the journal has it all
+                coord = Coordinator(args.scale, uris, ckdir,
+                                    stall_ms=args.deadline_ms)
+                qid = coord.submit(DAG_QUERY)["id"]
+                _wait_for_journal_barriers(ckdir, qid)
+                coord.sigkill()
+                # boot B on the same journal; the client re-polls its
+                # persisted nextUri against the successor
+                succ = Coordinator(args.scale, uris, ckdir)
+                got = _drain_statement(
+                    succ.uri, qid, args.deadline_ms / 1000.0)
+                got = [list(r) for r in got]
+                if not rows_equal(got, want):
+                    status = "WRONG RESULT"
+                    failures += 1
+                re_n = succ.metric("coordinator_reattaches")
+                reattaches_total += int(re_n)
+                if re_n < 1:
+                    status = "NO REATTACH RECORDED"
+                    failures += 1
+                if san is not None:
+                    violations += succ.sanitizer_violations()
+            except Exception as e:  # noqa: BLE001 - harness verdict
+                status = f"ERROR {type(e).__name__}: {e}"
+                failures += 1
+            finally:
+                for c in (coord, succ):
+                    if c is not None:
+                        c.sigkill()
+                shutil.rmtree(ckdir, ignore_errors=True)
+            wall = time.monotonic() - t0
+            if wall * 1000 > args.deadline_ms:
+                status += " + HANG past deadline"
+                failures += 1
+            print(f"iter {i:02d} q=dag    fault=kill-coordinator "
+                  f"wall={wall:6.2f}s: {status}", flush=True)
+    finally:
+        if san is not None:
+            import http.client
+
+            for w in workers:
+                if not w.alive():
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                            f"{w.uri}/v1/info", timeout=5) as r:
+                        violations += int(json.load(r).get(
+                            "sanitizerViolations", 0) or 0)
+                except (OSError, ValueError,
+                        http.client.HTTPException):
+                    pass
+            if violations:
+                print(f"# chaos: {violations} sanitizer violation(s) "
+                      f"across coordinator/worker processes")
+                failures += violations
+            if san.violation_count():
+                print(san.report())
+                failures += san.violation_count()
+        for w in workers:
+            w.kill()
+    if reattaches_total < args.iterations:
+        print(f"# chaos: only {reattaches_total} re-attaches across "
+              f"{args.iterations} kill-coordinator iterations")
+    print(f"# chaos: {args.iterations} iterations, {failures} failures,"
+          f" coordinator_reattaches={reattaches_total}")
+    return 1 if failures else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iterations", type=int, default=20)
@@ -155,10 +376,13 @@ def main() -> int:
     ap.add_argument("--scale", type=float, default=0.01)
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--deadline-ms", type=int, default=180_000)
-    ap.add_argument("--mode", choices=FAULT_MODES, default=None,
+    ap.add_argument("--mode", choices=ALL_MODES, default=None,
                     help="pin every iteration to one fault mode "
                     "(kill-nonleaf additionally requires at least "
-                    "one nonleaf_replay across the run)")
+                    "one nonleaf_replay across the run; "
+                    "kill-coordinator SIGKILLs the coordinator "
+                    "subprocess mid-query and re-attaches on a "
+                    "successor over the same checkpoint journal)")
     ap.add_argument("--sanitize", action="store_true",
                     help="arm the runtime lock sanitizer in the "
                     "coordinator and every worker; fail on any "
@@ -174,6 +398,9 @@ def main() -> int:
 
         san.arm()
         san.reset()
+
+    if args.mode == "kill-coordinator":
+        return run_kill_coordinator(args, san)
 
     from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.dist.dcn import DcnRunner
@@ -257,6 +484,11 @@ def main() -> int:
                 "submit-drop": {"FAULT_SUBMIT_DROP_EVERY": 2},
                 "kill-nonleaf": {"FAULT_KILL_AFTER_FETCHES":
                                  rng.choice((1, 2))},
+                # sparse wire bit-rot: every nth served results body
+                # flips one bit; the PR-16 PageWireError path must
+                # absorb it via bounded same-token re-fetches
+                "corrupt": {"FAULT_SPOOL_CORRUPT_EVERY":
+                            rng.choice((5, 9))},
             }[mode]
             for w in workers:
                 w.set_fault(config if w is victim else {})

@@ -638,6 +638,172 @@ def run_restart_bench(args, persist_dir: str) -> dict:
     }
 
 
+# the 3-stage DAG shape every restart-coordinator cycle parks at its
+# final drain (all producer stages spooled) — the same query the chaos
+# kill-coordinator mode and tests/test_checkpoint.py pin
+_RESTART_DAG_QUERY = (
+    "select n_name, count(*), sum(top.c_count) from nation join ("
+    "  select c_nationkey nk, c_custkey ck, count(o_orderkey) c_count"
+    "  from customer left join orders on c_custkey = o_custkey"
+    "  group by c_nationkey, c_custkey) top on n_nationkey = top.nk "
+    "group by n_name order by n_name"
+)
+
+
+def run_restart_coordinator_bench(args) -> dict:
+    """Coordinator-HA mode (ISSUE 20): ``--restart-coordinator N``
+    runs N kill/re-attach cycles. Each cycle parks a spooled
+    multi-stage query at its final drain (every producer stage
+    checkpointed), replaces the coordinator (stop + fresh server on
+    the same checkpoint journal), drains the client's persisted
+    nextUri against the successor, and then serves a few fresh
+    statements. Reports the re-attach success rate, the re-attach
+    drain wall (boot-to-last-row, client stopwatch — these are
+    N one-shot recoveries, not a histogram population) and the
+    post-restart fresh-query wall, each as p50/p99 over cycles."""
+    import shutil
+    import tempfile
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.server.http_server import PrestoTpuServer
+    from presto_tpu.server.worker import WorkerServer
+
+    page_rows = 1 << 13
+    hdrs = {"X-Presto-Session": "stage_scheduler=true",
+            "Content-Type": "text/plain"}
+
+    def post(port, sql):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/statement",
+            data=sql.encode(), headers=hdrs)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read().decode())
+
+    def drain(doc):
+        rows = []
+        while True:
+            if doc.get("error"):
+                raise RuntimeError(str(doc["error"]))
+            rows.extend(doc.get("data") or [])
+            nxt = doc.get("nextUri")
+            if not nxt:
+                return rows
+            time.sleep(0.01)
+            with urllib.request.urlopen(nxt, timeout=60) as r:
+                doc = json.loads(r.read().decode())
+
+    oracle = LocalRunner({"tpch": TpchConnector(args.scale)},
+                         page_rows=page_rows)
+    want = sorted(map(repr, map(list, oracle.execute(
+        _RESTART_DAG_QUERY).rows)))
+
+    workers = [
+        WorkerServer({"tpch": TpchConnector(args.scale)},
+                     node_id=f"w{i}", default_catalog="tpch",
+                     page_rows=page_rows)
+        for i in range(2)
+    ]
+    uris = [f"http://127.0.0.1:{w.start()}" for w in workers]
+
+    def boot(ckdir):
+        srv = PrestoTpuServer(
+            {"tpch": TpchConnector(scale=args.scale)}, port=0,
+            page_rows=page_rows, worker_uris=uris,
+            checkpoint_dir=ckdir)
+        srv.start()
+        return srv
+
+    n = args.restart_coordinator
+    reattached = 0
+    errors = 0
+    reattach_walls = []
+    fresh_walls = []
+    try:
+        for _ in range(n):
+            ckdir = tempfile.mkdtemp(prefix="loadbench_ckpt_")
+            park = threading.Event()
+            srv = srv2 = None
+            try:
+                srv = boot(ckdir)
+
+                def hook(sched, _park=park):
+                    _park.wait(300)
+                    raise RuntimeError(
+                        "superseded coordinator: parked root drain")
+
+                srv._dcn._root_hook = hook
+                qid = post(srv.port, _RESTART_DAG_QUERY)["id"]
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    rec = srv._journal.pending().get(qid)
+                    if rec and rec.get("root") and \
+                            rec.get("root_inputs") and \
+                            all(str(f) in rec["stages"]
+                                for f in rec["root_inputs"]):
+                        break
+                    time.sleep(0.05)
+                else:
+                    raise RuntimeError("barriers never journaled")
+                q = srv.manager.get(qid)
+                if q is not None and q.checkpoint is not None:
+                    q.checkpoint.detach()  # dead processes don't write
+                srv.stop()
+
+                t0 = time.monotonic()
+                srv2 = boot(ckdir)
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv2.port}"
+                        f"/v1/statement/{qid}/0", timeout=60) as r:
+                    doc = json.loads(r.read().decode())
+                got = drain(doc)
+                reattach_walls.append(
+                    (time.monotonic() - t0) * 1000.0)
+                ex = srv2._runner.executor
+                if (sorted(map(repr, map(list, got))) == want
+                        and ex.coordinator_reattaches >= 1):
+                    reattached += 1
+                else:
+                    errors += 1
+                # post-restart serving health: fresh statements on the
+                # successor, client-stopwatch walls
+                for sql in REPEATED_STATEMENTS:
+                    t1 = time.monotonic()
+                    drain(post(srv2.port, sql))
+                    fresh_walls.append(
+                        (time.monotonic() - t1) * 1000.0)
+            except Exception as e:  # noqa: BLE001 - bench verdict
+                errors += 1
+                print(f"# restart-coordinator cycle failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+            finally:
+                park.set()
+                for s in (srv, srv2):
+                    if s is not None:
+                        s.stop()
+                shutil.rmtree(ckdir, ignore_errors=True)
+    finally:
+        for w in workers:
+            w.stop()
+
+    def pct(walls, q):
+        if not walls:
+            return 0.0
+        s = sorted(walls)
+        return s[min(int(q * len(s)), len(s) - 1)]
+
+    return {
+        "mode": "restart-coordinator",
+        "cycles": n,
+        "errors": errors,
+        "reattach_rate": (reattached / n) if n else 0.0,
+        "reattach_p50_ms": round(pct(reattach_walls, 0.50), 1),
+        "reattach_p99_ms": round(pct(reattach_walls, 0.99), 1),
+        "post_restart_p50_ms": round(pct(fresh_walls, 0.50), 1),
+        "post_restart_p99_ms": round(pct(fresh_walls, 0.99), 1),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--server", default=None,
@@ -692,6 +858,15 @@ def main() -> int:
                     help="result_cache_persist_dir for the clients' "
                          "sessions (default: a fresh temp dir when "
                          "--restart-after is set)")
+    ap.add_argument("--restart-coordinator", type=int, default=0,
+                    help="coordinator-HA mode (ISSUE 20): run this "
+                         "many kill/re-attach cycles — each parks a "
+                         "spooled multi-stage query at its final "
+                         "drain, replaces the coordinator on the "
+                         "same checkpoint journal, resumes the "
+                         "client's nextUri stream, then serves fresh "
+                         "statements; reports reattach_rate and "
+                         "re-attach / post-restart p50/p99")
     ap.add_argument("--fleet", type=int, default=0,
                     help="fleet-reuse mode (ISSUE 19): boot this "
                          "many subprocess workers under a DcnRunner "
@@ -721,6 +896,16 @@ def main() -> int:
     if args.fleet > 0:
         out = run_fleet_bench(args.fleet, args.duration, args.scale,
                               seed=args.seed)
+        if san is not None:
+            out["sanitizer_violations"] = san.violation_count()
+            if out["sanitizer_violations"]:
+                print(san.report(), file=sys.stderr)
+        print(json.dumps(out, sort_keys=True))
+        return 1 if out["errors"] or out.get(
+            "sanitizer_violations") else 0
+
+    if args.restart_coordinator > 0:
+        out = run_restart_coordinator_bench(args)
         if san is not None:
             out["sanitizer_violations"] = san.violation_count()
             if out["sanitizer_violations"]:
